@@ -1,0 +1,25 @@
+"""photon-ml-tpu: a TPU-native (JAX/XLA/pjit) framework for GLMs and GLMix/GAME models.
+
+A ground-up rebuild of the capabilities of LinkedIn Photon-ML
+(reference: /root/reference, Scala/Spark) designed for TPU hardware:
+
+- GLM training (linear / logistic / Poisson regression, smoothed-hinge SVM)
+  with L1 / L2 / elastic-net regularization and box constraints.
+- Pure-JAX, fully jittable optimizers: L-BFGS, OWL-QN, box-projected L-BFGS,
+  and TRON (trust-region Newton with truncated conjugate gradient).
+- Feature normalization folded algebraically into the objective so raw data
+  is never rewritten (reference: photon-lib function/glm/ValueAndGradientAggregator.scala:36-49).
+- GAME/GLMix: fixed-effect + per-entity random-effect coordinates trained by
+  block coordinate descent with residual offsets
+  (reference: photon-lib algorithm/CoordinateDescent.scala).
+- Data parallelism via jax.sharding (Mesh + NamedSharding + psum), replacing
+  Spark treeAggregate; entity parallelism via vmap'd local solvers over
+  padded entity blocks, replacing per-entity RDD solves.
+- Evaluation (AUC, AUPR, RMSE, per-task losses, precision@k, per-query
+  variants), hyper-parameter search (Sobol random + Gaussian-process
+  Bayesian), model diagnostics, and Avro I/O end to end.
+"""
+
+__version__ = "0.1.0"
+
+from photon_ml_tpu.types import TaskType  # noqa: F401

@@ -1,0 +1,11 @@
+from photon_ml_tpu.algorithm.coordinates import (  # noqa: F401
+    Coordinate,
+    CoordinateOptimizationConfig,
+    FixedEffectCoordinate,
+    ModelCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.algorithm.coordinate_descent import (  # noqa: F401
+    CoordinateDescentResult,
+    run_coordinate_descent,
+)

@@ -1,0 +1,1 @@
+from photon_ml_tpu.data.batch import LabeledPointBatch  # noqa: F401

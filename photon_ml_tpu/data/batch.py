@@ -1,0 +1,132 @@
+"""Dense batched training data: the TPU-native LabeledPoint.
+
+Reference parity: photon-lib data/LabeledPoint.scala — per-sample
+(label, features, offset, weight). On TPU the unit is not one sample but a
+dense [n, d] block: the MXU wants large batched matmuls, so sparse per-sample
+vectors become padded dense rows (feature shards are domain-limited, see
+SURVEY.md §7 "Sparse features on TPU").
+
+``weights`` double as the padding mask: padded rows carry weight 0 and
+therefore contribute nothing to any weighted aggregate — value, gradient,
+Hessian-vector, or evaluator. This is how fixed-shape jit programs coexist
+with ragged real-world data.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@flax.struct.dataclass
+class LabeledPointBatch:
+    """A dense block of labeled samples.
+
+    features: [n, d] float array
+    labels:   [n] float array
+    offsets:  [n] float array — prior/residual scores added to the margin
+              (the residual mechanism of coordinate descent,
+              reference data/DataSet.scala addScoresToOffsets)
+    weights:  [n] float array — sample weights; 0 marks padding
+    """
+
+    features: Array
+    labels: Array
+    offsets: Array
+    weights: Array
+
+    @property
+    def num_samples(self) -> int:
+        return self.features.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.features.shape[1]
+
+    def with_offsets(self, offsets: Array) -> "LabeledPointBatch":
+        return self.replace(offsets=offsets)
+
+    def add_scores_to_offsets(self, scores: Array) -> "LabeledPointBatch":
+        """Residual update used by coordinate descent (DataSet.addScoresToOffsets)."""
+        return self.replace(offsets=self.offsets + scores)
+
+    @classmethod
+    def create(
+        cls,
+        features,
+        labels,
+        offsets=None,
+        weights=None,
+        dtype=None,
+    ) -> "LabeledPointBatch":
+        """Build a batch. ``dtype=None`` preserves the input float dtype
+        (float64 in x64 test mode, float32 in production)."""
+        features = jnp.asarray(features, dtype=dtype)
+        if dtype is None:
+            dtype = features.dtype
+        labels = jnp.asarray(labels, dtype=dtype)
+        n = features.shape[0]
+        if offsets is None:
+            offsets = jnp.zeros((n,), dtype=dtype)
+        else:
+            offsets = jnp.asarray(offsets, dtype=dtype)
+        if weights is None:
+            weights = jnp.ones((n,), dtype=dtype)
+        else:
+            weights = jnp.asarray(weights, dtype=dtype)
+        return cls(features=features, labels=labels, offsets=offsets, weights=weights)
+
+    def pad_to(self, n: int) -> "LabeledPointBatch":
+        """Pad to n rows with zero-weight rows (fixed shapes for jit)."""
+        cur = self.num_samples
+        if cur == n:
+            return self
+        if cur > n:
+            raise ValueError(f"cannot pad {cur} rows down to {n}")
+        pad = n - cur
+        return LabeledPointBatch(
+            features=jnp.pad(self.features, ((0, pad), (0, 0))),
+            labels=jnp.pad(self.labels, (0, pad)),
+            offsets=jnp.pad(self.offsets, (0, pad)),
+            weights=jnp.pad(self.weights, (0, pad)),
+        )
+
+
+def compute_margins(batch: LabeledPointBatch, coefficients: Array) -> Array:
+    """margin_i = x_i . w + offset_i (reference DataPoint.computeMargin)."""
+    return batch.features @ coefficients + batch.offsets
+
+
+def summarize(features: np.ndarray, weights: np.ndarray | None = None) -> dict:
+    """Weighted feature summary (reference stat/BasicStatisticalSummary.scala).
+
+    Returns mean, variance (unbiased, weighted), max, min, max_magnitude,
+    norm_l1, norm_l2, num_nonzeros per feature column — the statistics the
+    reference gets from Spark MLLIB's MultivariateStatisticalSummary.
+    """
+    features = np.asarray(features, dtype=np.float64)
+    n = features.shape[0]
+    if weights is None:
+        weights = np.ones((n,), dtype=np.float64)
+    else:
+        weights = np.asarray(weights, dtype=np.float64)
+    wsum = weights.sum()
+    mean = (weights[:, None] * features).sum(axis=0) / wsum
+    centered = features - mean
+    var = (weights[:, None] * centered * centered).sum(axis=0) / np.maximum(wsum - 1.0, 1.0)
+    return {
+        "count": n,
+        "weight_sum": wsum,
+        "mean": mean,
+        "variance": var,
+        "max": features.max(axis=0) if n else np.zeros(features.shape[1]),
+        "min": features.min(axis=0) if n else np.zeros(features.shape[1]),
+        "max_magnitude": np.abs(features).max(axis=0) if n else np.zeros(features.shape[1]),
+        "norm_l1": np.abs(features).sum(axis=0),
+        "norm_l2": np.sqrt((features * features).sum(axis=0)),
+        "num_nonzeros": (features != 0).sum(axis=0).astype(np.float64),
+    }

@@ -1,0 +1,278 @@
+"""GAME dataset: the TPU-native GameDatum collection.
+
+Reference parity: photon-api data/GameDatum.scala (response/offset/weight +
+per-shard features + id tags), data/FixedEffectDataSet.scala,
+data/RandomEffectDataSet.scala (grouping per entity with reservoir caps,
+lower bounds, active/passive split), data/LocalDataSet.scala (per-entity
+Pearson feature selection), data/RandomEffectDataSetPartitioner.scala.
+
+TPU-native redesign (SURVEY.md §7):
+
+- The dataset is column-oriented: one dense [n, d_shard] feature block per
+  feature shard, plus [n] labels/offsets/weights and per-RE-type [n] entity
+  index arrays. The sample axis shards over the mesh's "data" axis.
+- Random-effect *training* data is materialized as size-bucketed padded
+  blocks: entities bucketed by sample count, each bucket a
+  [entities, cap, d] tensor that a vmapped local solver consumes. This
+  replaces the reference's groupByKey + per-entity RDD records.
+- There is no passive/active score split: scoring always runs over the full
+  sample axis via an entity-indexed gather (models/game.py), so samples
+  dropped from training (reservoir cap, lower bound) are still scored —
+  the same semantics as active+passive scoring in the reference
+  (RandomEffectDataSet.scala:433-478).
+- Reservoir sampling is keyed on stable sample ids, fixing the recompute
+  instability documented at RandomEffectDataSet.scala:389-395.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import LabeledPointBatch
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class GameDataset:
+    """Column-oriented GAME data. Host-built once, then device-resident.
+
+    feature_shards: shard id -> [n, d_shard] (np or jax array)
+    entity_idx:     RE type -> [n] int32 (row in that type's entity vocab,
+                    -1 for entities absent from the vocab)
+    entity_vocabs:  RE type -> [num_entities] key array (host)
+    ids:            eval id columns (e.g. queryId) -> [n] host array
+    """
+
+    unique_ids: np.ndarray
+    labels: Array
+    offsets: Array
+    weights: Array
+    feature_shards: dict[str, Array]
+    entity_idx: dict[str, Array]
+    entity_vocabs: dict[str, np.ndarray]
+    ids: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_samples(self) -> int:
+        return int(self.labels.shape[0])
+
+    def shard_features(self, shard_id: str) -> Array:
+        return self.feature_shards[shard_id]
+
+    def entity_indices(self, re_type: str) -> Array:
+        return self.entity_idx[re_type]
+
+    def fixed_effect_batch(self, shard_id: str, extra_offsets: Array | None = None) -> LabeledPointBatch:
+        offsets = self.offsets if extra_offsets is None else self.offsets + extra_offsets
+        return LabeledPointBatch(
+            features=jnp.asarray(self.feature_shards[shard_id]),
+            labels=jnp.asarray(self.labels),
+            offsets=jnp.asarray(offsets),
+            weights=jnp.asarray(self.weights),
+        )
+
+
+@dataclasses.dataclass
+class EntityBucket:
+    """One size-bucket of random-effect training data.
+
+    features:    [e, cap, d]
+    labels/offsets/weights: [e, cap] (weight 0 marks padding)
+    entity_rows: [e] int32 — row of each entity in the RE type's vocab
+    sample_rows: [e, cap] int32 — global sample row of each slot, -1 pad
+    """
+
+    features: Array
+    labels: Array
+    weights: Array
+    entity_rows: Array
+    sample_rows: Array
+
+    @property
+    def num_entities(self) -> int:
+        return self.features.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.features.shape[1]
+
+    def gather_offsets(self, full_offsets: Array) -> Array:
+        """Current residual offsets for every slot: [e, cap]."""
+        safe = jnp.maximum(self.sample_rows, 0)
+        return jnp.where(self.sample_rows >= 0, full_offsets[safe], 0.0)
+
+
+@dataclasses.dataclass
+class RandomEffectDataset:
+    """Bucketed per-entity training view for one RE coordinate."""
+
+    random_effect_type: str
+    feature_shard_id: str
+    buckets: list[EntityBucket]
+    num_entities: int  # size of the entity vocab
+    dim: int
+
+    @property
+    def num_trained_entities(self) -> int:
+        return sum(b.num_entities for b in self.buckets)
+
+
+def _stable_priority(sample_id: int, seed: int) -> int:
+    """Deterministic per-sample priority for reservoir sampling, stable under
+    recompute (fixes RandomEffectDataSet.scala:389-395)."""
+    h = hashlib.blake2b(
+        f"{seed}:{sample_id}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(h, "little")
+
+
+def build_random_effect_dataset(
+    dataset: GameDataset,
+    re_type: str,
+    shard_id: str,
+    *,
+    active_data_upper_bound: int | None = None,
+    active_data_lower_bound: int | None = None,
+    bucket_sizes: Sequence[int] = (8, 32, 128, 512, 2048),
+    seed: int = 0,
+) -> RandomEffectDataset:
+    """Group samples by entity into padded, size-bucketed blocks.
+
+    - upper bound: per-entity reservoir cap (stable-id keyed sampling),
+      reference RandomEffectDataSet.scala:354-420 / MinHeapWithFixedCapacity.
+    - lower bound: entities with fewer samples are excluded from training
+      (still scored via the gather path), reference :320-341.
+    - buckets: entities padded to the smallest bucket capacity >= their
+      (capped) sample count; per-bucket tensors keep padding waste bounded
+      while giving the vmapped solver fixed shapes.
+    """
+    entity_idx = np.asarray(dataset.entity_idx[re_type])
+    features = np.asarray(dataset.feature_shards[shard_id])
+    labels = np.asarray(dataset.labels)
+    weights = np.asarray(dataset.weights)
+    unique_ids = np.asarray(dataset.unique_ids)
+    dim = features.shape[1]
+    num_entities = len(dataset.entity_vocabs[re_type])
+
+    # samples per entity (ignore rows with no entity)
+    valid = entity_idx >= 0
+    order = np.argsort(entity_idx[valid], kind="stable")
+    rows = np.nonzero(valid)[0][order]
+    ents = entity_idx[rows]
+    boundaries = np.concatenate(
+        [[0], np.nonzero(ents[1:] != ents[:-1])[0] + 1, [len(ents)]]
+    )
+
+    max_bucket = max(bucket_sizes)
+    per_bucket: dict[int, list[tuple[int, np.ndarray]]] = {c: [] for c in bucket_sizes}
+    for start, end in zip(boundaries[:-1], boundaries[1:]):
+        entity = int(ents[start])
+        sample_rows = rows[start:end]
+        count = len(sample_rows)
+        if active_data_lower_bound is not None and count < active_data_lower_bound:
+            continue
+        # The largest bucket is an implicit cap: sampling (not head-truncation)
+        # applies either way, so the kept subset is unbiased.
+        cap = min(active_data_upper_bound or max_bucket, max_bucket)
+        if count > cap:
+            # stable reservoir: keep the `cap` samples with smallest priority
+            prio = np.array(
+                [_stable_priority(int(unique_ids[r]), seed) for r in sample_rows]
+            )
+            keep = np.argsort(prio, kind="stable")[:cap]
+            sample_rows = sample_rows[np.sort(keep)]
+            count = cap
+        bucket_cap = next(c for c in bucket_sizes if c >= count)
+        per_bucket[bucket_cap].append((entity, sample_rows))
+
+    buckets: list[EntityBucket] = []
+    for cap, members in per_bucket.items():
+        if not members:
+            continue
+        e = len(members)
+        bf = np.zeros((e, cap, dim), dtype=features.dtype)
+        bl = np.zeros((e, cap), dtype=labels.dtype)
+        bw = np.zeros((e, cap), dtype=weights.dtype)
+        be = np.zeros((e,), dtype=np.int32)
+        bs = np.full((e, cap), -1, dtype=np.int32)
+        for i, (entity, sample_rows) in enumerate(members):
+            k = len(sample_rows)
+            bf[i, :k] = features[sample_rows]
+            bl[i, :k] = labels[sample_rows]
+            bw[i, :k] = weights[sample_rows]
+            be[i] = entity
+            bs[i, :k] = sample_rows
+        buckets.append(
+            EntityBucket(
+                features=jnp.asarray(bf),
+                labels=jnp.asarray(bl),
+                weights=jnp.asarray(bw),
+                entity_rows=jnp.asarray(be),
+                sample_rows=jnp.asarray(bs),
+            )
+        )
+
+    return RandomEffectDataset(
+        random_effect_type=re_type,
+        feature_shard_id=shard_id,
+        buckets=buckets,
+        num_entities=num_entities,
+        dim=dim,
+    )
+
+
+def build_game_dataset(
+    *,
+    labels,
+    feature_shards: Mapping[str, np.ndarray],
+    entity_keys: Mapping[str, np.ndarray] | None = None,
+    offsets=None,
+    weights=None,
+    unique_ids=None,
+    ids: Mapping[str, np.ndarray] | None = None,
+    entity_vocabs: Mapping[str, np.ndarray] | None = None,
+    dtype=np.float32,
+) -> GameDataset:
+    """Assemble a GameDataset from host arrays (reference GameConverters).
+
+    entity_keys: RE type -> [n] per-sample entity key array; vocabs are built
+    from the observed keys unless provided (warm-start scoring needs the
+    training vocab, reference GameEstimator.getInitialModel).
+    """
+    labels = np.asarray(labels, dtype=dtype)
+    n = len(labels)
+    offsets = np.zeros(n, dtype) if offsets is None else np.asarray(offsets, dtype)
+    weights = np.ones(n, dtype) if weights is None else np.asarray(weights, dtype)
+    unique_ids = np.arange(n, dtype=np.int64) if unique_ids is None else np.asarray(unique_ids)
+
+    entity_keys = entity_keys or {}
+    vocabs: dict[str, np.ndarray] = {}
+    entity_idx: dict[str, Array] = {}
+    for re_type, keys in entity_keys.items():
+        keys = np.asarray(keys)
+        if entity_vocabs is not None and re_type in entity_vocabs:
+            vocab = np.asarray(entity_vocabs[re_type])
+        else:
+            vocab = np.unique(keys)
+        lookup = {k: i for i, k in enumerate(vocab.tolist())}
+        idx = np.array([lookup.get(k, -1) for k in keys.tolist()], dtype=np.int32)
+        vocabs[re_type] = vocab
+        entity_idx[re_type] = jnp.asarray(idx)
+
+    return GameDataset(
+        unique_ids=unique_ids,
+        labels=jnp.asarray(labels),
+        offsets=jnp.asarray(offsets),
+        weights=jnp.asarray(weights),
+        feature_shards={k: jnp.asarray(np.asarray(v, dtype=dtype)) for k, v in feature_shards.items()},
+        entity_idx=entity_idx,
+        entity_vocabs=vocabs,
+        ids=dict(ids or {}),
+    )

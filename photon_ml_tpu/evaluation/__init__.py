@@ -1,0 +1,8 @@
+from photon_ml_tpu.evaluation.evaluators import (  # noqa: F401
+    EvaluationData,
+    Evaluator,
+    MultiEvaluator,
+    default_evaluator_for_task,
+    parse_evaluator,
+)
+from photon_ml_tpu.evaluation import local_metrics  # noqa: F401

@@ -1,0 +1,133 @@
+"""Vectorized metric primitives (weighted, tie-aware), host-side float64.
+
+Reference parity: photon-api evaluation/*.scala — AUC/AUPR via Spark MLLIB
+BinaryClassificationMetrics, RMSE, per-task losses, and the local evaluators
+used per query (AreaUnderROCCurveLocalEvaluator.scala,
+PrecisionAtKLocalEvaluator.scala).
+
+Evaluation runs once per coordinate update, not in the jitted hot loop, so
+these are numpy (float64, exact tie handling via sort + run-boundary
+arithmetic — the vectorized replacement of groupByKey + local computation).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _as1d(a) -> np.ndarray:
+    return np.asarray(a, dtype=np.float64).reshape(-1)
+
+
+def area_under_roc_curve(scores, labels, weights=None) -> float:
+    """Weighted AUC with average-rank tie handling (Mann-Whitney form).
+
+    AUC = [ Σ_{i∈pos} w_i (W⁻_{<s_i} + ½ W⁻_{=s_i}) ] / (W⁺ W⁻)
+    """
+    s, y = _as1d(scores), _as1d(labels)
+    w = np.ones_like(s) if weights is None else _as1d(weights)
+    pos = y > 0.5
+    w_pos = np.where(pos, w, 0.0)
+    w_neg = np.where(~pos, w, 0.0)
+    wp, wn = w_pos.sum(), w_neg.sum()
+    if wp == 0.0 or wn == 0.0:
+        return float("nan")
+    order = np.argsort(s, kind="stable")
+    s_sorted = s[order]
+    wneg_sorted = w_neg[order]
+    cum_neg = np.concatenate([[0.0], np.cumsum(wneg_sorted)])
+    left = np.searchsorted(s_sorted, s_sorted, side="left")
+    right = np.searchsorted(s_sorted, s_sorted, side="right")
+    neg_less = cum_neg[left]
+    neg_eq = cum_neg[right] - cum_neg[left]
+    contrib = w_pos[order] * (neg_less + 0.5 * neg_eq)
+    return float(contrib.sum() / (wp * wn))
+
+
+def area_under_precision_recall_curve(scores, labels, weights=None) -> float:
+    """Weighted AUPR via trapezoidal area on the PR curve evaluated at
+    distinct-score thresholds (matches MLLIB's areaUnderPR construction,
+    including the (0, p_first) starting point)."""
+    s, y = _as1d(scores), _as1d(labels)
+    w = np.ones_like(s) if weights is None else _as1d(weights)
+    order = np.argsort(-s, kind="stable")
+    s_desc = s[order]
+    tp_w = np.where(y[order] > 0.5, w[order], 0.0)
+    all_w = w[order]
+    total_pos = tp_w.sum()
+    if total_pos == 0.0:
+        return float("nan")
+    cum_tp = np.cumsum(tp_w)
+    cum_all = np.cumsum(all_w)
+    # threshold boundaries: last index of each tie-run of equal scores
+    is_run_end = np.concatenate([s_desc[1:] != s_desc[:-1], [True]])
+    tp_k = cum_tp[is_run_end]
+    all_k = cum_all[is_run_end]
+    precision = np.divide(tp_k, all_k, out=np.zeros_like(tp_k), where=all_k > 0)
+    recall = tp_k / total_pos
+    r = np.concatenate([[0.0], recall])
+    p = np.concatenate([[precision[0] if len(precision) else 1.0], precision])
+    return float(np.sum((r[1:] - r[:-1]) * 0.5 * (p[1:] + p[:-1])))
+
+
+def root_mean_squared_error(scores, labels, weights=None) -> float:
+    s, y = _as1d(scores), _as1d(labels)
+    w = np.ones_like(s) if weights is None else _as1d(weights)
+    wsum = w.sum()
+    if wsum == 0.0:
+        return float("nan")
+    return float(np.sqrt(np.sum(w * (s - y) ** 2) / wsum))
+
+
+def mean_absolute_error(scores, labels, weights=None) -> float:
+    s, y = _as1d(scores), _as1d(labels)
+    w = np.ones_like(s) if weights is None else _as1d(weights)
+    wsum = w.sum()
+    if wsum == 0.0:
+        return float("nan")
+    return float(np.sum(w * np.abs(s - y)) / wsum)
+
+
+def logistic_loss(scores, labels, weights=None) -> float:
+    """Mean weighted logistic loss of margins (reference LogisticLossEvaluator)."""
+    s, y = _as1d(scores), _as1d(labels)
+    w = np.ones_like(s) if weights is None else _as1d(weights)
+    wsum = w.sum()
+    # stable softplus
+    loss = np.logaddexp(0.0, s) - y * s
+    return float(np.sum(w * loss) / wsum) if wsum else float("nan")
+
+
+def squared_loss(scores, labels, weights=None) -> float:
+    s, y = _as1d(scores), _as1d(labels)
+    w = np.ones_like(s) if weights is None else _as1d(weights)
+    wsum = w.sum()
+    return float(np.sum(w * 0.5 * (s - y) ** 2) / wsum) if wsum else float("nan")
+
+
+def poisson_loss(scores, labels, weights=None) -> float:
+    s, y = _as1d(scores), _as1d(labels)
+    w = np.ones_like(s) if weights is None else _as1d(weights)
+    wsum = w.sum()
+    loss = np.exp(s) - y * s
+    return float(np.sum(w * loss) / wsum) if wsum else float("nan")
+
+
+def smoothed_hinge_loss(scores, labels, weights=None) -> float:
+    s, y = _as1d(scores), _as1d(labels)
+    w = np.ones_like(s) if weights is None else _as1d(weights)
+    wsum = w.sum()
+    t = (2.0 * y - 1.0) * s
+    loss = np.where(t <= 0.0, 0.5 - t, np.where(t < 1.0, 0.5 * (1.0 - t) ** 2, 0.0))
+    return float(np.sum(w * loss) / wsum) if wsum else float("nan")
+
+
+def precision_at_k(k: int, scores, labels, weights=None) -> float:
+    """Fraction of positives among the top-k scored items
+    (reference PrecisionAtKLocalEvaluator.scala; per-query use)."""
+    s, y = _as1d(scores), _as1d(labels)
+    order = np.argsort(-s, kind="stable")
+    top = order[: min(k, len(order))]
+    if len(top) == 0:
+        return float("nan")
+    return float((y[top] > 0.5).mean())

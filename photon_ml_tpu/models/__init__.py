@@ -1,0 +1,9 @@
+from photon_ml_tpu.models.coefficients import Coefficients  # noqa: F401
+from photon_ml_tpu.models.glm import GeneralizedLinearModel  # noqa: F401
+from photon_ml_tpu.models.game import (  # noqa: F401
+    DatumScoringModel,
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+    score_random_effect,
+)

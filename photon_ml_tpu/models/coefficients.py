@@ -1,0 +1,32 @@
+"""Model coefficients: means + optional variances.
+
+Reference parity: photon-lib model/Coefficients.scala — a coefficient vector
+with optional per-coefficient variances (from the inverse Hessian diagonal),
+persisted as BayesianLinearModelAvro.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@flax.struct.dataclass
+class Coefficients:
+    means: Array
+    variances: Array | None = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def compute_score(self, features: Array) -> Array:
+        """Dot product score (reference Coefficients.computeScore)."""
+        return features @ self.means
+
+    @classmethod
+    def zeros(cls, dim: int, dtype=jnp.float32) -> "Coefficients":
+        return cls(means=jnp.zeros((dim,), dtype=dtype))

@@ -1,0 +1,134 @@
+"""GAME models: fixed-effect, random-effect, and the composite GameModel.
+
+Reference parity: photon-api model/FixedEffectModel.scala (broadcast GLM +
+feature shard id), model/RandomEffectModel.scala (RDD[(REId, GLM)] + RE type
++ shard id, scoring by join), photon-lib model/GameModel.scala (map
+CoordinateId -> DatumScoringModel, score = Σ sub-scores, GameModel.scala:101-107;
+type consistency check :163-169).
+
+TPU-native: a random-effect model is one dense [num_entities, dim] matrix —
+the per-entity GLMs of the reference collapsed into an embedding-style table.
+Scoring is a gather + row-wise dot (one fused XLA op), replacing the
+datum-by-REId RDD join. Entities unseen at training time score 0, matching
+the reference's behavior for missing REIds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+class DatumScoringModel:
+    """Anything that can score a GameDataset (reference DatumScoringModel)."""
+
+    task: TaskType
+
+    def score_dataset(self, dataset) -> Array:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel(DatumScoringModel):
+    """A single GLM applied to one feature shard (reference FixedEffectModel.scala)."""
+
+    glm: GeneralizedLinearModel
+    feature_shard_id: str
+
+    @property
+    def task(self) -> TaskType:
+        return self.glm.task
+
+    def score_dataset(self, dataset) -> Array:
+        features = dataset.shard_features(self.feature_shard_id)
+        return features @ self.glm.coefficients.means
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel(DatumScoringModel):
+    """Per-entity coefficient table for one random-effect type.
+
+    coefficients: [num_entities, dim]; entity i's GLM lives in row i.
+    variances: optional [num_entities, dim].
+    entity_keys: host-side vocab, position == row index.
+    """
+
+    coefficients: Array
+    entity_keys: np.ndarray  # [num_entities] of str/int keys
+    random_effect_type: str
+    feature_shard_id: str
+    task: TaskType
+    variances: Array | None = None
+
+    @property
+    def num_entities(self) -> int:
+        return self.coefficients.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.coefficients.shape[1]
+
+    def score_dataset(self, dataset) -> Array:
+        features = dataset.shard_features(self.feature_shard_id)
+        entity_idx = dataset.entity_indices(self.random_effect_type)
+        return score_random_effect(self.coefficients, features, entity_idx)
+
+    def with_coefficients(self, coefficients: Array) -> "RandomEffectModel":
+        return dataclasses.replace(self, coefficients=coefficients)
+
+
+def score_random_effect(table: Array, features: Array, entity_idx: Array) -> Array:
+    """scores_i = x_i . table[entity_idx_i], 0 for unseen entities (idx < 0).
+
+    The gather + einsum that replaces RandomEffectModel.scala's scoring join.
+    """
+    safe_idx = jnp.maximum(entity_idx, 0)
+    rows = table[safe_idx]
+    scores = jnp.einsum("nd,nd->n", features, rows)
+    return jnp.where(entity_idx >= 0, scores, 0.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class GameModel:
+    """Ordered map coordinate-id -> sub-model; score = sum of sub-scores."""
+
+    models: Mapping[str, DatumScoringModel]
+
+    def __post_init__(self):
+        # Reference GameModel.scala:163-169 type-consistency check.
+        tasks = {m.task for m in self.models.values() if m.task != TaskType.NONE}
+        if len(tasks) > 1:
+            raise ValueError(f"Inconsistent task types across coordinates: {tasks}")
+
+    @property
+    def task(self) -> TaskType:
+        for m in self.models.values():
+            if m.task != TaskType.NONE:
+                return m.task
+        return TaskType.NONE
+
+    def get(self, coordinate_id: str) -> DatumScoringModel:
+        return self.models[coordinate_id]
+
+    def score_dataset(self, dataset) -> Array:
+        total = None
+        for model in self.models.values():
+            s = model.score_dataset(dataset)
+            total = s if total is None else total + s
+        if total is None:
+            raise ValueError("GameModel has no sub-models")
+        return total
+
+    def updated(self, coordinate_id: str, model: DatumScoringModel) -> "GameModel":
+        new = dict(self.models)
+        new[coordinate_id] = model
+        return GameModel(models=new)

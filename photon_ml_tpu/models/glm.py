@@ -1,0 +1,69 @@
+"""Generalized linear models: coefficients + link functions per task.
+
+Reference parity: photon-api supervised/model/GeneralizedLinearModel.scala and
+subclasses (LogisticRegressionModel, LinearRegressionModel,
+PoissonRegressionModel, SmoothedHingeLossLinearSVMModel) with
+predictWithOffset and the BinaryClassifier / Regression interfaces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """A trained GLM for one task type.
+
+    ``score`` is the raw margin x.w (+ offset); ``predict`` applies the mean
+    (inverse-link) function of the task.
+    """
+
+    coefficients: Coefficients
+    task: TaskType
+
+    @property
+    def dim(self) -> int:
+        return self.coefficients.dim
+
+    def score(self, features: Array, offsets: Array | None = None) -> Array:
+        margins = self.coefficients.compute_score(features)
+        if offsets is not None:
+            margins = margins + offsets
+        return margins
+
+    def predict(self, features: Array, offsets: Array | None = None) -> Array:
+        margins = self.score(features, offsets)
+        return self.mean(margins)
+
+    def mean(self, margins: Array) -> Array:
+        t = self.task
+        if t == TaskType.LOGISTIC_REGRESSION:
+            return jax.nn.sigmoid(margins)
+        if t == TaskType.LINEAR_REGRESSION:
+            return margins
+        if t == TaskType.POISSON_REGRESSION:
+            return jnp.exp(margins)
+        if t == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+            # margin sign is the classification; expose the margin itself
+            return margins
+        raise ValueError(f"No mean function for task {t}")
+
+    def classify(self, features: Array, offsets: Array | None = None, threshold: float = 0.5) -> Array:
+        """Binary classification (reference BinaryClassifier.predictClassWithOffset)."""
+        if not self.task.is_classification:
+            raise ValueError(f"{self.task} is not a classification task")
+        if self.task == TaskType.LOGISTIC_REGRESSION:
+            return (self.predict(features, offsets) >= threshold).astype(jnp.int32)
+        return (self.score(features, offsets) >= 0.0).astype(jnp.int32)
+
+    def with_coefficients(self, coefficients: Coefficients) -> "GeneralizedLinearModel":
+        return dataclasses.replace(self, coefficients=coefficients)

@@ -1,0 +1,14 @@
+from photon_ml_tpu.ops.losses import (  # noqa: F401
+    LogisticLoss,
+    PointwiseLoss,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    SquaredLoss,
+    loss_for_task,
+)
+from photon_ml_tpu.ops.normalization import (  # noqa: F401
+    NormalizationContext,
+    NormalizationType,
+    no_normalization,
+)
+from photon_ml_tpu.ops.objective import GLMObjective  # noqa: F401

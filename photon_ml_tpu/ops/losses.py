@@ -1,0 +1,134 @@
+"""Pointwise GLM losses: scalar functions of (margin, label).
+
+Every GLM loss in this framework is a function of the per-sample margin
+z = w.x (+ offset) and the label. The objective layer only needs:
+
+  - ``loss_and_dz(margin, label)``  -> (l, dl/dz)
+  - ``d2z(margin, label)``          -> d2l/dz2
+
+Reference parity: photon-lib function/glm/PointwiseLossFunction.scala:36-54
+and the concrete losses in photon-api function/glm/{Logistic,Squared,Poisson}LossFunction.scala
+and function/svm/SmoothedHingeLossFunction.scala:33-83.
+
+All functions are elementwise, jit/vmap-safe, and numerically stable in
+float32 (TPU native dtype); no python control flow on traced values.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+class PointwiseLoss:
+    """Interface for pointwise losses. Subclasses are stateless singletons."""
+
+    #: whether d2z is meaningful (TwiceDiffFunction in the reference)
+    twice_differentiable: bool = True
+
+    def loss_and_dz(self, margin: Array, label: Array) -> tuple[Array, Array]:
+        raise NotImplementedError
+
+    def d2z(self, margin: Array, label: Array) -> Array:
+        raise NotImplementedError
+
+    def loss(self, margin: Array, label: Array) -> Array:
+        return self.loss_and_dz(margin, label)[0]
+
+
+class LogisticLoss(PointwiseLoss):
+    """Negative log-likelihood of the logistic model, labels in {0, 1}.
+
+    l(z, y) = softplus(z) - y*z  (stable for all z)
+    dl/dz   = sigmoid(z) - y
+    d2l/dz2 = sigmoid(z) * (1 - sigmoid(z))
+
+    Reference: photon-api function/glm/LogisticLossFunction.scala:45+.
+    """
+
+    def loss_and_dz(self, margin: Array, label: Array) -> tuple[Array, Array]:
+        loss = jax.nn.softplus(margin) - label * margin
+        dz = jax.nn.sigmoid(margin) - label
+        return loss, dz
+
+    def d2z(self, margin: Array, label: Array) -> Array:
+        s = jax.nn.sigmoid(margin)
+        return s * (1.0 - s)
+
+
+class SquaredLoss(PointwiseLoss):
+    """Squared loss for linear regression: l = (z - y)^2 / 2.
+
+    Reference: photon-api function/glm/SquaredLossFunction.scala.
+    """
+
+    def loss_and_dz(self, margin: Array, label: Array) -> tuple[Array, Array]:
+        diff = margin - label
+        return 0.5 * diff * diff, diff
+
+    def d2z(self, margin: Array, label: Array) -> Array:
+        return jnp.ones_like(margin)
+
+
+class PoissonLoss(PointwiseLoss):
+    """Poisson regression negative log-likelihood: l = exp(z) - y*z.
+
+    Reference: photon-api function/glm/PoissonLossFunction.scala.
+    """
+
+    def loss_and_dz(self, margin: Array, label: Array) -> tuple[Array, Array]:
+        ez = jnp.exp(margin)
+        return ez - label * margin, ez - label
+
+    def d2z(self, margin: Array, label: Array) -> Array:
+        return jnp.exp(margin)
+
+
+class SmoothedHingeLoss(PointwiseLoss):
+    """Rennie's smoothed hinge loss for linear SVM, labels in {0, 1}.
+
+    With t = (2y - 1) * z:
+        l = 1/2 - t        if t <= 0
+        l = (1 - t)^2 / 2  if 0 < t < 1
+        l = 0              if t >= 1
+
+    Only first-order in the reference (DiffFunction — LBFGS family only,
+    photon-api function/svm/SmoothedHingeLossFunction.scala:33-83); we expose
+    the piecewise-constant second derivative for completeness but mark the
+    loss as not twice differentiable so TRON refuses it, matching reference
+    behavior.
+    """
+
+    twice_differentiable = False
+
+    def loss_and_dz(self, margin: Array, label: Array) -> tuple[Array, Array]:
+        y = 2.0 * label - 1.0
+        t = y * margin
+        loss = jnp.where(t <= 0.0, 0.5 - t, jnp.where(t < 1.0, 0.5 * (1.0 - t) ** 2, 0.0))
+        dt = jnp.where(t <= 0.0, -1.0, jnp.where(t < 1.0, t - 1.0, 0.0))
+        return loss, y * dt
+
+    def d2z(self, margin: Array, label: Array) -> Array:
+        y = 2.0 * label - 1.0
+        t = y * margin
+        return jnp.where((t > 0.0) & (t < 1.0), 1.0, 0.0)
+
+
+_LOSS_BY_TASK: dict[TaskType, PointwiseLoss] = {
+    TaskType.LINEAR_REGRESSION: SquaredLoss(),
+    TaskType.LOGISTIC_REGRESSION: LogisticLoss(),
+    TaskType.POISSON_REGRESSION: PoissonLoss(),
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: SmoothedHingeLoss(),
+}
+
+
+def loss_for_task(task: TaskType) -> PointwiseLoss:
+    """Map a task type to its pointwise loss (reference GLMLossFunction factory)."""
+    try:
+        return _LOSS_BY_TASK[task]
+    except KeyError:
+        raise ValueError(f"No loss defined for task {task}") from None

@@ -1,0 +1,168 @@
+"""Feature normalization folded into the objective algebra.
+
+The reference never rewrites the data: normalized margins are computed as
+
+    x' = (x - shift) * factor
+    margin = w . x' = (w * factor) . x  -  (w * factor) . shift
+
+so the data stays raw/sparse and normalization is two elementwise ops on the
+coefficient vector (reference: photon-lib
+function/glm/ValueAndGradientAggregator.scala:36-49 — effectiveCoefficients +
+marginShift — and normalization/NormalizationContext.scala).
+
+On TPU this matters for the same reason: the feature matrix is the big
+operand living in HBM; transforming coefficients instead of data keeps the
+hot matmul untouched and lets XLA fuse the elementwise ops into it.
+
+The intercept coordinate is exempt from shift/factor (factor=1, shift=0), so
+that standardization does not destroy the intercept semantics
+(reference NormalizationContext builder).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class NormalizationType(enum.Enum):
+    """Reference: photon-lib normalization/NormalizationType.scala:26-41."""
+
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"
+
+
+@flax.struct.dataclass
+class NormalizationContext:
+    """Per-feature-shard normalization factors and shifts.
+
+    ``factors`` / ``shifts`` are [dim] arrays or None (identity). A pytree, so
+    it can be closed over or passed through jit boundaries freely.
+    """
+
+    factors: Array | None = None
+    shifts: Array | None = None
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    def effective_coefficients(self, coefficients: Array) -> Array:
+        if self.factors is None:
+            return coefficients
+        return coefficients * self.factors
+
+    def margin_shift(self, effective_coefficients: Array) -> Array:
+        """The constant subtracted from every margin: (w*factor) . shift."""
+        if self.shifts is None:
+            return jnp.zeros((), dtype=effective_coefficients.dtype)
+        return jnp.dot(effective_coefficients, self.shifts)
+
+    def to_model_space(self, coefficients: Array, intercept_index: int | None = None) -> Array:
+        """Map coefficients trained in normalized space to original space.
+
+        Training minimizes L(w') over x' = (x - shift)*factor, i.e. margins
+        are X @ (w'*factor) - (w'*factor).shift. The equivalent original-space
+        model is w = w'*factor with the constant -(w'*factor).shift absorbed
+        into the intercept (whose factor is 1 and shift is 0). Models are
+        always persisted/scored in original space, so scoring needs no
+        normalization context (reference NormalizationContext
+        modelToOriginalSpace). Batched over leading axes.
+        """
+        if self.is_identity:
+            return coefficients
+        eff = coefficients * self.factors if self.factors is not None else coefficients
+        if self.shifts is not None:
+            if intercept_index is None:
+                raise ValueError(
+                    "Normalization with shifts (STANDARDIZATION) requires an "
+                    "intercept column to absorb the margin shift"
+                )
+            shift_total = eff @ self.shifts
+            eff = eff.at[..., intercept_index].add(-shift_total)
+        return eff
+
+    def from_model_space(self, coefficients: Array, intercept_index: int | None = None) -> Array:
+        """Inverse of ``to_model_space`` — used to warm-start a solver in
+        normalized space from a persisted original-space model."""
+        if self.is_identity:
+            return coefficients
+        w = coefficients
+        if self.shifts is not None:
+            if intercept_index is None:
+                raise ValueError(
+                    "Normalization with shifts (STANDARDIZATION) requires an "
+                    "intercept column to absorb the margin shift"
+                )
+            # eff_j = w_j for j != intercept (since shift_int = 0), so the
+            # intercept recovers as w_int + sum_j w_j * shift_j.
+            shift_total = w @ self.shifts
+            w = w.at[..., intercept_index].add(shift_total)
+        if self.factors is not None:
+            w = w / self.factors
+        return w
+
+    def variances_to_model_space(self, variances: Array) -> Array:
+        """Diagonal-approximation variance scaling: var(w_i) = var(w'_i)·f_i²
+        (ignores intercept covariance terms)."""
+        if self.factors is None:
+            return variances
+        return variances * self.factors * self.factors
+
+
+_NO_NORMALIZATION = NormalizationContext(factors=None, shifts=None)
+
+
+def no_normalization() -> NormalizationContext:
+    """Identity context (singleton, so identity-keyed jit caches stay warm)."""
+    return _NO_NORMALIZATION
+
+
+def build_normalization(
+    norm_type: NormalizationType,
+    *,
+    mean: Array,
+    variance: Array,
+    max_magnitude: Array,
+    intercept_index: int | None = None,
+) -> NormalizationContext:
+    """Build a NormalizationContext from feature summary statistics.
+
+    Reference: NormalizationContext.apply over BasicStatisticalSummary, per
+    NormalizationType {SCALE_WITH_STANDARD_DEVIATION, SCALE_WITH_MAX_MAGNITUDE,
+    STANDARDIZATION, NONE}. Zero std / zero magnitude features get factor 1 so
+    constant columns are left alone instead of exploding.
+    """
+    if norm_type == NormalizationType.NONE:
+        return no_normalization()
+
+    std = jnp.sqrt(variance)
+    inv_std = jnp.where(std > 0.0, 1.0 / jnp.maximum(std, 1e-30), 1.0)
+    inv_mag = jnp.where(
+        max_magnitude > 0.0, 1.0 / jnp.maximum(max_magnitude, 1e-30), 1.0
+    )
+
+    factors: Array | None
+    shifts: Array | None
+    if norm_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        factors, shifts = inv_std, None
+    elif norm_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        factors, shifts = inv_mag, None
+    elif norm_type == NormalizationType.STANDARDIZATION:
+        factors, shifts = inv_std, mean
+    else:  # pragma: no cover
+        raise ValueError(f"Unknown normalization type {norm_type}")
+
+    if intercept_index is not None:
+        if factors is not None:
+            factors = factors.at[intercept_index].set(1.0)
+        if shifts is not None:
+            shifts = shifts.at[intercept_index].set(0.0)
+    return NormalizationContext(factors=factors, shifts=shifts)

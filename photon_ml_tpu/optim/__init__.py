@@ -1,0 +1,13 @@
+from photon_ml_tpu.optim.common import (  # noqa: F401
+    ConvergenceReason,
+    SolverResult,
+)
+from photon_ml_tpu.optim.lbfgs import minimize_lbfgs  # noqa: F401
+from photon_ml_tpu.optim.owlqn import minimize_owlqn  # noqa: F401
+from photon_ml_tpu.optim.tron import minimize_tron  # noqa: F401
+from photon_ml_tpu.optim.optimizer import (  # noqa: F401
+    OptimizerConfig,
+    OptimizerType,
+    default_config_for,
+    solve,
+)

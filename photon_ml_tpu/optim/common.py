@@ -1,0 +1,166 @@
+"""Shared optimizer machinery: results, convergence, line search.
+
+Reference parity: photon-lib optimization/Optimizer.scala (template loop,
+convergence by max-iter / loss-delta / gradient-norm, Optimizer.scala:135-149)
+and OptimizationStatesTracker.scala (per-iteration state history).
+
+Everything here is jit- and vmap-safe: fixed shapes, lax control flow, no
+data-dependent python branching. ``vmap(minimize_*)`` over per-entity
+objectives is the TPU replacement for the reference's per-entity RDD solves.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+class ConvergenceReason(enum.IntEnum):
+    """Why an optimizer stopped (reference util/ConvergenceReason.scala)."""
+
+    NOT_CONVERGED = 0
+    MAX_ITERATIONS = 1
+    FUNCTION_VALUES_WITHIN_TOLERANCE = 2
+    GRADIENT_WITHIN_TOLERANCE = 3
+    LINE_SEARCH_FAILED = 4
+
+
+@flax.struct.dataclass
+class SolverResult:
+    """Final state + per-iteration history of one solve.
+
+    ``value_history`` / ``grad_norm_history`` are fixed-size [max_iter + 1]
+    arrays padded with NaN past ``iterations`` — the jittable analogue of
+    OptimizationStatesTracker's bounded state queue.
+    """
+
+    coefficients: Array
+    value: Array
+    gradient_norm: Array
+    iterations: Array  # int32 scalar
+    reason: Array  # int32 scalar, ConvergenceReason code
+    value_history: Array
+    grad_norm_history: Array
+
+    @property
+    def converged(self) -> Array:
+        return self.reason != ConvergenceReason.NOT_CONVERGED
+
+
+def check_convergence(
+    *,
+    value: Array,
+    prev_value: Array,
+    grad_norm: Array,
+    initial_grad_norm: Array,
+    tolerance: float,
+) -> Array:
+    """Return a ConvergenceReason code (0 if not converged).
+
+    Matches the reference's dual test (Optimizer.scala:135-149): relative
+    change in objective value below tolerance, or gradient norm below
+    tolerance relative to the initial gradient norm.
+    """
+    rel_delta = jnp.abs(value - prev_value) / jnp.maximum(
+        jnp.maximum(jnp.abs(value), jnp.abs(prev_value)), 1.0
+    )
+    func_ok = rel_delta <= tolerance
+    grad_ok = grad_norm <= tolerance * jnp.maximum(initial_grad_norm, 1.0)
+    return jnp.where(
+        grad_ok,
+        jnp.int32(ConvergenceReason.GRADIENT_WITHIN_TOLERANCE),
+        jnp.where(
+            func_ok,
+            jnp.int32(ConvergenceReason.FUNCTION_VALUES_WITHIN_TOLERANCE),
+            jnp.int32(ConvergenceReason.NOT_CONVERGED),
+        ),
+    )
+
+
+@flax.struct.dataclass
+class LineSearchResult:
+    step: Array
+    value: Array
+    gradient: Array
+    success: Array  # bool
+
+
+def wolfe_line_search(
+    value_and_grad_fn,
+    w: Array,
+    f0: Array,
+    g0: Array,
+    direction: Array,
+    t_init: Array,
+    c1: float = 1e-4,
+    c2: float = 0.9,
+    max_steps: int = 25,
+) -> LineSearchResult:
+    """Weak-Wolfe bisection line search, fully jittable.
+
+    Bracketing bisection: shrink on Armijo failure, expand (or bisect within
+    the bracket) on curvature failure. Each trial costs one value_and_grad —
+    cheap once jitted, since the whole optimizer step lives in one XLA
+    program (SURVEY.md §7 "keep the whole optimizer step inside one jit").
+
+    Replaces breeze's StrongWolfeLineSearch used by the reference's LBFGS
+    (optimization/LBFGS.scala:97-107).
+    """
+    dg0 = jnp.vdot(g0, direction)
+
+    def body(state):
+        i, t, lo, hi, t_best, f_best, g_best, has_best, _done = state
+        f_t, g_t = value_and_grad_fn(w + t * direction)
+        bad = jnp.isnan(f_t) | jnp.isinf(f_t)
+        armijo = (f_t <= f0 + c1 * t * dg0) & ~bad
+        curv = jnp.vdot(g_t, direction) >= c2 * dg0
+        done = armijo & curv
+        # Remember the best Armijo-satisfying point seen so far: if curvature
+        # never holds within max_steps, we still return a genuine decrease
+        # step instead of reporting a spurious line-search failure.
+        better = armijo & (~has_best | (f_t < f_best))
+        t_best = jnp.where(better, t, t_best)
+        f_best = jnp.where(better, f_t, f_best)
+        g_best = jax.tree.map(lambda a, b: jnp.where(better, a, b), g_t, g_best)
+        has_best = has_best | armijo
+        # Armijo failed -> step too long: shrink bracket from above.
+        new_hi = jnp.where(~armijo, t, hi)
+        # Armijo ok but curvature failed -> step too short: raise lower edge.
+        new_lo = jnp.where(armijo & ~curv, t, lo)
+        new_t = jnp.where(
+            ~armijo,
+            0.5 * (new_lo + new_hi),
+            jnp.where(
+                ~curv,
+                jnp.where(jnp.isinf(new_hi), 2.0 * t, 0.5 * (new_lo + new_hi)),
+                t,
+            ),
+        )
+        return (i + 1, new_t, new_lo, new_hi, t_best, f_best, g_best, has_best, done)
+
+    def cond(state):
+        i, *_rest, done = state
+        return (i < max_steps) & ~done
+
+    inf = jnp.asarray(jnp.inf, dtype=f0.dtype)
+    zero = jnp.zeros((), dtype=f0.dtype)
+    init = (
+        jnp.int32(0),
+        t_init.astype(f0.dtype),
+        zero,
+        inf,
+        zero,
+        f0,
+        g0,
+        jnp.asarray(False),
+        jnp.asarray(False),
+    )
+    _, _, _, _, t_best, f_best, g_best, has_best, _done = lax.while_loop(cond, body, init)
+    success = has_best & (f_best < f0)
+    return LineSearchResult(step=t_best, value=f_best, gradient=g_best, success=success)

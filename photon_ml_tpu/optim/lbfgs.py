@@ -1,0 +1,306 @@
+"""Pure-JAX L-BFGS with weak-Wolfe line search and optional box projection.
+
+Reference parity: photon-lib optimization/LBFGS.scala (breeze LBFGS wrapper,
+defaults maxIter=100, m=10, tol=1e-7, LBFGS.scala:152-157; box-constraint
+projection after each step, LBFGS.scala:70-76).
+
+TPU-native design: the whole solve — two-loop recursion, line search,
+convergence tests — is one ``lax.while_loop`` inside one XLA program. State
+is a pytree with fixed shapes (circular [m, d] history buffers), so the
+solver jits once, reuses the compiled program across coordinate-descent
+iterations and λ-grid points, and vmaps over entities for random-effect
+coordinates (replacing RandomEffectCoordinate.scala:104-153's per-entity
+breeze solves).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.optim.common import (
+    ConvergenceReason,
+    SolverResult,
+    check_convergence,
+    wolfe_line_search,
+)
+
+Array = jax.Array
+
+DEFAULT_MAX_ITER = 100
+DEFAULT_HISTORY = 10
+DEFAULT_TOLERANCE = 1e-7
+
+
+def two_loop_direction(
+    g: Array, s_hist: Array, y_hist: Array, rho: Array, count: Array, head: Array
+) -> Array:
+    """L-BFGS two-loop recursion over a circular history buffer.
+
+    s_hist/y_hist: [m, d]; rho: [m] (1/sᵀy); count: number of valid pairs;
+    head: slot of the most recent pair. Invalid slots are masked by zeroing
+    their alpha/beta contributions, keeping shapes static for jit.
+    """
+    m = s_hist.shape[0]
+
+    def backward(i, carry):
+        q, alphas = carry
+        idx = (head - i) % m
+        valid = i < count
+        alpha = jnp.where(valid, rho[idx] * jnp.vdot(s_hist[idx], q), 0.0)
+        q = q - alpha * y_hist[idx]
+        return q, alphas.at[idx].set(alpha)
+
+    q, alphas = lax.fori_loop(0, m, backward, (g, jnp.zeros((m,), dtype=g.dtype)))
+
+    gamma = jnp.where(
+        count > 0,
+        jnp.vdot(s_hist[head], y_hist[head])
+        / jnp.maximum(jnp.vdot(y_hist[head], y_hist[head]), 1e-30),
+        1.0,
+    )
+    r = gamma * q
+
+    def forward(i, r):
+        # oldest-to-newest among valid entries
+        idx = (head - (count - 1 - i)) % m
+        valid = i < count
+        beta = rho[idx] * jnp.vdot(y_hist[idx], r)
+        return r + jnp.where(valid, (alphas[idx] - beta), 0.0) * s_hist[idx]
+
+    r = lax.fori_loop(0, m, forward, r)
+    return -r
+
+
+@flax.struct.dataclass
+class _LBFGSState:
+    w: Array
+    f: Array
+    g: Array
+    s_hist: Array
+    y_hist: Array
+    rho: Array
+    count: Array
+    head: Array
+    iteration: Array
+    reason: Array
+    prev_f: Array
+    g0_norm: Array
+    value_history: Array
+    grad_norm_history: Array
+
+
+def minimize_lbfgs(
+    value_and_grad_fn: Callable[[Array], tuple[Array, Array]],
+    w0: Array,
+    *,
+    max_iter: int = DEFAULT_MAX_ITER,
+    history: int = DEFAULT_HISTORY,
+    tolerance: float = DEFAULT_TOLERANCE,
+    lower_bounds: Array | None = None,
+    upper_bounds: Array | None = None,
+    max_line_search_steps: int = 25,
+) -> SolverResult:
+    """Minimize a smooth function with L-BFGS. Jit- and vmap-safe.
+
+    With ``lower_bounds``/``upper_bounds`` set, iterates are projected onto
+    the box after every accepted step and convergence is tested on the
+    projected gradient — the gradient-projection scheme the reference applies
+    (LBFGS.scala:70-76); the dedicated LBFGSB entry point builds on this.
+    """
+    dtype = w0.dtype
+    d = w0.shape[0]
+    m = history
+
+    has_box = lower_bounds is not None or upper_bounds is not None
+    lo = jnp.full((d,), -jnp.inf, dtype) if lower_bounds is None else jnp.asarray(lower_bounds, dtype)
+    hi = jnp.full((d,), jnp.inf, dtype) if upper_bounds is None else jnp.asarray(upper_bounds, dtype)
+
+    def project(w):
+        return jnp.clip(w, lo, hi) if has_box else w
+
+    def projected_grad_norm(w, g):
+        if not has_box:
+            return jnp.linalg.norm(g)
+        # norm of P(w - g) - w: zero iff w is box-stationary
+        return jnp.linalg.norm(project(w - g) - w)
+
+    w0 = project(jnp.asarray(w0, dtype))
+    f0, g0 = value_and_grad_fn(w0)
+    g0_norm = projected_grad_norm(w0, g0)
+
+    nan_hist = jnp.full((max_iter + 1,), jnp.nan, dtype)
+    init = _LBFGSState(
+        w=w0,
+        f=f0,
+        g=g0,
+        s_hist=jnp.zeros((m, d), dtype),
+        y_hist=jnp.zeros((m, d), dtype),
+        rho=jnp.zeros((m,), dtype),
+        count=jnp.int32(0),
+        head=jnp.int32(0),
+        iteration=jnp.int32(0),
+        reason=jnp.int32(ConvergenceReason.NOT_CONVERGED),
+        prev_f=jnp.asarray(jnp.inf, dtype),
+        g0_norm=g0_norm,
+        value_history=nan_hist.at[0].set(f0),
+        grad_norm_history=nan_hist.at[0].set(g0_norm),
+    )
+
+    # Already stationary at the initial point?
+    init = init.replace(
+        reason=jnp.where(
+            g0_norm <= tolerance,
+            jnp.int32(ConvergenceReason.GRADIENT_WITHIN_TOLERANCE),
+            init.reason,
+        )
+    )
+
+    def cond(state: _LBFGSState):
+        return (state.iteration < max_iter) & (
+            state.reason == ConvergenceReason.NOT_CONVERGED
+        )
+
+    def body(state: _LBFGSState):
+        direction = two_loop_direction(
+            state.g, state.s_hist, state.y_hist, state.rho, state.count, state.head
+        )
+        if has_box:
+            # Active-set masking: don't push into an active bound
+            # (projected L-BFGS; reference projects per step, LBFGS.scala:70-76).
+            eps_b = 1e-10
+            active = ((state.w <= lo + eps_b) & (direction < 0.0)) | (
+                (state.w >= hi - eps_b) & (direction > 0.0)
+            )
+            direction = jnp.where(active, 0.0, direction)
+            sd = -state.g
+            sd = jnp.where(
+                ((state.w <= lo + eps_b) & (sd < 0.0))
+                | ((state.w >= hi - eps_b) & (sd > 0.0)),
+                0.0,
+                sd,
+            )
+            direction = jnp.where(jnp.vdot(state.g, direction) >= 0.0, sd, direction)
+        else:
+            # Guard: fall back to steepest descent if not a descent direction.
+            direction = jnp.where(jnp.vdot(state.g, direction) >= 0.0, -state.g, direction)
+
+        t_init = jnp.where(
+            state.count == 0,
+            1.0 / jnp.maximum(jnp.linalg.norm(state.g), 1.0),
+            jnp.ones((), dtype),
+        )
+
+        if has_box:
+            # Projected Armijo backtracking: trial points stay feasible, the
+            # sufficient-decrease test uses the actual displacement.
+            c1 = 1e-4
+
+            def ls_body(s):
+                i, t, _w, _f, _g, _ok = s
+                cand = project(state.w + t * direction)
+                f_t, g_t = value_and_grad_fn(cand)
+                decrease = jnp.vdot(state.g, cand - state.w)
+                ok = (
+                    (f_t <= state.f + c1 * decrease)
+                    & ~(jnp.isnan(f_t) | jnp.isinf(f_t))
+                    & (f_t < state.f)
+                )
+                return (i + 1, t * 0.5, cand, f_t, g_t, ok)
+
+            def ls_cond(s):
+                i, _t, _w, _f, _g, ok = s
+                return (i < max_line_search_steps) & ~ok
+
+            _, _, w_new, f_new, g_new, ls_ok = lax.while_loop(
+                ls_cond,
+                ls_body,
+                (jnp.int32(0), t_init, state.w, state.f, state.g, jnp.asarray(False)),
+            )
+            ls_success = ls_ok
+        else:
+            ls = wolfe_line_search(
+                value_and_grad_fn,
+                state.w,
+                state.f,
+                state.g,
+                direction,
+                t_init,
+                max_steps=max_line_search_steps,
+            )
+            w_new = state.w + ls.step * direction
+            f_new, g_new = ls.value, ls.gradient
+            ls_success = ls.success
+
+        s = w_new - state.w
+        y = g_new - state.g
+        sy = jnp.vdot(s, y)
+        keep_pair = ls_success & (sy > 1e-10)
+
+        new_head = jnp.where(keep_pair, (state.head + 1) % m, state.head)
+        # count==0 means head slot 0 is where the first pair goes
+        write_head = jnp.where(state.count == 0, jnp.int32(0), new_head)
+        new_head = jnp.where(state.count == 0, jnp.int32(0), new_head)
+        s_hist = jnp.where(
+            keep_pair, state.s_hist.at[write_head].set(s), state.s_hist
+        )
+        y_hist = jnp.where(
+            keep_pair, state.y_hist.at[write_head].set(y), state.y_hist
+        )
+        rho = jnp.where(
+            keep_pair,
+            state.rho.at[write_head].set(1.0 / jnp.maximum(sy, 1e-30)),
+            state.rho,
+        )
+        count = jnp.where(keep_pair, jnp.minimum(state.count + 1, m), state.count)
+
+        gnorm = projected_grad_norm(w_new, g_new)
+        reason = jnp.where(
+            ls_success,
+            check_convergence(
+                value=f_new,
+                prev_value=state.f,
+                grad_norm=gnorm,
+                initial_grad_norm=state.g0_norm,
+                tolerance=tolerance,
+            ),
+            jnp.int32(ConvergenceReason.LINE_SEARCH_FAILED),
+        )
+
+        it = state.iteration + 1
+        return _LBFGSState(
+            w=jnp.where(ls_success, w_new, state.w),
+            f=jnp.where(ls_success, f_new, state.f),
+            g=jnp.where(ls_success, g_new, state.g),
+            s_hist=s_hist,
+            y_hist=y_hist,
+            rho=rho,
+            count=count,
+            head=new_head,
+            iteration=it,
+            reason=reason,
+            prev_f=state.f,
+            g0_norm=state.g0_norm,
+            value_history=state.value_history.at[it].set(jnp.where(ls_success, f_new, state.f)),
+            grad_norm_history=state.grad_norm_history.at[it].set(gnorm),
+        )
+
+    final = lax.while_loop(cond, body, init)
+    reason = jnp.where(
+        final.reason == ConvergenceReason.NOT_CONVERGED,
+        jnp.int32(ConvergenceReason.MAX_ITERATIONS),
+        final.reason,
+    )
+    return SolverResult(
+        coefficients=final.w,
+        value=final.f,
+        gradient_norm=projected_grad_norm(final.w, final.g),
+        iterations=final.iteration,
+        reason=reason,
+        value_history=final.value_history,
+        grad_norm_history=final.grad_norm_history,
+    )

@@ -1,0 +1,120 @@
+"""Optimizer facade: config objects + dispatch to the jittable solvers.
+
+Reference parity: photon-lib optimization/Optimizer.scala (template method +
+convergence config), OptimizerFactory.scala, and the per-optimizer config in
+OptimizerConfig/GLMOptimizationConfiguration. The reference's optimizer
+objects are stateful; here an Optimizer is a frozen config whose ``solve``
+is a pure function, so one compiled program serves every coordinate-descent
+iteration, λ-grid point, and (vmapped) every random-effect entity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.objective import BoundObjective
+from photon_ml_tpu.optim.common import SolverResult
+from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+from photon_ml_tpu.optim.owlqn import minimize_owlqn
+from photon_ml_tpu.optim.tron import minimize_tron
+
+Array = jax.Array
+
+
+class OptimizerType(enum.Enum):
+    """Reference: photon-lib optimization/OptimizerType.scala."""
+
+    LBFGS = "LBFGS"
+    OWLQN = "OWLQN"
+    LBFGSB = "LBFGSB"
+    TRON = "TRON"
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Static solver configuration (reference OptimizerConfig.scala).
+
+    ``box_constraints``: optional (lower, upper) arrays for LBFGSB / the
+    reference's constraint-map projection (LBFGS.scala:70-76).
+    """
+
+    optimizer_type: OptimizerType = OptimizerType.LBFGS
+    max_iterations: int = 100
+    tolerance: float = 1e-7
+    history: int = 10  # L-BFGS memory m
+    max_cg_iterations: int = 20  # TRON inner loop cap
+    l1_weight: float = 0.0  # OWLQN only; set by the elastic-net path
+
+    def with_l1(self, l1_weight: float) -> "OptimizerConfig":
+        return dataclasses.replace(self, l1_weight=l1_weight)
+
+
+def solve(
+    config: OptimizerConfig,
+    objective: BoundObjective,
+    w0: Array,
+    *,
+    lower_bounds: Array | None = None,
+    upper_bounds: Array | None = None,
+) -> SolverResult:
+    """Run the configured solver on a bound objective. Pure; jit/vmap-safe."""
+    t = config.optimizer_type
+    if t == OptimizerType.LBFGS:
+        return minimize_lbfgs(
+            objective.value_and_grad,
+            w0,
+            max_iter=config.max_iterations,
+            history=config.history,
+            tolerance=config.tolerance,
+        )
+    if t == OptimizerType.LBFGSB:
+        if lower_bounds is None and upper_bounds is None:
+            raise ValueError("LBFGSB requires box constraints")
+        return minimize_lbfgs(
+            objective.value_and_grad,
+            w0,
+            max_iter=config.max_iterations,
+            history=config.history,
+            tolerance=config.tolerance,
+            lower_bounds=lower_bounds,
+            upper_bounds=upper_bounds,
+        )
+    if t == OptimizerType.OWLQN:
+        return minimize_owlqn(
+            objective.value_and_grad,
+            w0,
+            l1_weight=config.l1_weight,
+            max_iter=config.max_iterations,
+            history=config.history,
+            tolerance=config.tolerance,
+        )
+    if t == OptimizerType.TRON:
+        loss = objective.objective.loss
+        if not loss.twice_differentiable:
+            raise ValueError(
+                f"TRON requires a twice-differentiable loss, got {type(loss).__name__}"
+                " (reference restricts smoothed-hinge to the LBFGS family)"
+            )
+        return minimize_tron(
+            objective.value_and_grad,
+            objective.hessian_vector,
+            w0,
+            max_iter=config.max_iterations,
+            tolerance=config.tolerance,
+            max_cg_iter=config.max_cg_iterations,
+        )
+    raise ValueError(f"Unknown optimizer type {t}")
+
+
+def default_config_for(optimizer_type: OptimizerType) -> OptimizerConfig:
+    """Reference defaults: LBFGS maxIter=100 tol=1e-7 (LBFGS.scala:152-157);
+    TRON maxIter=15 tol=1e-5 (TRON.scala:257-262)."""
+    if optimizer_type == OptimizerType.TRON:
+        return OptimizerConfig(
+            optimizer_type=optimizer_type, max_iterations=15, tolerance=1e-5
+        )
+    return OptimizerConfig(optimizer_type=optimizer_type)

@@ -1,0 +1,6 @@
+from photon_ml_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    replicate,
+    shard_batch,
+    shard_game_dataset,
+)

@@ -1,0 +1,50 @@
+"""GameTransformer: the scoring facade.
+
+Reference parity: photon-api transformers/GameTransformer.scala:156-298 —
+build the GAME dataset view, score with a GameModel (sum of sub-model
+scores), optionally run evaluators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.evaluation.evaluators import EvaluationData, parse_evaluator
+from photon_ml_tpu.models.game import GameModel
+
+
+@dataclasses.dataclass
+class ScoredDataset:
+    """Per-sample scores + optional evaluation results
+    (reference ScoredGameDatum / scoring output)."""
+
+    unique_ids: np.ndarray
+    scores: np.ndarray
+    evaluations: dict[str, float]
+
+
+@dataclasses.dataclass
+class GameTransformer:
+    model: GameModel
+    evaluator_specs: Sequence[str] = ()
+
+    def transform(self, dataset: GameDataset) -> ScoredDataset:
+        scores = np.asarray(self.model.score_dataset(dataset)) + np.asarray(dataset.offsets)
+        evaluations: dict[str, float] = {}
+        if self.evaluator_specs:
+            data = EvaluationData(
+                labels=np.asarray(dataset.labels),
+                offsets=np.asarray(dataset.offsets),
+                weights=np.asarray(dataset.weights),
+                ids=dataset.ids,
+            )
+            for spec in self.evaluator_specs:
+                ev = parse_evaluator(spec)
+                evaluations[ev.name] = ev.evaluate(scores, data)
+        return ScoredDataset(
+            unique_ids=dataset.unique_ids, scores=scores, evaluations=evaluations
+        )

@@ -1,0 +1,34 @@
+"""Core type aliases and task types.
+
+Reference parity: photon-lib Types.scala:21-44 and TaskType.scala.
+"""
+
+from __future__ import annotations
+
+import enum
+
+# Type aliases (reference Types.scala). In the TPU build, per-sample unique
+# ids are int64 arrays; coordinate / random-effect / feature-shard ids are
+# python strings (host-side metadata, never traced).
+UniqueSampleId = int
+CoordinateId = str
+REType = str
+REId = str
+FeatureShardId = str
+
+
+class TaskType(enum.Enum):
+    """Supported training tasks (reference TaskType.scala)."""
+
+    LINEAR_REGRESSION = "LINEAR_REGRESSION"
+    LOGISTIC_REGRESSION = "LOGISTIC_REGRESSION"
+    POISSON_REGRESSION = "POISSON_REGRESSION"
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM = "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+    NONE = "NONE"
+
+    @property
+    def is_classification(self) -> bool:
+        return self in (
+            TaskType.LOGISTIC_REGRESSION,
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+        )

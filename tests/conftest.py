@@ -1,0 +1,57 @@
+"""Test configuration: multi-device CPU mesh + float64 for numeric checks.
+
+The JAX analogue of the reference's Spark local[*] harness
+(photon-test-utils SparkTestUtils.scala:43-76): 8 virtual CPU devices via
+--xla_force_host_platform_device_count, so every sharding/collective test
+runs without TPU hardware (SURVEY.md §4).
+
+Must run before jax initializes, hence the env mutation at import time.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Force CPU: the ambient environment may point JAX at real TPU hardware (and
+# a sitecustomize may override JAX_PLATFORMS via jax.config at interpreter
+# boot); tests must run on the 8-device virtual CPU mesh regardless, so set
+# both the env var and — after import — the config value.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+# Persistent compilation cache: the jitted while_loop solvers are expensive to
+# compile on CPU; cache across test runs (analogous to keeping one Spark
+# session per suite in the reference harness).
+jax.config.update("jax_compilation_cache_dir", "/tmp/photon_ml_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_classification(rng, n=200, d=8, dtype=np.float64):
+    """Deterministic synthetic binary-classification data
+    (reference SparkTestUtils generators)."""
+    w_true = rng.normal(size=(d,))
+    x = rng.normal(size=(n, d))
+    logits = x @ w_true
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype(dtype)
+    return x.astype(dtype), y, w_true
+
+
+def make_regression(rng, n=200, d=8, noise=0.1, dtype=np.float64):
+    w_true = rng.normal(size=(d,))
+    x = rng.normal(size=(n, d))
+    y = x @ w_true + noise * rng.normal(size=n)
+    return x.astype(dtype), y.astype(dtype), w_true

@@ -1,0 +1,113 @@
+"""Evaluator correctness vs sklearn-style closed forms computed by hand."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.evaluation import local_metrics as lm
+from photon_ml_tpu.evaluation.evaluators import (
+    EvaluationData,
+    default_evaluator_for_task,
+    parse_evaluator,
+)
+from photon_ml_tpu.types import TaskType
+
+
+def test_auc_simple():
+    # perfect separation
+    assert lm.area_under_roc_curve([1, 2, 3, 4], [0, 0, 1, 1]) == 1.0
+    # perfect inversion
+    assert lm.area_under_roc_curve([4, 3, 2, 1], [0, 0, 1, 1]) == 0.0
+    # random-ish hand case: pairs (pos>neg): s=[1,3,2,4] y=[0,0,1,1]
+    # pos scores {2,4}, neg {1,3}: pairs won: (2>1), (4>1), (4>3) = 3/4
+    np.testing.assert_allclose(
+        lm.area_under_roc_curve([1, 3, 2, 4], [0, 0, 1, 1]), 0.75
+    )
+
+
+def test_auc_ties_average_rank():
+    # one pos and one neg tied: contributes 0.5
+    np.testing.assert_allclose(lm.area_under_roc_curve([1, 1], [0, 1]), 0.5)
+
+
+def test_auc_weighted():
+    # duplicate a sample == double its weight
+    s = [1.0, 2.0, 3.0]
+    y = [0, 1, 1]
+    a_dup = lm.area_under_roc_curve([1.0, 2.0, 2.0, 3.0], [0, 1, 1, 1])
+    a_w = lm.area_under_roc_curve(s, y, [1.0, 2.0, 1.0])
+    np.testing.assert_allclose(a_w, a_dup)
+
+
+def test_auc_degenerate():
+    assert np.isnan(lm.area_under_roc_curve([1, 2], [1, 1]))
+
+
+def test_rmse():
+    np.testing.assert_allclose(
+        lm.root_mean_squared_error([1.0, 2.0], [0.0, 0.0]), np.sqrt(2.5)
+    )
+    np.testing.assert_allclose(
+        lm.root_mean_squared_error([1.0, 2.0], [0.0, 0.0], [1.0, 0.0]), 1.0
+    )
+
+
+def test_aupr_perfect():
+    np.testing.assert_allclose(
+        lm.area_under_precision_recall_curve([1, 2, 3, 4], [0, 0, 1, 1]), 1.0
+    )
+
+
+def test_precision_at_k():
+    s = [0.9, 0.8, 0.7, 0.1]
+    y = [1, 0, 1, 1]
+    np.testing.assert_allclose(lm.precision_at_k(2, s, y), 0.5)
+    np.testing.assert_allclose(lm.precision_at_k(3, s, y), 2.0 / 3.0)
+
+
+def test_multi_evaluator_per_query():
+    ev = parse_evaluator("AUC:queryId")
+    scores = np.array([1.0, 2.0, 3.0, 1.0, 2.0, 3.0, 5.0])
+    labels = np.array([0.0, 1.0, 1.0, 1.0, 0.0, 1.0, 1.0])
+    #                  |--- q1: AUC=1 ---|  |-- q2: pos={1,3},neg={2} -> (0+1)/2 |  q3 skipped (one class)
+    data = EvaluationData(
+        labels=labels,
+        offsets=np.zeros(7),
+        weights=np.ones(7),
+        ids={"queryId": np.array([1, 1, 1, 2, 2, 2, 3])},
+    )
+    v = ev.evaluate(scores, data)
+    np.testing.assert_allclose(v, (1.0 + 0.5) / 2)
+
+
+def test_multi_evaluator_precision_at_k():
+    ev = parse_evaluator("PRECISION@1:q")
+    data = EvaluationData(
+        labels=np.array([1.0, 0.0, 0.0, 1.0]),
+        offsets=np.zeros(4),
+        weights=np.ones(4),
+        ids={"q": np.array([0, 0, 1, 1])},
+    )
+    v = ev.evaluate(np.array([2.0, 1.0, 2.0, 1.0]), data)
+    # q0: top-1 is label 1 -> 1.0 ; q1: top-1 is label 0 -> 0.0
+    np.testing.assert_allclose(v, 0.5)
+
+
+def test_better_than_directions():
+    auc = parse_evaluator("AUC")
+    rmse = parse_evaluator("RMSE")
+    assert auc.better_than(0.9, 0.8)
+    assert not auc.better_than(0.7, 0.8)
+    assert rmse.better_than(0.5, 0.8)
+    assert auc.better_than(0.1, float("nan"))
+
+
+def test_default_evaluator_for_task():
+    assert default_evaluator_for_task(TaskType.LOGISTIC_REGRESSION).name == "LOGISTIC_LOSS"
+    assert default_evaluator_for_task(TaskType.LINEAR_REGRESSION).name == "SQUARED_LOSS"
+
+
+def test_parse_rejects_unknown():
+    with pytest.raises(ValueError):
+        parse_evaluator("BOGUS")
+    with pytest.raises(ValueError):
+        parse_evaluator("BOGUS:qid")

@@ -1,0 +1,70 @@
+"""Pointwise losses: derivatives checked against jax autodiff.
+
+Reference analogue: photon-api function/glm/*LossFunction tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops.losses import (
+    LogisticLoss,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    SquaredLoss,
+    loss_for_task,
+)
+from photon_ml_tpu.types import TaskType
+
+LOSSES = [LogisticLoss(), SquaredLoss(), PoissonLoss(), SmoothedHingeLoss()]
+MARGINS = jnp.linspace(-4.0, 4.0, 41)
+
+
+@pytest.mark.parametrize("loss", LOSSES, ids=lambda l: type(l).__name__)
+@pytest.mark.parametrize("label", [0.0, 1.0])
+def test_dz_matches_autodiff(loss, label):
+    label_arr = jnp.full_like(MARGINS, label)
+    _, dz = loss.loss_and_dz(MARGINS, label_arr)
+    auto = jax.vmap(jax.grad(lambda z, y: loss.loss(z, y)))(MARGINS, label_arr)
+    np.testing.assert_allclose(dz, auto, atol=1e-8)
+
+
+@pytest.mark.parametrize(
+    "loss", [LogisticLoss(), SquaredLoss(), PoissonLoss()], ids=lambda l: type(l).__name__
+)
+@pytest.mark.parametrize("label", [0.0, 1.0])
+def test_d2z_matches_autodiff(loss, label):
+    label_arr = jnp.full_like(MARGINS, label)
+    d2 = loss.d2z(MARGINS, label_arr)
+    auto = jax.vmap(jax.hessian(lambda z, y: loss.loss(z, y)))(MARGINS, label_arr)
+    np.testing.assert_allclose(d2, auto, atol=1e-8)
+
+
+def test_logistic_values():
+    # l(0, y) = log 2 for either label
+    l0, _ = LogisticLoss().loss_and_dz(jnp.array(0.0), jnp.array(1.0))
+    np.testing.assert_allclose(l0, np.log(2.0), rtol=1e-12)
+    # stable at extreme margins
+    l_big, dz = LogisticLoss().loss_and_dz(jnp.array(500.0), jnp.array(1.0))
+    assert np.isfinite(float(l_big)) and np.isfinite(float(dz))
+
+
+def test_smoothed_hinge_piecewise():
+    sh = SmoothedHingeLoss()
+    y1 = jnp.array(1.0)
+    # t >= 1: zero loss
+    assert float(sh.loss(jnp.array(2.0), y1)) == 0.0
+    # t <= 0: linear 1/2 - t
+    np.testing.assert_allclose(float(sh.loss(jnp.array(-1.0), y1)), 1.5)
+    # 0 < t < 1: quadratic
+    np.testing.assert_allclose(float(sh.loss(jnp.array(0.5), y1)), 0.125)
+    assert not sh.twice_differentiable
+
+
+def test_loss_for_task():
+    assert isinstance(loss_for_task(TaskType.LOGISTIC_REGRESSION), LogisticLoss)
+    assert isinstance(loss_for_task(TaskType.LINEAR_REGRESSION), SquaredLoss)
+    assert isinstance(loss_for_task(TaskType.POISSON_REGRESSION), PoissonLoss)
+    with pytest.raises(ValueError):
+        loss_for_task(TaskType.NONE)

@@ -1,0 +1,177 @@
+"""GLM objective: gradients/Hv vs numerical differentiation, normalization algebra.
+
+Reference analogue: photon-api function/glm/*AggregatorTest + NormalizationContext tests.
+The key invariant: computing with raw data + (effective coefficients, margin
+shift) must equal computing with explicitly transformed data.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import LabeledPointBatch, summarize
+from photon_ml_tpu.ops.losses import LogisticLoss, SquaredLoss
+from photon_ml_tpu.ops.normalization import (
+    NormalizationContext,
+    NormalizationType,
+    build_normalization,
+)
+from photon_ml_tpu.ops.objective import GLMObjective
+
+from tests.conftest import make_classification
+
+
+def _numerical_grad(f, w, eps=1e-6):
+    g = np.zeros_like(w)
+    for i in range(len(w)):
+        wp, wm = w.copy(), w.copy()
+        wp[i] += eps
+        wm[i] -= eps
+        g[i] = (f(jnp.asarray(wp)) - f(jnp.asarray(wm))) / (2 * eps)
+    return g
+
+
+def test_gradient_matches_numerical(rng):
+    x, y, _ = make_classification(rng, n=50, d=6)
+    batch = LabeledPointBatch.create(x, y, weights=rng.uniform(0.5, 2.0, size=50))
+    obj = GLMObjective(LogisticLoss(), l2_weight=0.3)
+    w = rng.normal(size=6)
+    _, grad = obj.value_and_gradient(jnp.asarray(w), batch)
+    num = _numerical_grad(lambda ww: float(obj.value(ww, batch)), w)
+    np.testing.assert_allclose(grad, num, rtol=1e-5, atol=1e-6)
+
+
+def test_hessian_vector_matches_numerical(rng):
+    x, y, _ = make_classification(rng, n=50, d=6)
+    batch = LabeledPointBatch.create(x, y)
+    obj = GLMObjective(LogisticLoss(), l2_weight=0.1)
+    w = rng.normal(size=6)
+    v = rng.normal(size=6)
+    hv = obj.hessian_vector(jnp.asarray(w), jnp.asarray(v), batch)
+    eps = 1e-6
+    g_plus = obj.gradient(jnp.asarray(w + eps * v), batch)
+    g_minus = obj.gradient(jnp.asarray(w - eps * v), batch)
+    num = (np.asarray(g_plus) - np.asarray(g_minus)) / (2 * eps)
+    np.testing.assert_allclose(hv, num, rtol=1e-4, atol=1e-5)
+
+
+def test_hessian_matrix_consistent_with_hv(rng):
+    x, y, _ = make_classification(rng, n=40, d=5)
+    batch = LabeledPointBatch.create(x, y)
+    obj = GLMObjective(LogisticLoss(), l2_weight=0.2)
+    w = jnp.asarray(rng.normal(size=5))
+    h = obj.hessian_matrix(w, batch)
+    for i in range(5):
+        e = jnp.zeros(5).at[i].set(1.0)
+        np.testing.assert_allclose(h[:, i], obj.hessian_vector(w, e, batch), rtol=1e-6, atol=1e-8)
+    diag = obj.hessian_diagonal(w, batch)
+    np.testing.assert_allclose(diag, jnp.diagonal(h), rtol=1e-6)
+
+
+def test_normalization_algebra_equals_explicit_transform(rng):
+    """Raw data + effective-coefficient algebra == explicitly standardized data.
+
+    This is the core trick of ValueAndGradientAggregator.scala:36-49.
+    """
+    x, y, _ = make_classification(rng, n=60, d=5)
+    stats = summarize(x)
+    norm = build_normalization(
+        NormalizationType.STANDARDIZATION,
+        mean=jnp.asarray(stats["mean"]),
+        variance=jnp.asarray(stats["variance"]),
+        max_magnitude=jnp.asarray(stats["max_magnitude"]),
+    )
+    raw = LabeledPointBatch.create(x, y)
+    x_std = (x - stats["mean"]) / np.sqrt(stats["variance"])
+    std_batch = LabeledPointBatch.create(x_std, y)
+
+    obj_norm = GLMObjective(LogisticLoss(), normalization=norm)
+    obj_plain = GLMObjective(LogisticLoss())
+    w = jnp.asarray(rng.normal(size=5))
+
+    np.testing.assert_allclose(
+        obj_norm.value(w, raw), obj_plain.value(w, std_batch), rtol=1e-10
+    )
+    np.testing.assert_allclose(
+        obj_norm.gradient(w, raw), obj_plain.gradient(w, std_batch), rtol=1e-8, atol=1e-10
+    )
+    v = jnp.asarray(rng.normal(size=5))
+    np.testing.assert_allclose(
+        obj_norm.hessian_vector(w, v, raw),
+        obj_plain.hessian_vector(w, v, std_batch),
+        rtol=1e-8,
+        atol=1e-10,
+    )
+    np.testing.assert_allclose(
+        obj_norm.hessian_matrix(w, raw),
+        obj_plain.hessian_matrix(w, std_batch),
+        rtol=1e-8,
+        atol=1e-10,
+    )
+
+
+def test_intercept_exempt_from_normalization(rng):
+    x, y, _ = make_classification(rng, n=30, d=4)
+    x = np.concatenate([x, np.ones((30, 1))], axis=1)  # intercept last
+    stats = summarize(x)
+    norm = build_normalization(
+        NormalizationType.STANDARDIZATION,
+        mean=jnp.asarray(stats["mean"]),
+        variance=jnp.asarray(stats["variance"]),
+        max_magnitude=jnp.asarray(stats["max_magnitude"]),
+        intercept_index=4,
+    )
+    assert float(norm.factors[4]) == 1.0
+    assert float(norm.shifts[4]) == 0.0
+
+
+def test_model_space_round_trip(rng):
+    """to_model_space must make raw-feature scoring equal normalized-space
+    margins, and from_model_space must invert it (code-review finding:
+    normalized-space coefficients were previously scored against raw data)."""
+    x, y, _ = make_classification(rng, n=40, d=4)
+    x = np.concatenate([x, np.ones((40, 1))], axis=1)  # intercept last
+    stats = summarize(x)
+    norm = build_normalization(
+        NormalizationType.STANDARDIZATION,
+        mean=jnp.asarray(stats["mean"]),
+        variance=jnp.asarray(stats["variance"]),
+        max_magnitude=jnp.asarray(stats["max_magnitude"]),
+        intercept_index=4,
+    )
+    w_norm = jnp.asarray(rng.normal(size=5))
+    obj = GLMObjective(LogisticLoss(), normalization=norm)
+    batch = LabeledPointBatch.create(x, y)
+    margins_training = obj.margins(w_norm, batch)
+
+    w_model = norm.to_model_space(w_norm, intercept_index=4)
+    margins_scoring = jnp.asarray(x) @ w_model
+    np.testing.assert_allclose(margins_scoring, margins_training, rtol=1e-10)
+
+    back = norm.from_model_space(w_model, intercept_index=4)
+    np.testing.assert_allclose(back, w_norm, rtol=1e-10)
+
+    # batched (random-effect table) path
+    table = jnp.asarray(rng.normal(size=(7, 5)))
+    round_trip = norm.from_model_space(norm.to_model_space(table, 4), 4)
+    np.testing.assert_allclose(round_trip, table, rtol=1e-10)
+
+
+def test_padding_rows_do_not_contribute(rng):
+    x, y, _ = make_classification(rng, n=30, d=4)
+    batch = LabeledPointBatch.create(x, y)
+    padded = batch.pad_to(48)
+    obj = GLMObjective(LogisticLoss(), l2_weight=0.05)
+    w = jnp.asarray(rng.normal(size=4))
+    np.testing.assert_allclose(obj.value(w, batch), obj.value(w, padded), rtol=1e-12)
+    np.testing.assert_allclose(obj.gradient(w, batch), obj.gradient(w, padded), rtol=1e-12)
+
+
+def test_weighted_squared_loss_closed_form(rng):
+    x = rng.normal(size=(20, 3))
+    y = rng.normal(size=20)
+    wts = rng.uniform(0.5, 2.0, size=20)
+    batch = LabeledPointBatch.create(x, y, weights=wts)
+    obj = GLMObjective(SquaredLoss())
+    w = rng.normal(size=3)
+    expected = 0.5 * np.sum(wts * (x @ w - y) ** 2)
+    np.testing.assert_allclose(float(obj.value(jnp.asarray(w), batch)), expected, rtol=1e-10)

@@ -1,0 +1,193 @@
+"""Optimizer convergence vs scipy on convex GLM problems.
+
+Reference analogue: photon-lib OptimizerIntegTest / LBFGSTest / OWLQNTest /
+TRONTest on convex toy objectives (IntegTestObjective.scala).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.optimize
+
+from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.ops.losses import LogisticLoss, PoissonLoss, SquaredLoss
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.optim import (
+    ConvergenceReason,
+    OptimizerConfig,
+    OptimizerType,
+    minimize_lbfgs,
+    minimize_owlqn,
+    minimize_tron,
+    solve,
+)
+
+from tests.conftest import make_classification, make_regression
+
+
+def _scipy_opt(obj, batch, d):
+    def f(w):
+        return float(obj.value(jnp.asarray(w), batch))
+
+    def g(w):
+        return np.asarray(obj.gradient(jnp.asarray(w), batch))
+
+    res = scipy.optimize.minimize(f, np.zeros(d), jac=g, method="L-BFGS-B",
+                                  options={"maxiter": 500, "ftol": 1e-14, "gtol": 1e-10})
+    return res.x, res.fun
+
+
+@pytest.mark.parametrize("loss_l2", [(LogisticLoss(), 0.5), (SquaredLoss(), 1.0)],
+                         ids=["logistic", "squared"])
+def test_lbfgs_matches_scipy(rng, loss_l2):
+    loss, l2 = loss_l2
+    x, y, _ = make_classification(rng, n=120, d=7)
+    if isinstance(loss, SquaredLoss):
+        x, y, _ = make_regression(rng, n=120, d=7)
+    batch = LabeledPointBatch.create(x, y)
+    obj = GLMObjective(loss, l2_weight=l2)
+    bound = obj.bind(batch)
+
+    result = jax.jit(lambda w0: minimize_lbfgs(bound.value_and_grad, w0))(jnp.zeros(7))
+    w_ref, f_ref = _scipy_opt(obj, batch, 7)
+    np.testing.assert_allclose(float(result.value), f_ref, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(result.coefficients), w_ref, rtol=1e-3, atol=1e-4)
+    assert int(result.reason) in (
+        ConvergenceReason.FUNCTION_VALUES_WITHIN_TOLERANCE,
+        ConvergenceReason.GRADIENT_WITHIN_TOLERANCE,
+    )
+
+
+def test_tron_matches_lbfgs(rng):
+    x, y, _ = make_classification(rng, n=150, d=6)
+    batch = LabeledPointBatch.create(x, y)
+    obj = GLMObjective(LogisticLoss(), l2_weight=0.3)
+    bound = obj.bind(batch)
+
+    tron = minimize_tron(bound.value_and_grad, bound.hessian_vector, jnp.zeros(6),
+                         max_iter=50, tolerance=1e-8)
+    lbfgs = minimize_lbfgs(bound.value_and_grad, jnp.zeros(6))
+    np.testing.assert_allclose(float(tron.value), float(lbfgs.value), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(tron.coefficients), np.asarray(lbfgs.coefficients), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_tron_poisson(rng):
+    d = 5
+    w_true = rng.normal(size=d) * 0.3
+    x = rng.normal(size=(200, d))
+    lam = np.exp(x @ w_true)
+    y = rng.poisson(lam).astype(np.float64)
+    batch = LabeledPointBatch.create(x, y)
+    obj = GLMObjective(PoissonLoss(), l2_weight=0.1)
+    bound = obj.bind(batch)
+    res = minimize_tron(bound.value_and_grad, bound.hessian_vector, jnp.zeros(d),
+                        max_iter=50, tolerance=1e-8)
+    w_ref, f_ref = _scipy_opt(obj, batch, d)
+    np.testing.assert_allclose(float(res.value), f_ref, rtol=1e-5)
+
+
+def test_owlqn_produces_sparse_solution(rng):
+    x, y, _ = make_classification(rng, n=150, d=10)
+    batch = LabeledPointBatch.create(x, y)
+    obj = GLMObjective(LogisticLoss())
+    bound = obj.bind(batch)
+
+    strong = minimize_owlqn(bound.value_and_grad, jnp.zeros(10), l1_weight=20.0)
+    weak = minimize_owlqn(bound.value_and_grad, jnp.zeros(10), l1_weight=0.01)
+    nnz_strong = int(np.sum(np.abs(np.asarray(strong.coefficients)) > 1e-10))
+    nnz_weak = int(np.sum(np.abs(np.asarray(weak.coefficients)) > 1e-10))
+    assert nnz_strong < nnz_weak
+
+
+def test_owlqn_matches_scipy_l1(rng):
+    """OWL-QN objective value vs scipy on a smoothed-L1 surrogate check:
+    compare against proximal-quality solution found by scipy on L(w)+λ‖w‖₁
+    via the subgradient-free Nelder-Mead is too weak; instead verify optimality
+    conditions: |∇L_i| <= λ at zeros, ∇L_i = -λ·sign(w_i) at non-zeros."""
+    x, y, _ = make_classification(rng, n=120, d=6)
+    batch = LabeledPointBatch.create(x, y)
+    obj = GLMObjective(LogisticLoss())
+    bound = obj.bind(batch)
+    lam = 3.0
+    res = minimize_owlqn(bound.value_and_grad, jnp.zeros(6), l1_weight=lam, tolerance=1e-10)
+    w = np.asarray(res.coefficients)
+    g = np.asarray(obj.gradient(res.coefficients, batch))
+    for i in range(6):
+        if abs(w[i]) < 1e-10:
+            assert abs(g[i]) <= lam + 1e-3
+        else:
+            np.testing.assert_allclose(g[i], -lam * np.sign(w[i]), atol=1e-3)
+
+
+def test_lbfgsb_box_constraints(rng):
+    x, y, _ = make_regression(rng, n=100, d=5)
+    batch = LabeledPointBatch.create(x, y)
+    obj = GLMObjective(SquaredLoss(), l2_weight=0.01)
+    bound = obj.bind(batch)
+    lo = jnp.zeros(5)
+    hi = jnp.full((5,), 0.5)
+    res = minimize_lbfgs(bound.value_and_grad, jnp.zeros(5),
+                         lower_bounds=lo, upper_bounds=hi)
+    w = np.asarray(res.coefficients)
+    assert np.all(w >= -1e-12) and np.all(w <= 0.5 + 1e-12)
+
+    def f(wv):
+        return float(obj.value(jnp.asarray(wv), batch))
+
+    def g(wv):
+        return np.asarray(obj.gradient(jnp.asarray(wv), batch))
+
+    ref = scipy.optimize.minimize(f, np.zeros(5), jac=g, method="L-BFGS-B",
+                                  bounds=[(0.0, 0.5)] * 5)
+    np.testing.assert_allclose(float(res.value), ref.fun, rtol=1e-5)
+
+
+def test_solver_is_vmappable(rng):
+    """The property that powers random-effect coordinates: batched solves."""
+    n_entities, n, d = 8, 32, 4
+    xs = rng.normal(size=(n_entities, n, d))
+    w_true = rng.normal(size=(n_entities, d))
+    logits = np.einsum("end,ed->en", xs, w_true)
+    ys = (rng.uniform(size=(n_entities, n)) < 1.0 / (1.0 + np.exp(-logits))).astype(float)
+
+    def solve_one(x, y):
+        batch = LabeledPointBatch.create(x, y)
+        bound = GLMObjective(LogisticLoss(), l2_weight=1.0).bind(batch)
+        return minimize_lbfgs(bound.value_and_grad, jnp.zeros(d), max_iter=50)
+
+    batched = jax.jit(jax.vmap(solve_one))(jnp.asarray(xs), jnp.asarray(ys))
+    assert batched.coefficients.shape == (n_entities, d)
+    for e in range(n_entities):
+        single = solve_one(jnp.asarray(xs[e]), jnp.asarray(ys[e]))
+        np.testing.assert_allclose(
+            np.asarray(batched.coefficients[e]), np.asarray(single.coefficients),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_solve_facade_and_tron_rejects_hinge(rng):
+    from photon_ml_tpu.ops.losses import SmoothedHingeLoss
+
+    x, y, _ = make_classification(rng, n=50, d=4)
+    batch = LabeledPointBatch.create(x, y)
+    bound = GLMObjective(SmoothedHingeLoss(), l2_weight=0.1).bind(batch)
+    res = solve(OptimizerConfig(optimizer_type=OptimizerType.LBFGS), bound, jnp.zeros(4))
+    assert float(res.value) < float(bound.value(jnp.zeros(4)))
+    with pytest.raises(ValueError, match="twice-differentiable"):
+        solve(OptimizerConfig(optimizer_type=OptimizerType.TRON), bound, jnp.zeros(4))
+
+
+def test_history_tracking(rng):
+    x, y, _ = make_classification(rng, n=80, d=5)
+    batch = LabeledPointBatch.create(x, y)
+    bound = GLMObjective(LogisticLoss(), l2_weight=0.2).bind(batch)
+    res = minimize_lbfgs(bound.value_and_grad, jnp.zeros(5))
+    vh = np.asarray(res.value_history)
+    iters = int(res.iterations)
+    assert np.all(np.isfinite(vh[: iters + 1]))
+    assert np.all(np.isnan(vh[iters + 1:]))
+    # monotone decrease of accepted values
+    assert np.all(np.diff(vh[: iters + 1]) <= 1e-12)
