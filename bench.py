@@ -1,0 +1,100 @@
+"""Benchmark: jitted L-BFGS logistic regression throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: the reference's hot loop (SURVEY.md §3.4) — L-BFGS iterations over
+a dense [n, d] logistic-regression batch, the TPU analogue of
+DistributedGLMLossFunction.calculate -> ValueAndGradientAggregator
+.treeAggregate. ``vs_baseline`` is the measured speedup over the same solve
+run by scipy's Fortran L-BFGS-B on the host CPU — a stand-in for the
+reference's single-executor Breeze/JVM path (the reference repo itself
+publishes no benchmark numbers, see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _make_data(n: int, d: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(d,)).astype(np.float32) / np.sqrt(d)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    logits = x @ w_true
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float32)
+    return x, y
+
+
+def bench_tpu(x, y, max_iter: int) -> tuple[float, int]:
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import LabeledPointBatch
+    from photon_ml_tpu.ops.losses import LogisticLoss
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+
+    batch = LabeledPointBatch.create(x, y)
+    objective = GLMObjective(LogisticLoss(), l2_weight=1.0)
+    bound = objective.bind(batch)
+
+    @jax.jit
+    def run(w0):
+        return minimize_lbfgs(
+            bound.value_and_grad, w0, max_iter=max_iter, tolerance=0.0
+        )
+
+    w0 = jnp.zeros((x.shape[1],), dtype=jnp.float32)
+    result = jax.block_until_ready(run(w0))  # compile + warm up
+    t0 = time.perf_counter()
+    result = jax.block_until_ready(run(w0))
+    elapsed = time.perf_counter() - t0
+    return elapsed, int(result.iterations)
+
+
+def bench_cpu_scipy(x, y, max_iter: int) -> tuple[float, int]:
+    from scipy.optimize import minimize
+
+    x64, y64 = x.astype(np.float64), y.astype(np.float64)
+
+    def f(w):
+        m = x64 @ w
+        # logistic loss + grad, numerically stable
+        val = np.sum(np.logaddexp(0.0, m) - y64 * m) + 0.5 * np.dot(w, w)
+        p = 1.0 / (1.0 + np.exp(-m))
+        g = x64.T @ (p - y64) + w
+        return val, g
+
+    w0 = np.zeros(x.shape[1])
+    t0 = time.perf_counter()
+    res = minimize(f, w0, jac=True, method="L-BFGS-B",
+                   options={"maxiter": max_iter, "ftol": 0.0, "gtol": 0.0})
+    elapsed = time.perf_counter() - t0
+    return elapsed, int(res.nit)
+
+
+def main():
+    n, d, max_iter = 1 << 18, 512, 30
+    x, y = _make_data(n, d)
+
+    tpu_time, tpu_iters = bench_tpu(x, y, max_iter)
+    tpu_rate = n * max(tpu_iters, 1) / tpu_time
+
+    # CPU baseline on a subsample (same per-example cost; keeps bench fast)
+    n_cpu = min(n, 1 << 15)
+    cpu_time, cpu_iters = bench_cpu_scipy(x[:n_cpu], y[:n_cpu], max_iter)
+    cpu_rate = n_cpu * max(cpu_iters, 1) / cpu_time
+
+    print(json.dumps({
+        "metric": "glm_lbfgs_examples_per_sec",
+        "value": round(tpu_rate, 1),
+        "unit": "examples/sec (n=262144, d=512, 30 L-BFGS iters, logistic)",
+        "vs_baseline": round(tpu_rate / cpu_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -211,8 +211,7 @@ class RandomEffectCoordinate(Coordinate):
         return model.score_dataset(self.dataset)
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _jitted_re_bucket_solve(
+def solve_entity_bucket(
     objective: GLMObjective,
     opt: OptimizerConfig,
     features: Array,  # [e, cap, d]
@@ -222,8 +221,13 @@ def _jitted_re_bucket_solve(
     entity_rows: Array,  # [e]
     full_offsets: Array,  # [n]
     table: Array,  # [E, d]
-):
-    """Solve every entity in a bucket and scatter results into the table."""
+) -> Array:
+    """Solve every entity in a bucket and scatter results into the table.
+
+    Pure/traceable: reused by the single-chip jit wrapper below and by the
+    mesh-sharded full-GAME train step (parallel/distributed.py), where the
+    entity axis shards over the mesh's "data" axis.
+    """
     safe = jnp.maximum(sample_rows, 0)
     offsets = jnp.where(sample_rows >= 0, full_offsets[safe], 0.0)
 
@@ -234,6 +238,24 @@ def _jitted_re_bucket_solve(
     w0s = table[entity_rows]
     solved = jax.vmap(solve_one)(features, labels, offsets, weights, w0s)
     return table.at[entity_rows].set(solved)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _jitted_re_bucket_solve(
+    objective: GLMObjective,
+    opt: OptimizerConfig,
+    features: Array,
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    full_offsets: Array,
+    table: Array,
+):
+    return solve_entity_bucket(
+        objective, opt, features, labels, weights, sample_rows, entity_rows,
+        full_offsets, table,
+    )
 
 
 @dataclasses.dataclass

@@ -256,9 +256,12 @@ def build_game_dataset(
     vocabs: dict[str, np.ndarray] = {}
     entity_idx: dict[str, Array] = {}
     for re_type, keys in entity_keys.items():
-        keys = np.asarray(keys)
+        # Entity keys are canonically strings (they round-trip through Avro
+        # model files as modelId strings, io/model_io.py); coerce here so an
+        # int-keyed dataset still matches a loaded model's vocab.
+        keys = np.asarray(keys).astype(str)
         if entity_vocabs is not None and re_type in entity_vocabs:
-            vocab = np.asarray(entity_vocabs[re_type])
+            vocab = np.asarray(entity_vocabs[re_type]).astype(str)
         else:
             vocab = np.unique(keys)
         lookup = {k: i for i, k in enumerate(vocab.tolist())}

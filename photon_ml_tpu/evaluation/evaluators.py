@@ -128,6 +128,8 @@ def parse_evaluator(spec: str) -> Evaluator:
         metric, id_col = spec.split(":", 1)
         metric = metric.strip().upper()
         id_col = id_col.strip()
+        if not id_col:
+            raise ValueError(f"Per-query evaluator '{spec}' is missing an id column")
         if metric.startswith("PRECISION@"):
             k_str = metric.split("@", 1)[1]
             if not k_str.isdigit() or int(k_str) < 1:

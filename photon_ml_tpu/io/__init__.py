@@ -1,0 +1,3 @@
+"""I/O layer: Avro wire formats, feature index maps, data readers, model
+persistence — the TPU-native replacement for photon-client's Avro stack
+(reference photon-client data/avro/*, photon-avro-schemas)."""

@@ -1,0 +1,165 @@
+"""Feature index maps: (name, term) -> dense column index.
+
+Reference parity: photon-api index/IndexMap.scala (iface),
+DefaultIndexMap(Loader) (on-heap from distinct), PalDBIndexMap (off-heap
+partitioned stores), and the client's Constants (DELIMITER="\\u0001",
+INTERCEPT_NAME="(INTERCEPT)", reference photon-lib Constants.scala:31-42).
+
+TPU-native: the index map is host-side metadata — it never reaches the
+device. Persistence is a sorted key file + JSON metadata; the off-heap,
+memory-mapped variant (PalDB equivalent, for billion-feature maps that must
+not live on the Python heap) is provided by the native runtime
+(photon_ml_tpu.runtime.native_index, C++ mmap hash store) with this module
+as the contract and fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Iterator, Mapping
+
+DELIMITER = ""
+INTERCEPT_NAME = "(INTERCEPT)"
+INTERCEPT_TERM = ""
+
+
+def feature_key(name: str, term: str = "") -> str:
+    """Reference Utils.getFeatureKey: name + DELIMITER + term."""
+    return f"{name}{DELIMITER}{term}"
+
+
+def split_feature_key(key: str) -> tuple[str, str]:
+    name, _, term = key.partition(DELIMITER)
+    return name, term
+
+
+INTERCEPT_KEY = feature_key(INTERCEPT_NAME, INTERCEPT_TERM)
+
+
+class IndexMap(Mapping[str, int]):
+    """Immutable feature-key -> index map with reverse lookup.
+
+    Reference IndexMap: getIndex / getFeatureName + the map contract.
+    """
+
+    def __init__(self, key_to_index: dict[str, int]):
+        self._forward = dict(key_to_index)
+        self._reverse: dict[int, str] | None = None
+
+    # Mapping protocol -------------------------------------------------------
+    def __getitem__(self, key: str) -> int:
+        return self._forward[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._forward)
+
+    def __len__(self) -> int:
+        return len(self._forward)
+
+    # Reference API ----------------------------------------------------------
+    def get_index(self, key: str) -> int:
+        """-1 when absent (reference IndexMap.NULL_KEY semantics)."""
+        return self._forward.get(key, -1)
+
+    def get_feature_name(self, index: int) -> str | None:
+        if self._reverse is None:
+            self._reverse = {v: k for k, v in self._forward.items()}
+        return self._reverse.get(index)
+
+    @property
+    def size(self) -> int:
+        return len(self._forward)
+
+    @property
+    def has_intercept(self) -> bool:
+        return INTERCEPT_KEY in self._forward
+
+    @property
+    def intercept_index(self) -> int | None:
+        idx = self._forward.get(INTERCEPT_KEY)
+        return idx
+
+    # Construction -----------------------------------------------------------
+    @classmethod
+    def from_keys(cls, keys: Iterable[str], *, add_intercept: bool = False) -> "IndexMap":
+        """Build from distinct feature keys, sorted for determinism
+        (reference DefaultIndexMapLoader sorts distinct keys)."""
+        distinct = sorted(set(keys))
+        mapping = {k: i for i, k in enumerate(distinct)}
+        if add_intercept and INTERCEPT_KEY not in mapping:
+            mapping[INTERCEPT_KEY] = len(mapping)
+        return cls(mapping)
+
+    @classmethod
+    def from_name_terms(
+        cls, pairs: Iterable[tuple[str, str]], *, add_intercept: bool = False
+    ) -> "IndexMap":
+        return cls.from_keys((feature_key(n, t) for n, t in pairs),
+                             add_intercept=add_intercept)
+
+    # Persistence ------------------------------------------------------------
+    def save(self, directory: str | os.PathLike, name: str = "index") -> str:
+        """Write ``<name>.keys`` (one key per line, index order) +
+        ``<name>.meta.json``. Keys may contain the \\u0001 delimiter; lines
+        are the unit, so keys must not contain newlines."""
+        os.makedirs(directory, exist_ok=True)
+        ordered = sorted(self._forward.items(), key=lambda kv: kv[1])
+        expected = list(range(len(ordered)))
+        if [i for _, i in ordered] != expected:
+            raise ValueError("index map indices must be dense 0..n-1 to save")
+        keys_path = os.path.join(directory, f"{name}.keys")
+        with open(keys_path, "w", encoding="utf-8") as f:
+            for k, _ in ordered:
+                f.write(k + "\n")
+        with open(os.path.join(directory, f"{name}.meta.json"), "w") as f:
+            json.dump({"size": len(ordered), "format": "photon-ml-tpu/index/v1"}, f)
+        return keys_path
+
+    @classmethod
+    def load(cls, directory: str | os.PathLike, name: str = "index") -> "IndexMap":
+        keys_path = os.path.join(directory, f"{name}.keys")
+        with open(keys_path, encoding="utf-8") as f:
+            mapping = {line.rstrip("\n"): i for i, line in enumerate(f)}
+        return cls(mapping)
+
+
+class IdentityIndexMap(Mapping[str, int]):
+    """Keys are already stringified integers (reference
+    IdentityIndexMapLoader, used when data carries numeric feature ids)."""
+
+    def __init__(self, size: int):
+        self._size = size
+
+    def __getitem__(self, key: str) -> int:
+        idx = int(split_feature_key(key)[0]) if DELIMITER in key else int(key)
+        if 0 <= idx < self._size:
+            return idx
+        raise KeyError(key)
+
+    def get_index(self, key: str) -> int:
+        try:
+            return self[key]
+        except (KeyError, ValueError):
+            return -1
+
+    def get_feature_name(self, index: int) -> str | None:
+        return str(index) if 0 <= index < self._size else None
+
+    def __iter__(self) -> Iterator[str]:
+        return (str(i) for i in range(self._size))
+
+    def __len__(self) -> int:
+        return self._size
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    @property
+    def has_intercept(self) -> bool:
+        return False
+
+    @property
+    def intercept_index(self) -> int | None:
+        return None

@@ -1,0 +1,345 @@
+"""GAME model persistence in the reference's on-disk layout.
+
+Reference parity: photon-client data/avro/ModelProcessingUtils.scala —
+save (:75-128) / load (:141-254) of:
+
+    <dir>/model-metadata.json                      {"modelType": ..., ...}
+    <dir>/fixed-effect/<name>/id-info              [featureShardId]
+    <dir>/fixed-effect/<name>/coefficients/*.avro  BayesianLinearModelAvro
+    <dir>/random-effect/<name>/id-info             [reType, featureShardId]
+    <dir>/random-effect/<name>/coefficients/*.avro one record per entity
+
+plus the text model writer (photon-client util/IOUtils writeModelsInText),
+the feature-stats writer (:515-586, FeatureSummarizationResultAvro), and the
+score writer (ScoreProcessingUtils.scala, ScoringResultAvro). A model saved
+by this module is directory-compatible with one saved by the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import photon_schemas as schemas
+from photon_ml_tpu.io.index_map import IndexMap, split_feature_key
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.types import TaskType
+
+FIXED_EFFECT = "fixed-effect"
+RANDOM_EFFECT = "random-effect"
+ID_INFO = "id-info"
+COEFFICIENTS = "coefficients"
+METADATA_FILE = "model-metadata.json"
+
+#: Default sparsity threshold below which coefficients are not persisted
+#: (reference VectorUtils.DEFAULT_SPARSITY_THRESHOLD).
+DEFAULT_SPARSITY_THRESHOLD = 1e-4
+
+#: JVM class names used in the modelClass field, for interchange with the
+#: reference's loader (supervised/model hierarchy).
+_MODEL_CLASS = {
+    TaskType.LOGISTIC_REGRESSION:
+        "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    TaskType.LINEAR_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    TaskType.POISSON_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        "com.linkedin.photon.ml.supervised.classification.SmoothedHingeLossLinearSVMModel",
+}
+_CLASS_TO_TASK = {v: k for k, v in _MODEL_CLASS.items()}
+
+
+def _coefficients_to_name_term_values(
+    means: np.ndarray, index_map: IndexMap, threshold: float
+) -> list[dict]:
+    out = []
+    for j, v in enumerate(means):
+        if abs(v) >= threshold or threshold == 0.0:
+            key = index_map.get_feature_name(j)
+            if key is None:
+                continue
+            name, term = split_feature_key(key)
+            out.append({"name": name, "term": term, "value": float(v)})
+    return out
+
+
+def _glm_to_record(
+    model_id: str,
+    glm: GeneralizedLinearModel,
+    index_map: IndexMap,
+    threshold: float,
+) -> dict:
+    means = np.asarray(glm.coefficients.means)
+    record = {
+        "modelId": model_id,
+        "modelClass": _MODEL_CLASS.get(glm.task),
+        "means": _coefficients_to_name_term_values(means, index_map, threshold),
+        "variances": None,
+        "lossFunction": None,
+    }
+    if glm.coefficients.variances is not None:
+        record["variances"] = _coefficients_to_name_term_values(
+            np.asarray(glm.coefficients.variances), index_map, 0.0
+        )
+    return record
+
+
+def _record_to_coefficients(record: dict, index_map: IndexMap, dtype) -> Coefficients:
+    d = index_map.size
+    means = np.zeros((d,), dtype=dtype)
+    from photon_ml_tpu.io.index_map import feature_key
+
+    for ntv in record["means"]:
+        j = index_map.get_index(feature_key(ntv["name"], ntv.get("term", "")))
+        if j >= 0:
+            means[j] = ntv["value"]
+    variances = None
+    if record.get("variances"):
+        variances = np.zeros((d,), dtype=dtype)
+        for ntv in record["variances"]:
+            j = index_map.get_index(feature_key(ntv["name"], ntv.get("term", "")))
+            if j >= 0:
+                variances[j] = ntv["value"]
+    return Coefficients(
+        means=jnp.asarray(means),
+        variances=None if variances is None else jnp.asarray(variances),
+    )
+
+
+def save_game_model(
+    output_dir: str | os.PathLike,
+    game_model: GameModel,
+    index_maps: Mapping[str, IndexMap],
+    *,
+    optimization_configurations: dict | None = None,
+    sparsity_threshold: float = DEFAULT_SPARSITY_THRESHOLD,
+    random_effect_records_per_file: int = 65536,
+) -> None:
+    """Save a GAME model in the reference directory layout."""
+    output_dir = str(output_dir)
+    os.makedirs(output_dir, exist_ok=True)
+    task = game_model.task
+    with open(os.path.join(output_dir, METADATA_FILE), "w") as f:
+        json.dump(
+            {
+                "modelType": task.value,
+                "optimizationConfigurations": optimization_configurations or {},
+            },
+            f,
+            indent=2,
+        )
+
+    for name, model in game_model.models.items():
+        if isinstance(model, FixedEffectModel):
+            base = os.path.join(output_dir, FIXED_EFFECT, name)
+            os.makedirs(os.path.join(base, COEFFICIENTS), exist_ok=True)
+            with open(os.path.join(base, ID_INFO), "w") as f:
+                f.write(model.feature_shard_id + "\n")
+            index_map = index_maps[model.feature_shard_id]
+            avro_io.write_container(
+                os.path.join(base, COEFFICIENTS, "part-00000.avro"),
+                schemas.BAYESIAN_LINEAR_MODEL_AVRO,
+                [_glm_to_record(name, model.glm, index_map, sparsity_threshold)],
+            )
+        elif isinstance(model, RandomEffectModel):
+            base = os.path.join(output_dir, RANDOM_EFFECT, name)
+            os.makedirs(os.path.join(base, COEFFICIENTS), exist_ok=True)
+            with open(os.path.join(base, ID_INFO), "w") as f:
+                f.write(model.random_effect_type + "\n")
+                f.write(model.feature_shard_id + "\n")
+            index_map = index_maps[model.feature_shard_id]
+            table = np.asarray(model.coefficients)
+            keys = [str(k) for k in np.asarray(model.entity_keys).tolist()]
+
+            def records() -> Iterable[dict]:
+                for i, key in enumerate(keys):
+                    glm = GeneralizedLinearModel(
+                        Coefficients(means=table[i]), model.task
+                    )
+                    yield _glm_to_record(key, glm, index_map, sparsity_threshold)
+
+            # chunk into part files (reference randomEffectModelFileLimit)
+            it = iter(records())
+            part = 0
+            while True:
+                chunk = []
+                for record in it:
+                    chunk.append(record)
+                    if len(chunk) >= random_effect_records_per_file:
+                        break
+                if not chunk:
+                    break
+                avro_io.write_container(
+                    os.path.join(base, COEFFICIENTS, f"part-{part:05d}.avro"),
+                    schemas.BAYESIAN_LINEAR_MODEL_AVRO,
+                    chunk,
+                )
+                part += 1
+        else:
+            raise TypeError(f"cannot save coordinate '{name}' of type {type(model)}")
+
+
+def load_game_model(
+    models_dir: str | os.PathLike,
+    index_maps: Mapping[str, IndexMap],
+    *,
+    coordinates_to_load: set[str] | None = None,
+    dtype=np.float32,
+) -> GameModel:
+    """Load a GAME model saved in the reference layout."""
+    models_dir = str(models_dir)
+    meta_path = os.path.join(models_dir, METADATA_FILE)
+    task = TaskType.NONE
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        task = TaskType(meta.get("modelType", "NONE"))
+
+    models: dict[str, object] = {}
+
+    fe_dir = os.path.join(models_dir, FIXED_EFFECT)
+    if os.path.isdir(fe_dir):
+        for name in sorted(os.listdir(fe_dir)):
+            if coordinates_to_load is not None and name not in coordinates_to_load:
+                continue
+            base = os.path.join(fe_dir, name)
+            with open(os.path.join(base, ID_INFO)) as f:
+                shard_id = f.read().strip().splitlines()[0]
+            if shard_id not in index_maps:
+                raise ValueError(
+                    f"missing feature shard definition '{shard_id}' for coordinate '{name}'"
+                )
+            index_map = index_maps[shard_id]
+            records = list(avro_io.read_directory(os.path.join(base, COEFFICIENTS)))
+            if len(records) != 1:
+                raise ValueError(f"expected 1 fixed-effect record for '{name}', got {len(records)}")
+            record = records[0]
+            model_task = _CLASS_TO_TASK.get(record.get("modelClass"), task)
+            glm = GeneralizedLinearModel(
+                _record_to_coefficients(record, index_map, dtype), model_task
+            )
+            models[name] = FixedEffectModel(glm=glm, feature_shard_id=shard_id)
+
+    re_dir = os.path.join(models_dir, RANDOM_EFFECT)
+    if os.path.isdir(re_dir):
+        for name in sorted(os.listdir(re_dir)):
+            if coordinates_to_load is not None and name not in coordinates_to_load:
+                continue
+            base = os.path.join(re_dir, name)
+            with open(os.path.join(base, ID_INFO)) as f:
+                lines = f.read().strip().splitlines()
+            re_type, shard_id = lines[0], lines[1]
+            if shard_id not in index_maps:
+                raise ValueError(
+                    f"missing feature shard definition '{shard_id}' for coordinate '{name}'"
+                )
+            index_map = index_maps[shard_id]
+            records = list(avro_io.read_directory(os.path.join(base, COEFFICIENTS)))
+            keys = sorted(r["modelId"] for r in records)
+            row = {k: i for i, k in enumerate(keys)}
+            table = np.zeros((len(keys), index_map.size), dtype=dtype)
+            model_task = task
+            for record in records:
+                coeffs = _record_to_coefficients(record, index_map, dtype)
+                table[row[record["modelId"]]] = np.asarray(coeffs.means)
+                model_task = _CLASS_TO_TASK.get(record.get("modelClass"), model_task)
+            models[name] = RandomEffectModel(
+                coefficients=jnp.asarray(table),
+                entity_keys=np.asarray(keys),
+                random_effect_type=re_type,
+                feature_shard_id=shard_id,
+                task=model_task,
+            )
+
+    if not models:
+        raise ValueError(f"No models could be loaded from given path: {models_dir}")
+    return GameModel(models=models)
+
+
+def write_glm_text(
+    path: str | os.PathLike,
+    models: Mapping[float, GeneralizedLinearModel],
+    index_map: IndexMap,
+) -> None:
+    """Per-λ text model dump (reference IOUtils.writeModelsInText: one file
+    per regularization weight, 'name\\tterm\\tvalue' lines)."""
+    os.makedirs(path, exist_ok=True)
+    for lam, glm in models.items():
+        means = np.asarray(glm.coefficients.means)
+        with open(os.path.join(str(path), f"{lam}.txt"), "w", encoding="utf-8") as f:
+            for j in np.argsort(-np.abs(means)):
+                key = index_map.get_feature_name(int(j))
+                if key is None:
+                    continue
+                name, term = split_feature_key(key)
+                f.write(f"{name}\t{term}\t{float(means[j])!r}\n")
+
+
+def write_feature_stats(
+    path: str | os.PathLike,
+    stats: Mapping[str, np.ndarray],
+    index_map: IndexMap,
+) -> None:
+    """Feature summary as FeatureSummarizationResultAvro (reference
+    ModelProcessingUtils.writeBasicStatistics:515-586)."""
+    metrics_per_feature = {}
+    d = index_map.size
+    for metric, values in stats.items():
+        arr = np.asarray(values)
+        if arr.ndim == 1 and arr.shape[0] == d:
+            metrics_per_feature[metric] = arr
+
+    def records():
+        for j in range(d):
+            key = index_map.get_feature_name(j)
+            if key is None:
+                continue
+            name, term = split_feature_key(key)
+            yield {
+                "featureName": name,
+                "featureTerm": term,
+                "metrics": {m: float(v[j]) for m, v in metrics_per_feature.items()},
+            }
+
+    os.makedirs(os.path.dirname(str(path)) or ".", exist_ok=True)
+    avro_io.write_container(path, schemas.FEATURE_SUMMARIZATION_RESULT_AVRO, records())
+
+
+def write_scores(
+    path: str | os.PathLike,
+    scores: np.ndarray,
+    *,
+    model_id: str = "",
+    uids: np.ndarray | None = None,
+    labels: np.ndarray | None = None,
+    weights: np.ndarray | None = None,
+) -> None:
+    """Scored-item output as ScoringResultAvro (reference
+    ScoreProcessingUtils.saveScoredItemsToHDFS)."""
+    n = len(scores)
+
+    def records():
+        for i in range(n):
+            yield {
+                "uid": None if uids is None else str(uids[i]),
+                "label": None if labels is None else float(labels[i]),
+                "modelId": model_id,
+                "predictionScore": float(scores[i]),
+                "weight": None if weights is None else float(weights[i]),
+                "metadataMap": None,
+            }
+
+    os.makedirs(os.path.dirname(str(path)) or ".", exist_ok=True)
+    avro_io.write_container(path, schemas.SCORING_RESULT_AVRO, records())
+
+
+def read_scores(path: str | os.PathLike) -> list[dict]:
+    return list(avro_io.read_directory(path))

@@ -1,0 +1,324 @@
+"""Mesh-sharded full-GAME training step: one jitted SPMD program.
+
+This is the TPU replacement for the reference's entire distributed training
+round (photon-api algorithm/FixedEffectCoordinate.scala:91-165 treeAggregate
+optimization + algorithm/RandomEffectCoordinate.scala:104-153 per-entity RDD
+solves + photon-lib algorithm/CoordinateDescent.scala:198-255 residual
+choreography). One call = one full block-coordinate-descent sweep:
+
+    FE solve (samples sharded over "data", features optionally over "model")
+    -> residual score update
+    -> per-RE-type vmapped entity solves (entities sharded over "data")
+    -> residual score updates
+    -> final training loss
+
+Everything lives inside a single jit, so XLA inserts every collective:
+gradient psums over the "data" axis where Spark ran treeAggregate, feature-
+axis reduce-scatters/all-gathers over "model" where the reference broadcast
+the coefficient vector, and gather/scatter collectives where the reference
+ran RDD joins. Multi-host pods: build the mesh over all processes' devices
+after jax.distributed.initialize; the same program then spans ICI + DCN.
+
+Sharding convention (parallel/mesh.py): axis "data" carries both sample-DP
+and entity-parallelism (the "EP" of this model family, SURVEY.md §2.5);
+axis "model" carries the feature axis of giant fixed-effect coordinates
+(the tensor-parallel analogue — 1B-coefficient FE vectors, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Mapping, Sequence
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.algorithm.coordinates import solve_entity_bucket
+from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.data.game_data import GameDataset, RandomEffectDataset
+from photon_ml_tpu.models.game import score_random_effect
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.optim.optimizer import OptimizerConfig, solve
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@flax.struct.dataclass
+class GameTrainState:
+    """Device-resident model state for one training step.
+
+    fe_coefficients: [d_fe] — the fixed-effect coefficient vector; shard its
+        (only) axis over "model" for giant coordinates, replicate otherwise.
+    re_tables: RE type -> [num_entities, d_re] coefficient table; the entity
+        axis shards over "data".
+    """
+
+    fe_coefficients: Array
+    re_tables: dict[str, Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectStepSpec:
+    """Static description of one RE coordinate inside the fused step."""
+
+    re_type: str
+    feature_shard_id: str
+    optimizer: OptimizerConfig
+    l2_weight: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectStepSpec:
+    feature_shard_id: str
+    optimizer: OptimizerConfig
+    l2_weight: float = 0.0
+
+
+def _data_pytree(dataset: GameDataset, re_specs: Sequence[RandomEffectStepSpec],
+                 fe_shard: str) -> dict:
+    shards = {fe_shard} | {s.feature_shard_id for s in re_specs}
+    return {
+        "labels": jnp.asarray(dataset.labels),
+        "offsets": jnp.asarray(dataset.offsets),
+        "weights": jnp.asarray(dataset.weights),
+        "features": {k: jnp.asarray(dataset.feature_shards[k]) for k in shards},
+        "entity_idx": {
+            s.re_type: jnp.asarray(dataset.entity_idx[s.re_type]) for s in re_specs
+        },
+    }
+
+
+def _buckets_pytree(re_datasets: Mapping[str, RandomEffectDataset]) -> dict:
+    return {
+        k: [
+            {
+                "features": b.features,
+                "labels": b.labels,
+                "weights": b.weights,
+                "sample_rows": b.sample_rows,
+                "entity_rows": b.entity_rows,
+            }
+            for b in ds.buckets
+        ]
+        for k, ds in re_datasets.items()
+    }
+
+
+class GameTrainProgram:
+    """A compiled full-GAME training step bound to static specs.
+
+    Build once per (task, coordinate specs); call ``step`` repeatedly — the
+    jitted program is cached. Use ``shard_inputs`` to lay data and state out
+    over a mesh first; the same program runs single-chip when no mesh is
+    given (the SPMD partitioner simply sees one device).
+    """
+
+    def __init__(
+        self,
+        task: TaskType,
+        fe: FixedEffectStepSpec,
+        re_specs: Sequence[RandomEffectStepSpec] = (),
+        *,
+        normalization: NormalizationContext | None = None,
+    ):
+        self.task = task
+        self.fe = fe
+        self.re_specs = tuple(re_specs)
+        loss = loss_for_task(task)
+        self._loss = loss
+        self.normalization = normalization
+        self._fe_objective = GLMObjective(loss, l2_weight=fe.l2_weight,
+                                          normalization=normalization)
+        self._re_objectives = {
+            s.re_type: GLMObjective(loss, l2_weight=s.l2_weight)
+            for s in self.re_specs
+        }
+        self._step = jax.jit(self._step_impl)
+
+    def fe_coefficients_model_space(self, state: GameTrainState,
+                                    intercept_index: int | None = None) -> Array:
+        """Convert the state's normalized-space FE vector to original feature
+        space for persistence/scoring outside the step."""
+        return self._fe_objective.normalization.to_model_space(
+            state.fe_coefficients, intercept_index
+        )
+
+    # -- state / input preparation ------------------------------------------
+
+    def init_state(self, dataset: GameDataset,
+                   re_datasets: Mapping[str, RandomEffectDataset],
+                   dtype=None) -> GameTrainState:
+        fe_dim = dataset.feature_shards[self.fe.feature_shard_id].shape[1]
+        dtype = dtype or dataset.feature_shards[self.fe.feature_shard_id].dtype
+        tables = {
+            s.re_type: jnp.zeros(
+                (re_datasets[s.re_type].num_entities, re_datasets[s.re_type].dim),
+                dtype=dtype,
+            )
+            for s in self.re_specs
+        }
+        return GameTrainState(
+            fe_coefficients=jnp.zeros((fe_dim,), dtype=dtype), re_tables=tables
+        )
+
+    def prepare_inputs(self, dataset: GameDataset,
+                       re_datasets: Mapping[str, RandomEffectDataset]):
+        data = _data_pytree(dataset, self.re_specs, self.fe.feature_shard_id)
+        buckets = _buckets_pytree(
+            {s.re_type: re_datasets[s.re_type] for s in self.re_specs}
+        )
+        return data, buckets
+
+    def shard_inputs(self, mesh: Mesh, data, buckets, state,
+                     *, fe_feature_sharded: bool = False):
+        """Lay out inputs over the mesh: samples and entities over "data",
+        FE features (and coefficient vector) over "model" when requested."""
+        vec = NamedSharding(mesh, P("data"))
+        rep = NamedSharding(mesh, P())
+        fe_fspec = P("data", "model") if fe_feature_sharded else P("data", None)
+
+        def put_feats(shard_id, arr):
+            spec = fe_fspec if shard_id == self.fe.feature_shard_id else P("data", None)
+            return jax.device_put(arr, NamedSharding(mesh, spec))
+
+        data = dict(data)
+        data["labels"] = jax.device_put(data["labels"], vec)
+        data["offsets"] = jax.device_put(data["offsets"], vec)
+        data["weights"] = jax.device_put(data["weights"], vec)
+        data["features"] = {k: put_feats(k, v) for k, v in data["features"].items()}
+        data["entity_idx"] = {k: jax.device_put(v, vec) for k, v in data["entity_idx"].items()}
+
+        ent3 = NamedSharding(mesh, P("data", None, None))
+        ent2 = NamedSharding(mesh, P("data", None))
+        ent1 = NamedSharding(mesh, P("data"))
+        buckets = {
+            k: [
+                {
+                    "features": jax.device_put(b["features"], ent3),
+                    "labels": jax.device_put(b["labels"], ent2),
+                    "weights": jax.device_put(b["weights"], ent2),
+                    "sample_rows": jax.device_put(b["sample_rows"], ent2),
+                    "entity_rows": jax.device_put(b["entity_rows"], ent1),
+                }
+                for b in bs
+            ]
+            for k, bs in buckets.items()
+        }
+        fe_sharding = NamedSharding(mesh, P("model")) if fe_feature_sharded else rep
+        state = GameTrainState(
+            fe_coefficients=jax.device_put(state.fe_coefficients, fe_sharding),
+            re_tables={
+                k: jax.device_put(v, ent2) for k, v in state.re_tables.items()
+            },
+        )
+        return data, buckets, state
+
+    # -- the fused step ------------------------------------------------------
+
+    def step(self, data, buckets, state: GameTrainState):
+        """One full CD sweep. Returns (new_state, training_loss)."""
+        return self._step(data, buckets, state)
+
+    def _step_impl(self, data, buckets, state: GameTrainState):
+        feats = data["features"]
+        labels, weights = data["labels"], data["weights"]
+        base_offsets = data["offsets"]
+        fe_x = feats[self.fe.feature_shard_id]
+
+        re_scores = {
+            s.re_type: score_random_effect(
+                state.re_tables[s.re_type],
+                feats[s.feature_shard_id],
+                data["entity_idx"][s.re_type],
+            )
+            for s in self.re_specs
+        }
+
+        def sum_scores(skip=None):
+            total = jnp.zeros_like(base_offsets)
+            for k, v in re_scores.items():
+                if k != skip:
+                    total = total + v
+            return total
+
+        # ---- fixed-effect coordinate (samples sharded; grads psum over mesh)
+        fe_batch = LabeledPointBatch(
+            features=fe_x,
+            labels=labels,
+            offsets=base_offsets + sum_scores(),
+            weights=weights,
+        )
+        fe_result = solve(
+            self.fe.optimizer, self._fe_objective.bind(fe_batch), state.fe_coefficients
+        )
+        fe_w = fe_result.coefficients
+        # fe_w lives in normalized space (warm starts stay there across steps);
+        # score through the same effective-coefficient algebra the objective
+        # uses so residuals and the loss are in original data space.
+        norm = self._fe_objective.normalization
+        eff = norm.effective_coefficients(fe_w)
+        fe_score = fe_x @ eff - norm.margin_shift(eff)
+
+        # ---- random-effect coordinates (entities sharded, vmapped solves)
+        tables = dict(state.re_tables)
+        for spec in self.re_specs:
+            k = spec.re_type
+            full_offsets = base_offsets + fe_score + sum_scores(skip=k)
+            table = tables[k]
+            objective = self._re_objectives[k]
+            for b in buckets[k]:
+                table = solve_entity_bucket(
+                    objective,
+                    spec.optimizer,
+                    b["features"],
+                    b["labels"],
+                    b["weights"],
+                    b["sample_rows"],
+                    b["entity_rows"],
+                    full_offsets,
+                    table,
+                )
+            tables[k] = table
+            re_scores[k] = score_random_effect(
+                table, feats[spec.feature_shard_id], data["entity_idx"][k]
+            )
+
+        total_margin = base_offsets + fe_score + sum_scores()
+        losses = self._loss.loss(total_margin, labels)
+        wsum = jnp.maximum(jnp.sum(weights), 1.0)
+        train_loss = jnp.sum(weights * losses) / wsum
+        return GameTrainState(fe_coefficients=fe_w, re_tables=tables), train_loss
+
+
+def train_distributed(
+    program: GameTrainProgram,
+    dataset: GameDataset,
+    re_datasets: Mapping[str, RandomEffectDataset],
+    *,
+    mesh: Mesh | None = None,
+    num_iterations: int = 1,
+    fe_feature_sharded: bool = False,
+    state: GameTrainState | None = None,
+):
+    """Run ``num_iterations`` fused CD sweeps, optionally mesh-sharded.
+
+    Returns (final_state, [loss per sweep]).
+    """
+    data, buckets = program.prepare_inputs(dataset, re_datasets)
+    if state is None:
+        state = program.init_state(dataset, re_datasets)
+    if mesh is not None:
+        data, buckets, state = program.shard_inputs(
+            mesh, data, buckets, state, fe_feature_sharded=fe_feature_sharded
+        )
+    losses = []
+    for _ in range(num_iterations):
+        state, loss = program.step(data, buckets, state)
+        losses.append(float(loss))
+    return state, losses

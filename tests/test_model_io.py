@@ -1,0 +1,122 @@
+"""Model persistence round-trip tests (reference analogue:
+ModelProcessingUtilsIntegTest, ScoreProcessingUtilsIntegTest)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.io.index_map import IndexMap, feature_key
+from photon_ml_tpu.io.model_io import (
+    load_game_model,
+    read_scores,
+    save_game_model,
+    write_feature_stats,
+    write_glm_text,
+    write_scores,
+)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.types import TaskType
+
+
+def _index_map(d):
+    return IndexMap.from_name_terms([(f"f{j}", "t") for j in range(d)])
+
+
+def test_game_model_round_trip(tmp_path):
+    d = 6
+    imap = _index_map(d)
+    rng = np.random.default_rng(0)
+    fe = FixedEffectModel(
+        glm=GeneralizedLinearModel(
+            Coefficients(
+                means=jnp.asarray(rng.normal(size=d), dtype=jnp.float64),
+                variances=jnp.asarray(rng.uniform(0.1, 1.0, size=d), dtype=jnp.float64),
+            ),
+            TaskType.LOGISTIC_REGRESSION,
+        ),
+        feature_shard_id="global",
+    )
+    keys = np.array(["u1", "u2", "u3"])
+    re = RandomEffectModel(
+        coefficients=jnp.asarray(rng.normal(size=(3, d)), dtype=jnp.float64),
+        entity_keys=keys,
+        random_effect_type="user",
+        feature_shard_id="global",
+        task=TaskType.LOGISTIC_REGRESSION,
+    )
+    model = GameModel(models={"fixed": fe, "per-user": re})
+    out = tmp_path / "model"
+    save_game_model(out, model, {"global": imap}, sparsity_threshold=0.0)
+
+    assert (out / "model-metadata.json").exists()
+    assert (out / "fixed-effect" / "fixed" / "id-info").exists()
+    assert (out / "random-effect" / "per-user" / "id-info").exists()
+
+    back = load_game_model(out, {"global": imap}, dtype=np.float64)
+    assert set(back.models) == {"fixed", "per-user"}
+    np.testing.assert_allclose(
+        np.asarray(back.models["fixed"].glm.coefficients.means),
+        np.asarray(fe.glm.coefficients.means),
+    )
+    np.testing.assert_allclose(
+        np.asarray(back.models["fixed"].glm.coefficients.variances),
+        np.asarray(fe.glm.coefficients.variances),
+    )
+    assert back.models["fixed"].glm.task == TaskType.LOGISTIC_REGRESSION
+    re_back = back.models["per-user"]
+    assert re_back.random_effect_type == "user"
+    assert list(re_back.entity_keys) == ["u1", "u2", "u3"]
+    np.testing.assert_allclose(
+        np.asarray(re_back.coefficients), np.asarray(re.coefficients)
+    )
+
+
+def test_sparsity_threshold(tmp_path):
+    imap = _index_map(3)
+    fe = FixedEffectModel(
+        glm=GeneralizedLinearModel(
+            Coefficients(means=jnp.asarray([0.5, 1e-9, -0.25])),
+            TaskType.LINEAR_REGRESSION,
+        ),
+        feature_shard_id="s",
+    )
+    save_game_model(tmp_path / "m", GameModel(models={"fixed": fe}), {"s": imap},
+                    sparsity_threshold=1e-4)
+    back = load_game_model(tmp_path / "m", {"s": imap})
+    means = np.asarray(back.models["fixed"].glm.coefficients.means)
+    assert means[1] == 0.0  # dropped below threshold
+    assert means[0] == np.float32(0.5)
+
+
+def test_scores_round_trip(tmp_path):
+    scores = np.array([0.1, 0.9, 0.5])
+    write_scores(tmp_path / "scores.avro", scores, model_id="m1",
+                 uids=np.array([10, 11, 12]), labels=np.array([0.0, 1.0, 1.0]))
+    back = read_scores(tmp_path / "scores.avro")
+    assert [r["predictionScore"] for r in back] == [0.1, 0.9, 0.5]
+    assert back[0]["uid"] == "10"
+    assert back[2]["label"] == 1.0
+
+
+def test_text_and_stats_writers(tmp_path):
+    imap = _index_map(3)
+    models = {
+        0.1: GeneralizedLinearModel(
+            Coefficients(means=jnp.asarray([1.0, -2.0, 0.5])), TaskType.LINEAR_REGRESSION
+        )
+    }
+    write_glm_text(tmp_path / "text", models, imap)
+    content = (tmp_path / "text" / "0.1.txt").read_text()
+    lines = content.strip().splitlines()
+    assert lines[0].startswith("f1\tt\t-2.0")  # sorted by |coef|
+
+    stats = {"mean": np.array([0.0, 1.0, 2.0]), "variance": np.ones(3)}
+    write_feature_stats(tmp_path / "stats.avro", stats, imap)
+    from photon_ml_tpu.io.avro import read_container
+
+    records = list(read_container(tmp_path / "stats.avro"))
+    assert len(records) == 3
+    assert records[1]["metrics"]["mean"] == 1.0
