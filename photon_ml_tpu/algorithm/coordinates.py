@@ -25,8 +25,10 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.sampling import down_sampler_for_task
 from photon_ml_tpu.data.game_data import GameDataset, RandomEffectDataset
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.game import (
@@ -113,6 +115,7 @@ class FixedEffectCoordinate(Coordinate):
     config: CoordinateOptimizationConfig
     normalization: NormalizationContext | None = None
     intercept_index: int | None = None
+    _update_count: int = dataclasses.field(default=0, init=False, repr=False)
 
     def initial_model(self) -> FixedEffectModel:
         shard = self.dataset.feature_shards[self.feature_shard_id]
@@ -125,6 +128,21 @@ class FixedEffectCoordinate(Coordinate):
 
     def update_model(self, model: FixedEffectModel, extra_offsets: Array | None = None):
         batch = self.dataset.fixed_effect_batch(self.feature_shard_id, extra_offsets)
+        if self.config.down_sampling_rate < 1.0:
+            # Training-only thinning via weight zeroing (reference
+            # DistributedOptimizationProblem.runWithSampling:145-160); scoring
+            # below still covers every sample. The seed rotates per update so
+            # excluded rows differ across coordinate-descent iterations, like
+            # the reference's per-update random seed — but deterministically.
+            sampler = down_sampler_for_task(self.task, self.config.down_sampling_rate)
+            new_w = sampler.down_sample_weights(
+                np.asarray(self.dataset.labels),
+                np.asarray(self.dataset.weights),
+                self.dataset.unique_ids,
+                seed=self._update_count,
+            )
+            self._update_count += 1
+            batch = batch.replace(weights=jnp.asarray(new_w, dtype=batch.weights.dtype))
         objective = _make_objective(self.task, self.config, self.normalization)
         norm = objective.normalization
         w0 = norm.from_model_space(model.glm.coefficients.means, self.intercept_index)

@@ -27,7 +27,6 @@ TPU-native redesign (SURVEY.md §7):
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 from typing import Mapping, Sequence
 
 import jax
@@ -35,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.sampling.down_sampler import stable_uniform
 
 Array = jax.Array
 
@@ -124,13 +124,11 @@ class RandomEffectDataset:
         return sum(b.num_entities for b in self.buckets)
 
 
-def _stable_priority(sample_id: int, seed: int) -> int:
-    """Deterministic per-sample priority for reservoir sampling, stable under
-    recompute (fixes RandomEffectDataSet.scala:389-395)."""
-    h = hashlib.blake2b(
-        f"{seed}:{sample_id}".encode(), digest_size=8
-    ).digest()
-    return int.from_bytes(h, "little")
+def _stable_priorities(sample_ids: np.ndarray, seed: int) -> np.ndarray:
+    """Deterministic per-sample priorities for reservoir sampling, stable
+    under recompute (fixes RandomEffectDataSet.scala:389-395). Vectorized
+    via the same splitmix64 keying the down-samplers."""
+    return stable_uniform(sample_ids, seed)
 
 
 def build_random_effect_dataset(
@@ -183,9 +181,7 @@ def build_random_effect_dataset(
         cap = min(active_data_upper_bound or max_bucket, max_bucket)
         if count > cap:
             # stable reservoir: keep the `cap` samples with smallest priority
-            prio = np.array(
-                [_stable_priority(int(unique_ids[r]), seed) for r in sample_rows]
-            )
+            prio = _stable_priorities(unique_ids[sample_rows], seed)
             keep = np.argsort(prio, kind="stable")[:cap]
             sample_rows = sample_rows[np.sort(keep)]
             count = cap

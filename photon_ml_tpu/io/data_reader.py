@@ -16,6 +16,7 @@ grouping and per-query evaluation.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import os
 from typing import Iterable, Iterator, Mapping, Sequence
 
@@ -163,7 +164,11 @@ def records_to_game_dataset(
         try:
             uids.append(int(uid) if uid is not None else n)
         except ValueError:
-            uids.append(n)
+            # Non-numeric uid: hash the string into a disjoint id space so a
+            # fallback can't collide with a genuine numeric uid of another row
+            # (stable ids feed reservoir/down-sampling hashes).
+            digest = hashlib.blake2b(str(uid).encode(), digest_size=8).digest()
+            uids.append(int.from_bytes(digest, "little", signed=True) | (1 << 62))
 
         meta = record.get(META_DATA_MAP) or {}
         for col in id_cols:
@@ -244,9 +249,15 @@ def read_merged(
         raise ValueError(f"unknown format {fmt!r}")
 
     if index_maps is None:
-        index_maps = build_index_maps(records(), shard_configs)
+        # Decode once: index-map construction and dataset assembly both scan
+        # every record, and assembly materializes the data anyway.
+        materialized = list(records())
+        index_maps = build_index_maps(materialized, shard_configs)
+        record_source = materialized
+    else:
+        record_source = records()
     return records_to_game_dataset(
-        records(),
+        record_source,
         shard_configs,
         index_maps,
         random_effect_id_columns=random_effect_id_columns,

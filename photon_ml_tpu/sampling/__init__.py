@@ -1,0 +1,15 @@
+"""Down-sampling (reference photon-lib sampling/*.scala)."""
+
+from photon_ml_tpu.sampling.down_sampler import (
+    BinaryClassificationDownSampler,
+    DefaultDownSampler,
+    DownSampler,
+    down_sampler_for_task,
+)
+
+__all__ = [
+    "BinaryClassificationDownSampler",
+    "DefaultDownSampler",
+    "DownSampler",
+    "down_sampler_for_task",
+]

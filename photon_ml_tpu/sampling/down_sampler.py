@@ -1,0 +1,104 @@
+"""Deterministic down-sampling as a weight transform.
+
+Reference parity: photon-lib sampling/DownSampler.scala,
+sampling/DefaultDownSampler.scala (uniform sample of all rows, no
+reweighting), sampling/BinaryClassificationDownSampler.scala:31-68 (keep
+every positive, thin negatives at ``rate`` and rescale their weights by
+1/rate so the effective class balance of the objective is unchanged).
+
+TPU-native redesign: the reference filters RDD rows; a jitted program wants
+fixed shapes, so down-sampling here *zeroes weights* instead of dropping
+rows — a zero-weight sample contributes nothing to any weighted aggregate
+(data/batch.py), which is exactly the semantics of removal, and the batch
+keeps its compiled shape. Selection is keyed on stable sample ids via a
+splitmix64 hash, so the same (ids, seed) always selects the same rows —
+no RDD-recompute instability (cf. RandomEffectDataSet.scala:389-395).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from photon_ml_tpu.types import TaskType
+
+_U64 = np.uint64
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer: uint64 -> well-mixed uint64."""
+    x = x.astype(_U64)
+    with np.errstate(over="ignore"):
+        x = (x + _U64(0x9E3779B97F4A7C15)) & _U64(0xFFFFFFFFFFFFFFFF)
+        x = ((x ^ (x >> _U64(30))) * _U64(0xBF58476D1CE4E5B9)) & _U64(0xFFFFFFFFFFFFFFFF)
+        x = ((x ^ (x >> _U64(27))) * _U64(0x94D049BB133111EB)) & _U64(0xFFFFFFFFFFFFFFFF)
+        x = x ^ (x >> _U64(31))
+    return x
+
+
+def stable_uniform(unique_ids: np.ndarray, seed: int) -> np.ndarray:
+    """Deterministic per-sample uniform in [0, 1) keyed on (id, seed)."""
+    ids = np.asarray(unique_ids).astype(np.int64).view(_U64)
+    mixed = _splitmix64(ids ^ _splitmix64(np.full_like(ids, seed, dtype=_U64)))
+    return (mixed >> _U64(11)).astype(np.float64) * (1.0 / float(1 << 53))
+
+
+@dataclasses.dataclass(frozen=True)
+class DownSampler:
+    """Base: subclasses return a per-sample weight multiplier array.
+
+    ``down_sample_weights`` maps (labels, weights, ids) -> new weights with
+    dropped rows at 0; callers multiply into the batch/dataset weights.
+    """
+
+    down_sampling_rate: float
+
+    def __post_init__(self):
+        if not (0.0 < self.down_sampling_rate < 1.0):
+            raise ValueError(
+                f"down-sampling rate must be in (0, 1), got {self.down_sampling_rate}"
+            )
+
+    def down_sample_weights(
+        self, labels: np.ndarray, weights: np.ndarray, unique_ids: np.ndarray, seed: int = 0
+    ) -> np.ndarray:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class DefaultDownSampler(DownSampler):
+    """Uniform sampling of all rows with weights left untouched — the
+    reference's DefaultDownSampler is a plain RDD.sample with no
+    reweighting, so the effective data term shrinks by ``rate`` relative to
+    any fixed regularization weight; matched here for config parity."""
+
+    def down_sample_weights(self, labels, weights, unique_ids, seed: int = 0) -> np.ndarray:
+        weights = np.asarray(weights, dtype=np.float64)
+        keep = stable_uniform(unique_ids, seed) < self.down_sampling_rate
+        return np.where(keep, weights, 0.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryClassificationDownSampler(DownSampler):
+    """Keep all positives; sample negatives at ``rate`` with weights
+    rescaled by 1/rate (reference BinaryClassificationDownSampler.scala:31-68)."""
+
+    def down_sample_weights(self, labels, weights, unique_ids, seed: int = 0) -> np.ndarray:
+        labels = np.asarray(labels, dtype=np.float64)
+        weights = np.asarray(weights, dtype=np.float64)
+        positive = labels > 0.5
+        keep_neg = stable_uniform(unique_ids, seed) < self.down_sampling_rate
+        return np.where(
+            positive,
+            weights,
+            np.where(keep_neg, weights / self.down_sampling_rate, 0.0),
+        )
+
+
+def down_sampler_for_task(task: TaskType, rate: float) -> DownSampler:
+    """Factory matching the reference's DownSamplerHelper: classification
+    tasks thin only negatives; regression tasks sample uniformly."""
+    if task in (TaskType.LOGISTIC_REGRESSION, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+        return BinaryClassificationDownSampler(rate)
+    return DefaultDownSampler(rate)

@@ -1,0 +1,120 @@
+"""Down-sampling tests (reference sampling/*DownSampler*.scala test intent)."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.sampling import (
+    BinaryClassificationDownSampler,
+    DefaultDownSampler,
+    down_sampler_for_task,
+)
+from photon_ml_tpu.sampling.down_sampler import stable_uniform
+from photon_ml_tpu.types import TaskType
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(7)
+    n = 20_000
+    labels = (rng.uniform(size=n) < 0.3).astype(np.float64)
+    weights = rng.uniform(0.5, 2.0, size=n)
+    ids = np.arange(n, dtype=np.int64)
+    return labels, weights, ids
+
+
+def test_stable_uniform_deterministic_and_uniform():
+    ids = np.arange(50_000, dtype=np.int64)
+    u1 = stable_uniform(ids, seed=3)
+    u2 = stable_uniform(ids, seed=3)
+    np.testing.assert_array_equal(u1, u2)
+    assert not np.array_equal(u1, stable_uniform(ids, seed=4))
+    assert 0.0 <= u1.min() and u1.max() < 1.0
+    # roughly uniform
+    assert abs(u1.mean() - 0.5) < 0.01
+
+
+def test_default_down_sampler_rate_no_reweighting(data):
+    labels, weights, ids = data
+    sampler = DefaultDownSampler(0.25)
+    new_w = sampler.down_sample_weights(labels, weights, ids)
+    kept = new_w > 0
+    assert abs(kept.mean() - 0.25) < 0.02
+    # reference DefaultDownSampler is a plain sample: kept weights untouched
+    np.testing.assert_array_equal(new_w[kept], weights[kept])
+
+
+def test_seed_rotates_selection(data):
+    labels, weights, ids = data
+    sampler = DefaultDownSampler(0.25)
+    w0 = sampler.down_sample_weights(labels, weights, ids, seed=0)
+    w1 = sampler.down_sample_weights(labels, weights, ids, seed=1)
+    assert not np.array_equal(w0 > 0, w1 > 0)
+
+
+def test_binary_down_sampler_keeps_positives(data):
+    labels, weights, ids = data
+    sampler = BinaryClassificationDownSampler(0.1)
+    new_w = sampler.down_sample_weights(labels, weights, ids)
+    pos = labels > 0.5
+    np.testing.assert_array_equal(new_w[pos], weights[pos])
+    kept_neg = (new_w > 0) & ~pos
+    assert abs(kept_neg.sum() / (~pos).sum() - 0.1) < 0.02
+    # negative total weight preserved in expectation
+    assert abs(new_w[~pos].sum() / weights[~pos].sum() - 1.0) < 0.07
+
+
+def test_down_sampler_deterministic(data):
+    labels, weights, ids = data
+    s = BinaryClassificationDownSampler(0.5)
+    np.testing.assert_array_equal(
+        s.down_sample_weights(labels, weights, ids),
+        s.down_sample_weights(labels, weights, ids),
+    )
+
+
+def test_factory_and_validation():
+    assert isinstance(
+        down_sampler_for_task(TaskType.LOGISTIC_REGRESSION, 0.5),
+        BinaryClassificationDownSampler,
+    )
+    assert isinstance(
+        down_sampler_for_task(TaskType.LINEAR_REGRESSION, 0.5), DefaultDownSampler
+    )
+    with pytest.raises(ValueError):
+        DefaultDownSampler(1.0)
+    with pytest.raises(ValueError):
+        DefaultDownSampler(0.0)
+
+
+def test_fixed_effect_coordinate_with_down_sampling():
+    """FE coordinate trains with rate<1 and still produces a usable model."""
+    from photon_ml_tpu.algorithm.coordinates import (
+        CoordinateOptimizationConfig,
+        FixedEffectCoordinate,
+    )
+    from photon_ml_tpu.data.game_data import build_game_dataset
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig
+
+    rng = np.random.default_rng(0)
+    n, d = 4096, 8
+    w_true = rng.normal(size=d)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(x @ w_true)))
+    y = (rng.uniform(size=n) < p).astype(np.float32)
+    ds = build_game_dataset(labels=y, feature_shards={"g": x})
+    coord = FixedEffectCoordinate(
+        coordinate_id="fe",
+        dataset=ds,
+        feature_shard_id="g",
+        task=TaskType.LOGISTIC_REGRESSION,
+        config=CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=50),
+            l2_weight=1e-3,
+            down_sampling_rate=0.5,
+        ),
+    )
+    model, _ = coord.update_model(coord.initial_model())
+    w_fit = np.asarray(model.glm.coefficients.means)
+    # direction of the recovered coefficients matches the truth
+    cos = w_fit @ w_true / (np.linalg.norm(w_fit) * np.linalg.norm(w_true))
+    assert cos > 0.95
