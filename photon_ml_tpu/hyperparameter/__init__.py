@@ -1,0 +1,38 @@
+"""Hyperparameter search (reference photon-lib hyperparameter/**).
+
+Driver-side machinery — like the reference, which runs Sobol/GP search on
+the Spark driver with Breeze, this runs on the host in float64 numpy; each
+candidate evaluation launches full (jitted, TPU) training runs.
+"""
+
+from photon_ml_tpu.hyperparameter.acquisition import (
+    confidence_bound,
+    expected_improvement,
+)
+from photon_ml_tpu.hyperparameter.estimators import (
+    GaussianProcessEstimator,
+    GaussianProcessModel,
+)
+from photon_ml_tpu.hyperparameter.kernels import Matern52, RBF, Kernel
+from photon_ml_tpu.hyperparameter.rescaling import VectorRescaling
+from photon_ml_tpu.hyperparameter.search import (
+    EvaluationFunction,
+    GaussianProcessSearch,
+    RandomSearch,
+)
+from photon_ml_tpu.hyperparameter.slice_sampler import slice_sample
+
+__all__ = [
+    "confidence_bound",
+    "expected_improvement",
+    "GaussianProcessEstimator",
+    "GaussianProcessModel",
+    "Kernel",
+    "Matern52",
+    "RBF",
+    "VectorRescaling",
+    "EvaluationFunction",
+    "GaussianProcessSearch",
+    "RandomSearch",
+    "slice_sample",
+]

@@ -1,0 +1,132 @@
+"""Random (Sobol) and Gaussian-process (Bayesian) hyperparameter search.
+
+Reference parity: photon-lib hyperparameter/search/RandomSearch.scala:33-50
+(Sobol candidate generation in the unit cube, evaluation loop with observed
+and prior-observation seeding) and GaussianProcessSearch.scala (fit GP on
+observations, pick the candidate maximizing expected improvement among a
+fresh batch of Sobol draws, fall back to random until enough observations).
+
+All search state lives in the unit cube [0,1]^d; VectorRescaling maps to and
+from real hyperparameter ranges (log-scale λ grids etc.).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Protocol
+
+import numpy as np
+from scipy.stats import qmc
+
+from photon_ml_tpu.hyperparameter.acquisition import expected_improvement
+from photon_ml_tpu.hyperparameter.estimators import GaussianProcessEstimator
+from photon_ml_tpu.hyperparameter.kernels import Kernel, Matern52
+
+
+class EvaluationFunction(Protocol):
+    """Maps a unit-cube candidate vector to an observed (to-minimize) value.
+
+    Reference: photon-lib hyperparameter/EvaluationFunction.scala — the
+    client glue (GameEstimatorEvaluationFunction) turns the vector into a
+    full GAME training config, runs it, and returns the validation metric.
+    """
+
+    def __call__(self, candidate: np.ndarray) -> float: ...
+
+
+@dataclasses.dataclass
+class Observation:
+    candidate: np.ndarray
+    value: float
+
+
+@dataclasses.dataclass
+class SearchResult:
+    best_candidate: np.ndarray
+    best_value: float
+    observations: list[Observation]
+
+
+class RandomSearch:
+    """Sobol-sequence random search (reference RandomSearch.scala:33-50)."""
+
+    def __init__(self, dim: int, seed: int = 0):
+        self.dim = dim
+        self.seed = seed
+        self._sobol = qmc.Sobol(d=dim, scramble=True, seed=seed)
+        self.observations: list[Observation] = []
+        self.prior_observations: list[Observation] = []
+
+    def draw_candidates(self, n: int) -> np.ndarray:
+        return self._sobol.random(n)
+
+    def next_candidate(self) -> np.ndarray:
+        return self.draw_candidates(1)[0]
+
+    def observe(self, candidate: np.ndarray, value: float) -> None:
+        self.observations.append(Observation(np.asarray(candidate, float), float(value)))
+
+    def observe_prior(self, candidate: np.ndarray, value: float) -> None:
+        """Seed the search with results from earlier runs (reference
+        findWithPriors / observePrior)."""
+        self.prior_observations.append(
+            Observation(np.asarray(candidate, float), float(value))
+        )
+
+    def find(self, evaluation_function: EvaluationFunction, n: int) -> SearchResult:
+        for _ in range(n):
+            cand = self.next_candidate()
+            self.observe(cand, evaluation_function(cand))
+        return self._result()
+
+    def _result(self) -> SearchResult:
+        all_obs = self.observations + self.prior_observations
+        if not all_obs:
+            raise ValueError("no observations recorded")
+        best = min(all_obs, key=lambda o: o.value)
+        return SearchResult(
+            best_candidate=best.candidate,
+            best_value=best.value,
+            observations=list(self.observations),
+        )
+
+
+class GaussianProcessSearch(RandomSearch):
+    """Bayesian search: GP surrogate + expected improvement
+    (reference GaussianProcessSearch.scala)."""
+
+    def __init__(
+        self,
+        dim: int,
+        seed: int = 0,
+        *,
+        kernel: Kernel | None = None,
+        min_observations: int = 3,
+        candidate_pool: int = 250,
+        num_kernel_samples: int = 3,
+        burn_in: int = 8,
+    ):
+        super().__init__(dim, seed)
+        self.kernel = kernel or Matern52()
+        self.min_observations = min_observations
+        self.candidate_pool = candidate_pool
+        self.num_kernel_samples = num_kernel_samples
+        self.burn_in = burn_in
+
+    def next_candidate(self) -> np.ndarray:
+        all_obs = self.observations + self.prior_observations
+        if len(all_obs) < self.min_observations:
+            return super().next_candidate()
+        x = np.stack([o.candidate for o in all_obs])
+        y = np.array([o.value for o in all_obs])
+        estimator = GaussianProcessEstimator(
+            kernel=self.kernel,
+            num_kernel_samples=self.num_kernel_samples,
+            burn_in=self.burn_in,
+            seed=self.seed + len(all_obs),
+        )
+        model = estimator.fit(x, y)
+        pool = self.draw_candidates(self.candidate_pool)
+        mean, var = model.predict(pool)
+        ei = expected_improvement(mean, var, best_value=float(y.min()))
+        return pool[int(np.argmax(ei))]

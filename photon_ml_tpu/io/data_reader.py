@@ -168,7 +168,10 @@ def records_to_game_dataset(
             # fallback can't collide with a genuine numeric uid of another row
             # (stable ids feed reservoir/down-sampling hashes).
             digest = hashlib.blake2b(str(uid).encode(), digest_size=8).digest()
-            uids.append(int.from_bytes(digest, "little", signed=True) | (1 << 62))
+            # mask to 62 bits then tag bit 62: range [2^62, 2^63) is disjoint
+            # from any non-negative numeric uid below 2^62
+            hashed = int.from_bytes(digest, "little") & ((1 << 62) - 1)
+            uids.append(hashed | (1 << 62))
 
         meta = record.get(META_DATA_MAP) or {}
         for col in id_cols:

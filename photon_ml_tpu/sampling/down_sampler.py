@@ -40,7 +40,8 @@ def _splitmix64(x: np.ndarray) -> np.ndarray:
 def stable_uniform(unique_ids: np.ndarray, seed: int) -> np.ndarray:
     """Deterministic per-sample uniform in [0, 1) keyed on (id, seed)."""
     ids = np.asarray(unique_ids).astype(np.int64).view(_U64)
-    mixed = _splitmix64(ids ^ _splitmix64(np.full_like(ids, seed, dtype=_U64)))
+    seed_key = _splitmix64(np.asarray([seed], dtype=np.int64).view(_U64))[0]
+    mixed = _splitmix64(ids ^ seed_key)
     return (mixed >> _U64(11)).astype(np.float64) * (1.0 / float(1 << 53))
 
 

@@ -1,0 +1,227 @@
+"""Hyperparameter search tests (reference photon-lib hyperparameter/** test
+intent: kernels PSD, slice sampler distribution, GP recovery, search finds
+minima, rescaling round trip, GAME tuning glue)."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.hyperparameter import (
+    GaussianProcessEstimator,
+    GaussianProcessSearch,
+    Matern52,
+    RBF,
+    RandomSearch,
+    VectorRescaling,
+    confidence_bound,
+    expected_improvement,
+    slice_sample,
+)
+from photon_ml_tpu.hyperparameter.rescaling import DimensionSpec
+
+
+class TestKernels:
+    def test_psd_and_symmetry(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(30, 4))
+        for kernel in (RBF(amplitude=1.5, noise=1e-3), Matern52(amplitude=0.7, noise=1e-3)):
+            k = kernel(x)
+            np.testing.assert_allclose(k, k.T, atol=1e-12)
+            eigs = np.linalg.eigvalsh(k)
+            assert eigs.min() > 0  # noise jitter keeps it PD
+
+    def test_diagonal_is_amplitude_plus_noise(self):
+        x = np.zeros((3, 2))
+        k = RBF(amplitude=2.0, noise=0.1)(x)
+        np.testing.assert_allclose(np.diag(k), 4.0 + 0.01)
+
+    def test_lengthscale_controls_decay(self):
+        x = np.array([[0.0], [1.0]])
+        near = RBF(lengthscale=10.0)(x)[0, 1]
+        far = RBF(lengthscale=0.1)(x)[0, 1]
+        assert near > 0.99 and far < 1e-5
+
+    def test_cross_covariance_shape(self):
+        k = Matern52()(np.zeros((5, 3)), np.zeros((7, 3)))
+        assert k.shape == (5, 7)
+
+
+class TestSliceSampler:
+    def test_samples_standard_normal(self):
+        rng = np.random.default_rng(1)
+        log_prob = lambda x: float(-0.5 * x @ x)
+        samples = slice_sample(
+            log_prob, np.zeros(1), rng, num_samples=4000, burn_in=100
+        )
+        assert abs(samples.mean()) < 0.1
+        assert abs(samples.std() - 1.0) < 0.1
+
+    def test_respects_support(self):
+        rng = np.random.default_rng(2)
+        log_prob = lambda x: 0.0 if 0 <= x[0] <= 1 else -np.inf
+        samples = slice_sample(log_prob, np.array([0.5]), rng, num_samples=500)
+        assert samples.min() >= 0 and samples.max() <= 1
+        assert abs(samples.mean() - 0.5) < 0.1
+
+
+class TestGP:
+    def test_recovers_smooth_function(self):
+        rng = np.random.default_rng(3)
+        x = rng.uniform(size=(25, 1))
+        y = np.sin(4 * x[:, 0])
+        model = GaussianProcessEstimator(seed=0).fit(x, y)
+        xt = np.linspace(0.05, 0.95, 20)[:, None]
+        mean, var = model.predict(xt)
+        np.testing.assert_allclose(mean, np.sin(4 * xt[:, 0]), atol=0.25)
+        assert np.all(var > 0)
+
+    def test_uncertainty_grows_away_from_data(self):
+        x = np.array([[0.5]])
+        model = GaussianProcessEstimator(seed=0).fit(x, np.array([0.0]))
+        _, var_near = model.predict(np.array([[0.5]]))
+        _, var_far = model.predict(np.array([[5.0]]))
+        assert var_far[0] > var_near[0]
+
+
+class TestAcquisition:
+    def test_expected_improvement_prefers_low_mean_high_var(self):
+        mean = np.array([0.0, 0.0, 1.0])
+        var = np.array([1.0, 0.01, 1.0])
+        ei = expected_improvement(mean, var, best_value=0.0)
+        assert ei[0] > ei[1] and ei[0] > ei[2]
+
+    def test_ei_zero_when_certain_and_worse(self):
+        ei = expected_improvement(np.array([5.0]), np.array([1e-18]), best_value=0.0)
+        assert ei[0] < 1e-9
+
+    def test_confidence_bound_direction(self):
+        cb = confidence_bound(np.array([0.0, 1.0]), np.array([0.1, 0.1]))
+        assert cb[0] > cb[1]
+
+
+def _quadratic(candidate: np.ndarray) -> float:
+    target = np.array([0.3, 0.7])
+    return float(((candidate - target) ** 2).sum())
+
+
+class TestSearch:
+    def test_random_search_improves(self):
+        search = RandomSearch(dim=2, seed=0)
+        result = search.find(_quadratic, 32)
+        assert result.best_value < 0.05
+        assert len(result.observations) == 32
+
+    def test_gp_search_beats_random_budget(self):
+        gp = GaussianProcessSearch(dim=2, seed=0, min_observations=5)
+        result = gp.find(_quadratic, 20)
+        assert result.best_value < 0.02
+
+    def test_prior_observations_seed_best(self):
+        search = RandomSearch(dim=2, seed=0)
+        search.observe_prior(np.array([0.3, 0.7]), 0.0)
+        result = search.find(_quadratic, 3)
+        assert result.best_value == 0.0
+        np.testing.assert_array_equal(result.best_candidate, [0.3, 0.7])
+
+    def test_sobol_deterministic(self):
+        a = RandomSearch(dim=3, seed=5).draw_candidates(8)
+        b = RandomSearch(dim=3, seed=5).draw_candidates(8)
+        np.testing.assert_array_equal(a, b)
+
+
+class TestRescaling:
+    def test_round_trip(self):
+        rescaling = VectorRescaling(
+            [
+                DimensionSpec("lam", 1e-4, 1e2, log_scale=True),
+                DimensionSpec("iters", 10, 100, discrete=True),
+                DimensionSpec("rate", 0.0, 1.0),
+            ]
+        )
+        unit = np.array([0.5, 0.25, 0.75])
+        values = rescaling.to_hyperparameters(unit)
+        assert values[0] == pytest.approx(np.sqrt(1e-4 * 1e2))  # log midpoint
+        assert values[1] == np.round(10 + 0.25 * 90)
+        assert values[2] == 0.75
+        back = rescaling.to_unit(values)
+        np.testing.assert_allclose(back[0], 0.5, atol=1e-12)
+        np.testing.assert_allclose(back[2], 0.75, atol=1e-12)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DimensionSpec("bad", 1.0, 0.5)
+        with pytest.raises(ValueError):
+            DimensionSpec("bad", 0.0, 1.0, log_scale=True)
+
+
+class TestGameTuning:
+    def test_tunes_lambda_on_overfit_problem(self):
+        """λ tuning should pick a non-degenerate λ that beats the worst
+        candidates on held-out data."""
+        from photon_ml_tpu.algorithm.coordinates import CoordinateOptimizationConfig
+        from photon_ml_tpu.data.game_data import build_game_dataset
+        from photon_ml_tpu.estimators import FixedEffectCoordinateConfig, GameEstimator
+        from photon_ml_tpu.hyperparameter.game_glue import (
+            GameHyperparameterTuner,
+            HyperparameterTuningMode,
+        )
+        from photon_ml_tpu.optim.optimizer import OptimizerConfig
+        from photon_ml_tpu.types import TaskType
+
+        rng = np.random.default_rng(0)
+        n, d = 60, 40  # overparameterized: needs regularization
+        w = rng.normal(size=d) * (rng.uniform(size=d) < 0.2)
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (x @ w + rng.normal(scale=2.0, size=n)).astype(np.float32)
+        xv = rng.normal(size=(200, d)).astype(np.float32)
+        yv = (xv @ w + rng.normal(scale=2.0, size=200)).astype(np.float32)
+
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinate_configs={
+                "fe": FixedEffectCoordinateConfig(
+                    feature_shard_id="g",
+                    optimization=CoordinateOptimizationConfig(
+                        optimizer=OptimizerConfig(max_iterations=60)
+                    ),
+                )
+            },
+            validation_evaluators=("RMSE",),
+        )
+        train = build_game_dataset(labels=y, feature_shards={"g": x})
+        val = build_game_dataset(labels=yv, feature_shards={"g": xv})
+
+        tuner = GameHyperparameterTuner(
+            estimator=est,
+            reg_ranges={"fe": (1e-3, 1e3)},
+            mode=HyperparameterTuningMode.RANDOM,
+            seed=0,
+        )
+        result = tuner.tune(train, val, num_iterations=6)
+        assert 1e-3 <= result.best_reg_weights["fe"] <= 1e3
+        values = [o.value for o in result.search.observations]
+        assert result.best_value == min(values)
+        # tuned λ beats the worst observation on the held-out metric
+        assert result.best_value < max(values)
+
+    def test_serialization_round_trip(self, tmp_path):
+        from photon_ml_tpu.hyperparameter.game_glue import (
+            TuningResult,
+            load_tuned_config,
+            save_tuned_config,
+        )
+        from photon_ml_tpu.hyperparameter.search import Observation, SearchResult
+
+        result = TuningResult(
+            best_reg_weights={"fe": 0.5},
+            best_value=1.25,
+            search=SearchResult(
+                best_candidate=np.array([0.4]),
+                best_value=1.25,
+                observations=[Observation(np.array([0.4]), 1.25)],
+            ),
+        )
+        path = str(tmp_path / "tuned.json")
+        save_tuned_config(result, path)
+        loaded = load_tuned_config(path)
+        assert loaded["best_reg_weights"] == {"fe": 0.5}
+        assert loaded["observations"][0]["value"] == 1.25
