@@ -42,6 +42,7 @@ from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType, solve
+from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.types import TaskType
 
 Array = jax.Array
@@ -203,6 +204,13 @@ class RandomEffectCoordinate(Coordinate):
         )
 
     def update_model(self, model: RandomEffectModel, extra_offsets: Array | None = None):
+        projector = self.re_dataset.projector_type
+        if projector != ProjectorType.IDENTITY and self.normalization is not None:
+            raise ValueError(
+                "feature normalization is not supported with projected "
+                "random-effect coordinates (normalize upstream or use "
+                "ProjectorType.IDENTITY)"
+            )
         objective = _make_objective(self.task, self.config, self.normalization)
         opt = _solve_config(self.config)
         full_offsets = self.dataset.offsets
@@ -210,23 +218,65 @@ class RandomEffectCoordinate(Coordinate):
             full_offsets = full_offsets + extra_offsets
         norm = objective.normalization
         table = norm.from_model_space(model.coefficients, self.intercept_index)
-        for bucket in self.re_dataset.buckets:
-            table = _jitted_re_bucket_solve(
-                objective,
-                opt,
-                bucket.features,
-                bucket.labels,
-                bucket.weights,
-                bucket.sample_rows,
-                bucket.entity_rows,
-                full_offsets,
-                table,
+
+        if projector == ProjectorType.INDEX_MAP:
+            # extra scratch column absorbs the padding scatter/gather slots
+            table_ext = jnp.concatenate(
+                [table, jnp.zeros((table.shape[0], 1), table.dtype)], axis=1
             )
+            for bucket in self.re_dataset.buckets:
+                table_ext = _jitted_re_bucket_solve_indexmap(
+                    objective, opt,
+                    bucket.features, bucket.labels, bucket.weights,
+                    bucket.sample_rows, bucket.entity_rows, bucket.col_index,
+                    full_offsets, table_ext,
+                )
+            table = table_ext[:, :-1]
+        elif projector == ProjectorType.RANDOM:
+            matrix = jnp.asarray(self.re_dataset.projection.matrix, dtype=table.dtype)
+            for bucket in self.re_dataset.buckets:
+                table = _jitted_re_bucket_solve_random(
+                    objective, opt,
+                    bucket.features, bucket.labels, bucket.weights,
+                    bucket.sample_rows, bucket.entity_rows,
+                    matrix, full_offsets, table,
+                )
+        else:
+            for bucket in self.re_dataset.buckets:
+                table = _jitted_re_bucket_solve(
+                    objective, opt,
+                    bucket.features, bucket.labels, bucket.weights,
+                    bucket.sample_rows, bucket.entity_rows,
+                    full_offsets, table,
+                )
         table = norm.to_model_space(table, self.intercept_index)
         return model.with_coefficients(table), None
 
     def score(self, model: RandomEffectModel) -> Array:
         return model.score_dataset(self.dataset)
+
+
+def _bucket_offsets(sample_rows: Array, full_offsets: Array) -> Array:
+    safe = jnp.maximum(sample_rows, 0)
+    return jnp.where(sample_rows >= 0, full_offsets[safe], 0.0)
+
+
+def _solve_bucket_entities(
+    objective: GLMObjective,
+    opt: OptimizerConfig,
+    features: Array,  # [e, cap, k]
+    labels: Array,  # [e, cap]
+    weights: Array,  # [e, cap]
+    offsets: Array,  # [e, cap]
+    w0s: Array,  # [e, k]
+) -> Array:
+    """vmapped per-entity solves: [e, k] solved coefficients."""
+
+    def solve_one(f, l, o, w, w0):
+        batch = LabeledPointBatch(features=f, labels=l, offsets=o, weights=w)
+        return solve(opt, objective.bind(batch), w0).coefficients
+
+    return jax.vmap(solve_one)(features, labels, offsets, weights, w0s)
 
 
 def solve_entity_bucket(
@@ -246,15 +296,10 @@ def solve_entity_bucket(
     mesh-sharded full-GAME train step (parallel/distributed.py), where the
     entity axis shards over the mesh's "data" axis.
     """
-    safe = jnp.maximum(sample_rows, 0)
-    offsets = jnp.where(sample_rows >= 0, full_offsets[safe], 0.0)
-
-    def solve_one(f, l, o, w, w0):
-        batch = LabeledPointBatch(features=f, labels=l, offsets=o, weights=w)
-        return solve(opt, objective.bind(batch), w0).coefficients
-
-    w0s = table[entity_rows]
-    solved = jax.vmap(solve_one)(features, labels, offsets, weights, w0s)
+    offsets = _bucket_offsets(sample_rows, full_offsets)
+    solved = _solve_bucket_entities(
+        objective, opt, features, labels, weights, offsets, table[entity_rows]
+    )
     return table.at[entity_rows].set(solved)
 
 
@@ -274,6 +319,54 @@ def _jitted_re_bucket_solve(
         objective, opt, features, labels, weights, sample_rows, entity_rows,
         full_offsets, table,
     )
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _jitted_re_bucket_solve_indexmap(
+    objective: GLMObjective,
+    opt: OptimizerConfig,
+    features: Array,  # [e, cap, k]
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    col_index: Array,  # [e, k], padding slots hold d (the scratch column)
+    full_offsets: Array,
+    table_ext: Array,  # [E, d+1]
+):
+    """Index-map-projected bucket solve: gather each entity's active columns
+    as its warm start, solve in the projected space, scatter back. Padding
+    slots read/write the scratch column, which is re-zeroed afterwards."""
+    offsets = _bucket_offsets(sample_rows, full_offsets)
+    w0s = table_ext[entity_rows[:, None], col_index]
+    solved = _solve_bucket_entities(
+        objective, opt, features, labels, weights, offsets, w0s
+    )
+    table_ext = table_ext.at[entity_rows[:, None], col_index].set(solved)
+    return table_ext.at[:, -1].set(0.0)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _jitted_re_bucket_solve_random(
+    objective: GLMObjective,
+    opt: OptimizerConfig,
+    features: Array,  # [e, cap, k] (already projected)
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    matrix: Array,  # [d, k]
+    full_offsets: Array,
+    table: Array,  # [E, d]
+):
+    """Random-projected bucket solve: warm start Pᵀw (the adjoint projection,
+    ≈ the projected coefficients since E[PᵀP]=I), back-project P w_k."""
+    offsets = _bucket_offsets(sample_rows, full_offsets)
+    w0s = table[entity_rows] @ matrix
+    solved = _solve_bucket_entities(
+        objective, opt, features, labels, weights, offsets, w0s
+    )
+    return table.at[entity_rows].set(solved @ matrix.T)
 
 
 @dataclasses.dataclass

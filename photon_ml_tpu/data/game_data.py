@@ -34,6 +34,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.projector.projectors import (
+    ProjectorType,
+    RandomProjectionMatrix,
+    entity_active_columns,
+)
 from photon_ml_tpu.sampling.down_sampler import stable_uniform
 
 Array = jax.Array
@@ -83,10 +88,15 @@ class GameDataset:
 class EntityBucket:
     """One size-bucket of random-effect training data.
 
-    features:    [e, cap, d]
+    features:    [e, cap, d] — d is the *bucket's* feature dim: the shard
+                 width for identity projection, the bucket's max
+                 active-column count for index-map projection, or the
+                 projected dim for random projection
     labels/offsets/weights: [e, cap] (weight 0 marks padding)
     entity_rows: [e] int32 — row of each entity in the RE type's vocab
     sample_rows: [e, cap] int32 — global sample row of each slot, -1 pad
+    col_index:   [e, d] int32 — index-map projection only: original column
+                 of each projected slot; padding slots hold ``full_dim``
     """
 
     features: Array
@@ -94,6 +104,7 @@ class EntityBucket:
     weights: Array
     entity_rows: Array
     sample_rows: Array
+    col_index: Array | None = None
 
     @property
     def num_entities(self) -> int:
@@ -111,13 +122,24 @@ class EntityBucket:
 
 @dataclasses.dataclass
 class RandomEffectDataset:
-    """Bucketed per-entity training view for one RE coordinate."""
+    """Bucketed per-entity training view for one RE coordinate.
+
+    ``dim`` is always the original shard width (the model table is [E, dim]
+    in original space); buckets may carry lower-dimensional features when a
+    projector is active.
+    """
 
     random_effect_type: str
     feature_shard_id: str
     buckets: list[EntityBucket]
     num_entities: int  # size of the entity vocab
     dim: int
+    projector_type: "ProjectorType" = None  # set in __post_init__
+    projection: "RandomProjectionMatrix | None" = None
+
+    def __post_init__(self):
+        if self.projector_type is None:
+            self.projector_type = ProjectorType.IDENTITY
 
     @property
     def num_trained_entities(self) -> int:
@@ -140,6 +162,8 @@ def build_random_effect_dataset(
     active_data_lower_bound: int | None = None,
     bucket_sizes: Sequence[int] = (8, 32, 128, 512, 2048),
     seed: int = 0,
+    projector_type: ProjectorType = ProjectorType.IDENTITY,
+    projected_dim: int | None = None,
 ) -> RandomEffectDataset:
     """Group samples by entity into padded, size-bucketed blocks.
 
@@ -150,6 +174,9 @@ def build_random_effect_dataset(
     - buckets: entities padded to the smallest bucket capacity >= their
       (capped) sample count; per-bucket tensors keep padding waste bounded
       while giving the vmapped solver fixed shapes.
+    - projector (reference projector/*.scala): INDEX_MAP bakes per-entity
+      active-column gathers into the buckets; RANDOM applies one shared
+      Gaussian [dim, projected_dim] matrix.
     """
     entity_idx = np.asarray(dataset.entity_idx[re_type])
     features = np.asarray(dataset.feature_shards[shard_id])
@@ -158,6 +185,13 @@ def build_random_effect_dataset(
     unique_ids = np.asarray(dataset.unique_ids)
     dim = features.shape[1]
     num_entities = len(dataset.entity_vocabs[re_type])
+
+    projection = None
+    if projector_type == ProjectorType.RANDOM:
+        if projected_dim is None:
+            raise ValueError("RANDOM projection requires projected_dim")
+        projection = RandomProjectionMatrix.create(dim, projected_dim, seed)
+        features = projection.project_features(features).astype(features.dtype)
 
     # samples per entity (ignore rows with no entity)
     valid = entity_idx >= 0
@@ -188,19 +222,35 @@ def build_random_effect_dataset(
         bucket_cap = next(c for c in bucket_sizes if c >= count)
         per_bucket[bucket_cap].append((entity, sample_rows))
 
+    index_projected = projector_type == ProjectorType.INDEX_MAP
     buckets: list[EntityBucket] = []
     for cap, members in per_bucket.items():
         if not members:
             continue
         e = len(members)
-        bf = np.zeros((e, cap, dim), dtype=features.dtype)
+        entity_cols: list[np.ndarray] | None = None
+        if index_projected:
+            entity_cols = [
+                entity_active_columns(features[sample_rows])
+                for _, sample_rows in members
+            ]
+            bdim = max(len(c) for c in entity_cols)
+        else:
+            bdim = features.shape[1]
+        bf = np.zeros((e, cap, bdim), dtype=features.dtype)
         bl = np.zeros((e, cap), dtype=labels.dtype)
         bw = np.zeros((e, cap), dtype=weights.dtype)
         be = np.zeros((e,), dtype=np.int32)
         bs = np.full((e, cap), -1, dtype=np.int32)
+        bc = np.full((e, bdim), dim, dtype=np.int32) if index_projected else None
         for i, (entity, sample_rows) in enumerate(members):
             k = len(sample_rows)
-            bf[i, :k] = features[sample_rows]
+            if index_projected:
+                cols = entity_cols[i]
+                bf[i, :k, : len(cols)] = features[np.ix_(sample_rows, cols)]
+                bc[i, : len(cols)] = cols
+            else:
+                bf[i, :k] = features[sample_rows]
             bl[i, :k] = labels[sample_rows]
             bw[i, :k] = weights[sample_rows]
             be[i] = entity
@@ -212,6 +262,7 @@ def build_random_effect_dataset(
                 weights=jnp.asarray(bw),
                 entity_rows=jnp.asarray(be),
                 sample_rows=jnp.asarray(bs),
+                col_index=None if bc is None else jnp.asarray(bc),
             )
         )
 
@@ -221,6 +272,8 @@ def build_random_effect_dataset(
         buckets=buckets,
         num_entities=num_entities,
         dim=dim,
+        projector_type=projector_type,
+        projection=projection,
     )
 
 

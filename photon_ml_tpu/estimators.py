@@ -53,6 +53,7 @@ from photon_ml_tpu.ops.normalization import (
 )
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType, solve
+from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.types import TaskType
 
 logger = logging.getLogger(__name__)
@@ -76,6 +77,10 @@ class RandomEffectCoordinateConfig:
     optimization: CoordinateOptimizationConfig
     active_data_upper_bound: int | None = None
     active_data_lower_bound: int | None = None
+    #: reference projector/ProjectorType.scala — INDEX_MAP trains each entity
+    #: on its observed feature support; RANDOM on a shared Gaussian sketch
+    projector_type: ProjectorType = ProjectorType.IDENTITY
+    projected_dim: int | None = None  # RANDOM only
 
 
 CoordinateConfig = FixedEffectCoordinateConfig | RandomEffectCoordinateConfig
@@ -137,6 +142,8 @@ class GameEstimator:
                     cfg.feature_shard_id,
                     active_data_upper_bound=cfg.active_data_upper_bound,
                     active_data_lower_bound=cfg.active_data_lower_bound,
+                    projector_type=cfg.projector_type,
+                    projected_dim=cfg.projected_dim,
                 )
                 coordinates[cid] = RandomEffectCoordinate(
                     coordinate_id=cid,

@@ -43,7 +43,8 @@ class GaussianProcessModel:
             k_star = f.kernel(x, self.x_train)  # [m, n]
             mu = k_star @ f.alpha
             v = cho_solve(f.chol, k_star.T)  # [n, m]
-            prior = np.diag(f.kernel(x, x))
+            # stationary kernel: prior variance is the constant amplitude²
+            prior = np.full(len(x), f.kernel.amplitude**2)
             var = np.maximum(prior - np.einsum("mn,nm->m", k_star, v), 1e-12)
             means.append(mu)
             variances.append(var)

@@ -13,13 +13,18 @@ import numpy as np
 def _sample_dim(
     log_prob: Callable[[np.ndarray], float],
     x: np.ndarray,
+    logp_x: float,
     dim: int,
     rng: np.random.Generator,
     width: float,
     max_steps: int,
-) -> np.ndarray:
-    """One stepping-out + shrinkage slice-sampling update of x[dim]."""
-    y = log_prob(x) + np.log(rng.uniform(1e-300, 1.0))
+) -> tuple[np.ndarray, float]:
+    """One stepping-out + shrinkage slice-sampling update of x[dim].
+
+    ``logp_x`` is log_prob(x), threaded through so the (expensive) current
+    point density is never recomputed. Returns (new_x, log_prob(new_x)).
+    """
+    y = logp_x + np.log(rng.uniform(1e-300, 1.0))
 
     lower = x.copy()
     upper = x.copy()
@@ -39,14 +44,15 @@ def _sample_dim(
     for _ in range(100):
         candidate = x.copy()
         candidate[dim] = rng.uniform(lower[dim], upper[dim])
-        if log_prob(candidate) > y:
-            return candidate
+        logp_candidate = log_prob(candidate)
+        if logp_candidate > y:
+            return candidate, logp_candidate
         # shrink
         if candidate[dim] < x[dim]:
             lower[dim] = candidate[dim]
         else:
             upper[dim] = candidate[dim]
-    return x  # degenerate slice; keep the current point
+    return x, logp_x  # degenerate slice; keep the current point
 
 
 def slice_sample(
@@ -65,12 +71,13 @@ def slice_sample(
     reference's per-dimension sampling. Returns [num_samples, d].
     """
     x = np.array(x0, dtype=np.float64, copy=True)
+    logp = log_prob(x)
     d = x.shape[0]
     out = np.empty((num_samples, d))
     total = burn_in + num_samples
     for i in range(total):
         for dim in rng.permutation(d):
-            x = _sample_dim(log_prob, x, int(dim), rng, width, max_step_out)
+            x, logp = _sample_dim(log_prob, x, logp, int(dim), rng, width, max_step_out)
         if i >= burn_in:
             out[i - burn_in] = x
     return out

@@ -40,6 +40,7 @@ from photon_ml_tpu.algorithm.coordinates import solve_entity_bucket
 from photon_ml_tpu.data.batch import LabeledPointBatch
 from photon_ml_tpu.data.game_data import GameDataset, RandomEffectDataset
 from photon_ml_tpu.models.game import score_random_effect
+from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.ops.objective import GLMObjective
@@ -95,6 +96,17 @@ def _data_pytree(dataset: GameDataset, re_specs: Sequence[RandomEffectStepSpec],
 
 
 def _buckets_pytree(re_datasets: Mapping[str, RandomEffectDataset]) -> dict:
+    for k, ds in re_datasets.items():
+        if ds.projector_type != ProjectorType.IDENTITY:
+            # The mesh-sharded step solves buckets in full shard space;
+            # projected buckets carry gathered/sketched columns it would
+            # scatter into the wrong table slots.
+            raise ValueError(
+                f"random-effect dataset '{k}' uses projector "
+                f"{ds.projector_type.name}; the distributed GAME step "
+                "supports ProjectorType.IDENTITY only (use the single-chip "
+                "GameEstimator path for projected coordinates)"
+            )
     return {
         k: [
             {

@@ -1,0 +1,9 @@
+"""Random-effect projectors (reference photon-api projector/*.scala)."""
+
+from photon_ml_tpu.projector.projectors import (
+    ProjectorType,
+    RandomProjectionMatrix,
+    entity_active_columns,
+)
+
+__all__ = ["ProjectorType", "RandomProjectionMatrix", "entity_active_columns"]

@@ -1,0 +1,78 @@
+"""Per-entity dimensionality reduction for random-effect training.
+
+Reference parity: photon-api projector/ — ProjectorType {IndexMapProjection,
+RandomProjection, IdentityProjection} (projector/ProjectorType.scala),
+IndexMapProjectorRDD.buildIndexMapProjector (collect active indices per
+entity, build per-entity index maps, projector/IndexMapProjectorRDD.scala:
+218-257), ProjectionMatrixBroadcast (random Gaussian matrix shared by all
+entities), IdentityProjector.
+
+TPU-native redesign: a per-entity index map becomes a per-entity gather
+index array baked into the entity bucket at dataset-build time —
+features[:, cols] — so the vmapped solver works on [e, cap, k] blocks with
+k = the bucket's max active-column count instead of the full shard width.
+Solved coefficients scatter back into the full [num_entities, dim] model
+table (models always live in original space, like the reference's
+RandomEffectModelInProjectedSpace un-projection). Random projection is one
+PRNG-keyed [d, k] matrix applied to every entity (the broadcast matrix of
+the reference); back-projection w = P w_k.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import numpy as np
+
+
+class ProjectorType(enum.Enum):
+    """Reference: projector/ProjectorType.scala."""
+
+    IDENTITY = "IDENTITY"
+    INDEX_MAP = "INDEX_MAP"
+    RANDOM = "RANDOM"
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomProjectionMatrix:
+    """Gaussian projection shared across entities (reference
+    ProjectionMatrixBroadcast). matrix: [d, k], entries N(0, 1/d) so
+    E[Pᵀ P] = I — the warm start Pᵀw then approximates the previous
+    projected solution without rescaling."""
+
+    matrix: np.ndarray
+
+    @classmethod
+    def create(cls, dim: int, projected_dim: int, seed: int = 0) -> "RandomProjectionMatrix":
+        if projected_dim >= dim:
+            raise ValueError(
+                f"random projection needs projected_dim < dim, got {projected_dim} >= {dim}"
+            )
+        rng = np.random.default_rng(seed)
+        m = rng.normal(scale=1.0 / np.sqrt(dim), size=(dim, projected_dim))
+        return cls(matrix=m.astype(np.float32))
+
+    @property
+    def dim(self) -> int:
+        return self.matrix.shape[0]
+
+    @property
+    def projected_dim(self) -> int:
+        return self.matrix.shape[1]
+
+    def project_features(self, features: np.ndarray) -> np.ndarray:
+        return features @ self.matrix
+
+    def back_project(self, coefficients: np.ndarray) -> np.ndarray:
+        """[..., k] solved coefficients -> [..., d] original space."""
+        return coefficients @ self.matrix.T
+
+
+def entity_active_columns(features: np.ndarray) -> np.ndarray:
+    """Columns with any nonzero value across an entity's samples — the
+    entity's observed support (IndexMapProjectorRDD.scala:218-257)."""
+    cols = np.nonzero(np.any(features != 0, axis=0))[0]
+    if cols.size == 0:
+        cols = np.array([0], dtype=np.int64)
+    return cols

@@ -1,0 +1,191 @@
+"""Projector tests (reference photon-api projector/*IntegTest intent:
+projected training matches full-space training when the support covers the
+data; random projection trains in the sketched space; models come back in
+original space)."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.algorithm.coordinates import (
+    CoordinateOptimizationConfig,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.data.game_data import (
+    build_game_dataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.optim.optimizer import OptimizerConfig
+from photon_ml_tpu.projector import (
+    ProjectorType,
+    RandomProjectionMatrix,
+    entity_active_columns,
+)
+from photon_ml_tpu.types import TaskType
+
+
+def _sparse_entity_data(seed=0, n=600, d=30, n_entities=12, support=5):
+    """Each entity only ever observes `support` of the d columns."""
+    rng = np.random.default_rng(seed)
+    entities = np.array([f"e{i}" for i in rng.integers(0, n_entities, size=n)])
+    supports = {
+        f"e{i}": rng.choice(d, size=support, replace=False) for i in range(n_entities)
+    }
+    w = {f"e{i}": rng.normal(size=support) for i in range(n_entities)}
+    x = np.zeros((n, d), dtype=np.float32)
+    y = np.zeros(n, dtype=np.float32)
+    for r in range(n):
+        e = entities[r]
+        x[r, supports[e]] = rng.normal(size=support)
+        y[r] = x[r, supports[e]] @ w[e] + rng.normal(scale=0.05)
+    return x, y, entities
+
+
+def test_entity_active_columns():
+    f = np.array([[0.0, 1.0, 0.0], [0.0, 2.0, 3.0]])
+    np.testing.assert_array_equal(entity_active_columns(f), [1, 2])
+    # all-zero features fall back to column 0
+    np.testing.assert_array_equal(entity_active_columns(np.zeros((2, 3))), [0])
+
+
+def test_random_projection_matrix():
+    p = RandomProjectionMatrix.create(64, 8, seed=1)
+    assert p.matrix.shape == (64, 8)
+    # E[P^T P] = I with scale 1/sqrt(k)
+    gram = p.matrix.T @ p.matrix
+    assert np.abs(np.diag(gram) - np.diag(gram).mean()).max() < np.diag(gram).mean()
+    with pytest.raises(ValueError):
+        RandomProjectionMatrix.create(8, 8)
+
+
+def test_index_map_projection_buckets():
+    x, y, entities = _sparse_entity_data()
+    ds = build_game_dataset(labels=y, feature_shards={"s": x}, entity_keys={"e": entities})
+    re = build_random_effect_dataset(
+        ds, "e", "s", projector_type=ProjectorType.INDEX_MAP
+    )
+    assert re.projector_type == ProjectorType.INDEX_MAP
+    assert re.dim == 30  # model stays full width
+    for b in re.buckets:
+        assert b.col_index is not None
+        # projected width is the per-bucket max support, far below d
+        assert b.features.shape[2] <= 6
+        # padding col_index slots point at the scratch column (== dim)
+        ci = np.asarray(b.col_index)
+        assert ci.max() <= 30
+
+
+def _train_re(re_ds, ds, l2=1e-3, iters=60):
+    coord = RandomEffectCoordinate(
+        coordinate_id="re",
+        dataset=ds,
+        re_dataset=re_ds,
+        task=TaskType.LINEAR_REGRESSION,
+        config=CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=iters), l2_weight=l2
+        ),
+    )
+    model, _ = coord.update_model(coord.initial_model())
+    return coord, model
+
+
+def test_index_map_projection_matches_identity():
+    """On support-sparse data, projected solves equal full-space solves."""
+    x, y, entities = _sparse_entity_data()
+    ds = build_game_dataset(labels=y, feature_shards={"s": x}, entity_keys={"e": entities})
+    re_id = build_random_effect_dataset(ds, "e", "s")
+    re_proj = build_random_effect_dataset(
+        ds, "e", "s", projector_type=ProjectorType.INDEX_MAP
+    )
+    _, m_id = _train_re(re_id, ds)
+    _, m_proj = _train_re(re_proj, ds)
+    t_id = np.asarray(m_id.coefficients)
+    t_proj = np.asarray(m_proj.coefficients)
+    # same fits on the observed support; off-support coords are 0 either way
+    np.testing.assert_allclose(t_proj, t_id, atol=5e-3)
+    scores_id = np.asarray(m_id.score_dataset(ds))
+    scores_proj = np.asarray(m_proj.score_dataset(ds))
+    np.testing.assert_allclose(scores_proj, scores_id, atol=1e-2)
+    # and the fit is actually good
+    assert np.sqrt(np.mean((scores_proj - y) ** 2)) < 0.2
+
+
+def test_random_projection_trains_and_back_projects():
+    x, y, entities = _sparse_entity_data(n=800, d=40)
+    ds = build_game_dataset(labels=y, feature_shards={"s": x}, entity_keys={"e": entities})
+    re = build_random_effect_dataset(
+        ds, "e", "s", projector_type=ProjectorType.RANDOM, projected_dim=16
+    )
+    assert re.projection is not None
+    for b in re.buckets:
+        assert b.features.shape[2] == 16
+    _, model = _train_re(re, ds, l2=1e-2)
+    # model table is in original space
+    assert np.asarray(model.coefficients).shape == (len(np.unique(entities)), 40)
+    scores = np.asarray(model.score_dataset(ds))
+    baseline = np.sqrt(np.mean(y**2))
+    rmse = np.sqrt(np.mean((scores - y) ** 2))
+    assert rmse < 0.8 * baseline  # sketch captures most of the signal
+
+
+def test_random_projection_requires_dim():
+    x, y, entities = _sparse_entity_data()
+    ds = build_game_dataset(labels=y, feature_shards={"s": x}, entity_keys={"e": entities})
+    with pytest.raises(ValueError, match="projected_dim"):
+        build_random_effect_dataset(ds, "e", "s", projector_type=ProjectorType.RANDOM)
+
+
+def test_projection_rejects_normalization():
+    from photon_ml_tpu.ops.normalization import (
+        NormalizationType,
+        build_normalization,
+    )
+    import jax.numpy as jnp
+
+    x, y, entities = _sparse_entity_data()
+    ds = build_game_dataset(labels=y, feature_shards={"s": x}, entity_keys={"e": entities})
+    re = build_random_effect_dataset(
+        ds, "e", "s", projector_type=ProjectorType.INDEX_MAP
+    )
+    norm = build_normalization(
+        NormalizationType.SCALE_WITH_MAX_MAGNITUDE,
+        mean=jnp.zeros(30),
+        variance=jnp.ones(30),
+        max_magnitude=jnp.ones(30),
+    )
+    coord = RandomEffectCoordinate(
+        coordinate_id="re",
+        dataset=ds,
+        re_dataset=re,
+        task=TaskType.LINEAR_REGRESSION,
+        config=CoordinateOptimizationConfig(optimizer=OptimizerConfig()),
+        normalization=norm,
+    )
+    with pytest.raises(ValueError, match="normalization"):
+        coord.update_model(coord.initial_model())
+
+
+def test_estimator_with_projected_coordinate():
+    from photon_ml_tpu.estimators import (
+        GameEstimator,
+        RandomEffectCoordinateConfig,
+    )
+
+    x, y, entities = _sparse_entity_data()
+    ds = build_game_dataset(labels=y, feature_shards={"s": x}, entity_keys={"e": entities})
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={
+            "re": RandomEffectCoordinateConfig(
+                random_effect_type="e",
+                feature_shard_id="s",
+                optimization=CoordinateOptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=50), l2_weight=1e-3
+                ),
+                projector_type=ProjectorType.INDEX_MAP,
+            )
+        },
+        num_iterations=1,
+    )
+    result = est.fit(ds)
+    scores = np.asarray(result.model.score_dataset(ds))
+    assert np.sqrt(np.mean((scores - y) ** 2)) < 0.2
