@@ -1,0 +1,209 @@
+"""Typed driver configuration + the k=v,k=v CLI grammar.
+
+Reference parity: photon-client io/scopt/ScoptParserHelpers.scala:43-101,
+155-200 — composite key-value grammar for coordinate and feature-shard
+configurations ("name=X,feature.shard=Y,reg.weights=0.1|1|10"), photon-client
+io/CoordinateConfiguration.scala (data config + opt config + reg-weight
+grid, expandOptimizationConfigurations), io/FeatureShardConfiguration.scala,
+and ModelOutputMode {NONE, BEST, EXPLICIT, TUNED, ALL}.
+
+The reference wraps spark.ml Params in scopt; here plain dataclasses +
+argparse carry the same nouns, and `expand_reg_weight_grid` reproduces the
+cartesian grid fold of GameTrainingDriver.scala:612-621.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+from typing import Mapping, Sequence
+
+from photon_ml_tpu.algorithm.coordinates import CoordinateOptimizationConfig
+from photon_ml_tpu.estimators import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
+from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+from photon_ml_tpu.projector.projectors import ProjectorType
+
+
+class ModelOutputMode(enum.Enum):
+    """Reference: io/ModelOutputMode.scala."""
+
+    NONE = "NONE"
+    BEST = "BEST"
+    ALL = "ALL"
+
+
+LIST_SEP = "|"
+
+
+def parse_kv_list(spec: str) -> dict[str, str]:
+    """Parse "k1=v1,k2=v2" into a dict (list values use '|' separators,
+    reference ScoptParserHelpers' composite grammar)."""
+    out: dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, eq, value = part.partition("=")
+        if not eq:
+            raise ValueError(f"expected key=value, got {part!r} in {spec!r}")
+        key = key.strip()
+        if key in out:
+            raise ValueError(f"duplicate key {key!r} in {spec!r}")
+        out[key] = value.strip()
+    return out
+
+
+def _bool(s: str) -> bool:
+    if s.lower() in ("true", "1", "yes"):
+        return True
+    if s.lower() in ("false", "0", "no"):
+        return False
+    raise ValueError(f"expected boolean, got {s!r}")
+
+
+def parse_feature_shard_config(spec: str) -> tuple[str, FeatureShardConfiguration]:
+    """"name=global,feature.bags=features|userFeatures,intercept=true"."""
+    kv = parse_kv_list(spec)
+    try:
+        name = kv.pop("name")
+        bags = tuple(b for b in kv.pop("feature.bags").split(LIST_SEP) if b)
+    except KeyError as e:
+        raise ValueError(f"feature shard config missing {e} in {spec!r}") from None
+    intercept = _bool(kv.pop("intercept", "true"))
+    if kv:
+        raise ValueError(f"unknown feature shard keys {sorted(kv)} in {spec!r}")
+    return name, FeatureShardConfiguration(feature_bags=bags, has_intercept=intercept)
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateCliConfig:
+    """One coordinate's full CLI configuration (reference
+    io/CoordinateConfiguration.scala: data config + opt config + λ grid)."""
+
+    name: str
+    feature_shard: str
+    optimizer: OptimizerType = OptimizerType.LBFGS
+    max_iterations: int = 100
+    tolerance: float = 1e-7
+    reg_weights: tuple[float, ...] = (0.0,)
+    reg_alpha: float = 0.0  # elastic-net: fraction of λ on L1
+    down_sampling_rate: float = 1.0
+    compute_variance: bool = False
+    # random-effect only
+    random_effect_type: str | None = None
+    active_data_lower_bound: int | None = None
+    active_data_upper_bound: int | None = None
+    projector: ProjectorType = ProjectorType.IDENTITY
+    projected_dim: int | None = None
+
+    @property
+    def is_random_effect(self) -> bool:
+        return self.random_effect_type is not None
+
+    def optimization_config(self, reg_weight: float) -> CoordinateOptimizationConfig:
+        l1 = self.reg_alpha * reg_weight
+        l2 = (1.0 - self.reg_alpha) * reg_weight
+        return CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(
+                optimizer_type=self.optimizer,
+                max_iterations=self.max_iterations,
+                tolerance=self.tolerance,
+            ),
+            l2_weight=l2,
+            l1_weight=l1,
+            compute_variance=self.compute_variance,
+            down_sampling_rate=self.down_sampling_rate,
+        )
+
+    def estimator_config(self, reg_weight: float):
+        if self.is_random_effect:
+            return RandomEffectCoordinateConfig(
+                random_effect_type=self.random_effect_type,
+                feature_shard_id=self.feature_shard,
+                optimization=self.optimization_config(reg_weight),
+                active_data_lower_bound=self.active_data_lower_bound,
+                active_data_upper_bound=self.active_data_upper_bound,
+                projector_type=self.projector,
+                projected_dim=self.projected_dim,
+            )
+        return FixedEffectCoordinateConfig(
+            feature_shard_id=self.feature_shard,
+            optimization=self.optimization_config(reg_weight),
+        )
+
+
+def parse_coordinate_config(spec: str) -> CoordinateCliConfig:
+    """Parse one --coordinate-configurations value, e.g.
+    "name=per-user,random.effect.type=userId,feature.shard=user,
+     optimizer=TRON,reg.weights=0.1|1|10,active.data.upper.bound=4096"."""
+    kv = parse_kv_list(spec)
+    try:
+        name = kv.pop("name")
+        shard = kv.pop("feature.shard")
+    except KeyError as e:
+        raise ValueError(f"coordinate config missing {e} in {spec!r}") from None
+
+    def pop(key, default=None):
+        return kv.pop(key, default)
+
+    cfg = CoordinateCliConfig(
+        name=name,
+        feature_shard=shard,
+        optimizer=OptimizerType(pop("optimizer", "LBFGS").upper()),
+        max_iterations=int(pop("max.iter", "100")),
+        tolerance=float(pop("tolerance", "1e-7")),
+        reg_weights=tuple(
+            float(w) for w in pop("reg.weights", "0").split(LIST_SEP) if w
+        ),
+        reg_alpha=float(pop("reg.alpha", "0")),
+        down_sampling_rate=float(pop("down.sampling.rate", "1")),
+        compute_variance=_bool(pop("variance", "false")),
+        random_effect_type=pop("random.effect.type"),
+        active_data_lower_bound=(
+            int(v) if (v := pop("active.data.lower.bound")) else None
+        ),
+        active_data_upper_bound=(
+            int(v) if (v := pop("active.data.upper.bound")) else None
+        ),
+        projector=ProjectorType(pop("projector", "IDENTITY").upper()),
+        projected_dim=(int(v) if (v := pop("projected.dim")) else None),
+    )
+    if kv:
+        raise ValueError(f"unknown coordinate config keys {sorted(kv)} in {spec!r}")
+    if not cfg.reg_weights:
+        raise ValueError(f"coordinate {name!r} has an empty reg.weights grid")
+    return cfg
+
+
+def expand_reg_weight_grid(
+    configs: Mapping[str, CoordinateCliConfig],
+) -> list[dict[str, float]]:
+    """Cartesian product of each coordinate's λ grid (reference
+    GameTrainingDriver.prepareGameOptConfigs:612-621)."""
+    names = list(configs.keys())
+    grids = [configs[n].reg_weights for n in names]
+    return [dict(zip(names, combo)) for combo in itertools.product(*grids)]
+
+
+def estimator_coordinate_configs(
+    configs: Mapping[str, CoordinateCliConfig], reg_weights: Mapping[str, float]
+) -> dict:
+    return {
+        name: cfg.estimator_config(reg_weights[name]) for name, cfg in configs.items()
+    }
+
+
+def evaluation_id_columns(evaluator_specs: Sequence[str]) -> tuple[str, ...]:
+    """Id columns needed by per-query evaluator specs ("AUC:queryId")."""
+    cols = []
+    for spec in evaluator_specs:
+        if ":" in spec:
+            col = spec.split(":", 1)[1].strip()
+            if col and col not in cols:
+                cols.append(col)
+    return tuple(cols)

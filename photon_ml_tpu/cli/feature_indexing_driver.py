@@ -1,0 +1,62 @@
+"""Feature indexing driver: build + persist per-shard feature index maps.
+
+Reference parity: photon-client index/FeatureIndexingDriver.scala:177-290 —
+scan the data once, collect distinct (name, term) per shard, build
+partitioned index stores (PalDB there; the native mmap store or text keys
+here), save to the output dir for later training/scoring runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Sequence
+
+from photon_ml_tpu.cli.configs import parse_feature_shard_config
+from photon_ml_tpu.io.data_reader import build_index_maps, read_avro_records, read_libsvm
+
+logger = logging.getLogger(__name__)
+
+
+def run(
+    *,
+    input_data_path: str,
+    output_dir: str,
+    feature_shards: dict,
+    input_format: str = "avro",
+) -> dict[str, int]:
+    records = (
+        read_avro_records(input_data_path)
+        if input_format == "avro"
+        else read_libsvm(input_data_path)
+    )
+    index_maps = build_index_maps(records, feature_shards)
+    sizes = {}
+    for shard_id, imap in index_maps.items():
+        imap.save(output_dir, shard_id)
+        sizes[shard_id] = imap.size
+        logger.info("shard '%s': %d features indexed", shard_id, imap.size)
+    return sizes
+
+
+def main(argv: Sequence[str] | None = None) -> dict[str, int]:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(prog="feature_indexing_driver")
+    p.add_argument("--input-data-path", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--feature-shard-configurations", action="append", required=True)
+    p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
+    args = p.parse_args(argv)
+    shards = dict(
+        parse_feature_shard_config(s) for s in args.feature_shard_configurations
+    )
+    return run(
+        input_data_path=args.input_data_path,
+        output_dir=args.output_dir,
+        feature_shards=shards,
+        input_format=args.input_format,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""GAME scoring driver: load a model, score a dataset, save scores.
+
+Reference parity: photon-client cli/game/scoring/GameScoringDriver.scala —
+run() (:133-194): prepare feature maps, read data, load GAME model from the
+training output layout, GameTransformer.transform, optional evaluation,
+saveScoresToHDFS (:191-253, ScoringResultAvro records).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+from typing import Sequence
+
+import numpy as np
+
+from photon_ml_tpu.cli.configs import (
+    evaluation_id_columns,
+    parse_feature_shard_config,
+)
+from photon_ml_tpu.io.data_reader import read_merged
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.model_io import load_game_model, write_scores
+from photon_ml_tpu.models.game import RandomEffectModel
+from photon_ml_tpu.transformers import GameTransformer
+from photon_ml_tpu.util import Timed
+
+logger = logging.getLogger(__name__)
+
+
+def run(
+    *,
+    input_data_path: str,
+    model_input_dir: str,
+    output_dir: str,
+    feature_shards: dict | None = None,
+    index_maps_dir: str | None = None,
+    evaluators: Sequence[str] = (),
+    model_id: str = "",
+    input_format: str = "avro",
+) -> dict:
+    """Score ``input_data_path`` with the model at ``model_input_dir``.
+
+    Index maps default to the ones the training driver saved next to the
+    model (<root>/index-maps); feature shard configs default to one shard
+    per saved index map using the bag of the same name.
+    """
+    os.makedirs(output_dir, exist_ok=True)
+    if index_maps_dir is None:
+        candidate = os.path.join(os.path.dirname(model_input_dir.rstrip("/")), "index-maps")
+        index_maps_dir = candidate if os.path.isdir(candidate) else None
+    index_maps = {}
+    if index_maps_dir:
+        for fname in os.listdir(index_maps_dir):
+            if fname.endswith(".keys"):
+                shard = fname[: -len(".keys")]
+                index_maps[shard] = IndexMap.load(index_maps_dir, shard)
+    if feature_shards is None:
+        from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
+
+        if not index_maps:
+            raise ValueError(
+                "no feature shard configurations and no saved index maps found"
+            )
+        feature_shards = {
+            shard: FeatureShardConfiguration(feature_bags=(shard, "features"))
+            for shard in index_maps
+        }
+
+    with Timed("load model"):
+        model = load_game_model(model_input_dir, index_maps)
+    re_columns = tuple(
+        sorted(
+            m.random_effect_type
+            for m in model.models.values()
+            if isinstance(m, RandomEffectModel)
+        )
+    )
+    entity_vocabs = {
+        m.random_effect_type: np.asarray(m.entity_keys)
+        for m in model.models.values()
+        if isinstance(m, RandomEffectModel)
+    }
+
+    with Timed("read scoring data"):
+        data = read_merged(
+            input_data_path,
+            feature_shards,
+            index_maps=index_maps or None,
+            random_effect_id_columns=re_columns,
+            evaluation_id_columns=evaluation_id_columns(evaluators),
+            entity_vocabs=entity_vocabs,
+            fmt=input_format,
+        )
+
+    with Timed("score"):
+        scored = GameTransformer(model=model, evaluator_specs=tuple(evaluators)).transform(
+            data.dataset
+        )
+
+    with Timed("save scores"):
+        write_scores(
+            os.path.join(output_dir, "scores", "part-00000.avro"),
+            scored.scores,
+            model_id=model_id,
+            uids=scored.unique_ids,
+            labels=np.asarray(data.dataset.labels),
+            weights=np.asarray(data.dataset.weights),
+        )
+    summary = {"num_scored": int(len(scored.scores)), "evaluations": scored.evaluations}
+    with open(os.path.join(output_dir, "scoring-summary.json"), "w") as f:
+        from photon_ml_tpu.cli.game_training_driver import _json_safe
+
+        json.dump(_json_safe(summary), f, indent=2, default=float)
+    return summary
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="game_scoring_driver")
+    p.add_argument("--input-data-path", required=True)
+    p.add_argument("--model-input-dir", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--feature-shard-configurations", action="append", default=None)
+    p.add_argument("--index-maps-dir")
+    p.add_argument("--evaluators", default="")
+    p.add_argument("--model-id", default="")
+    p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
+    return p
+
+
+def main(argv: Sequence[str] | None = None) -> dict:
+    logging.basicConfig(level=logging.INFO)
+    args = build_arg_parser().parse_args(argv)
+    shards = None
+    if args.feature_shard_configurations:
+        shards = dict(
+            parse_feature_shard_config(s) for s in args.feature_shard_configurations
+        )
+    return run(
+        input_data_path=args.input_data_path,
+        model_input_dir=args.model_input_dir,
+        output_dir=args.output_dir,
+        feature_shards=shards,
+        index_maps_dir=args.index_maps_dir,
+        evaluators=tuple(x.strip() for x in args.evaluators.split(",") if x.strip()),
+        model_id=args.model_id,
+        input_format=args.input_format,
+    )
+
+
+if __name__ == "__main__":
+    main()

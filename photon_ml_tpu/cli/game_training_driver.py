@@ -1,0 +1,399 @@
+"""GAME training driver: the flagship end-to-end CLI entry point.
+
+Reference parity: photon-client cli/game/training/GameTrainingDriver.scala —
+params (:78-166), run() pipeline (:335-471): read + validate data, feature
+stats, normalization contexts, λ-grid expansion (:612-621), GameEstimator
+fit per configuration warm-starting from the previous (:352-366), optional
+hyperparameter tuning (:631-663), model selection (:672-737), model save
+(:748-815); shared GameDriver params (cli/game/GameDriver.scala:56-132).
+
+Usage:
+    python -m photon_ml_tpu.cli.game_training_driver \
+        --input-data-path data/train --validation-data-path data/val \
+        --root-output-dir out \
+        --feature-shard-configurations name=global,feature.bags=features \
+        --coordinate-configurations name=fe,feature.shard=global,reg.weights=0.1|1|10 \
+        --task-type LOGISTIC_REGRESSION --evaluators AUC
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+from typing import Sequence
+
+import numpy as np
+
+from photon_ml_tpu.cli.configs import (
+    CoordinateCliConfig,
+    ModelOutputMode,
+    estimator_coordinate_configs,
+    evaluation_id_columns,
+    expand_reg_weight_grid,
+    parse_coordinate_config,
+    parse_feature_shard_config,
+)
+from photon_ml_tpu.data.batch import summarize
+from photon_ml_tpu.data.validators import DataValidationType, validate_game_dataset
+from photon_ml_tpu.estimators import GameEstimator
+from photon_ml_tpu.evaluation.evaluators import parse_evaluator
+from photon_ml_tpu.hyperparameter.game_glue import (
+    GameHyperparameterTuner,
+    HyperparameterTuningMode,
+    save_tuned_config,
+)
+from photon_ml_tpu.io.data_reader import read_merged
+from photon_ml_tpu.io.model_io import load_game_model, save_game_model, write_feature_stats
+from photon_ml_tpu.ops.normalization import NormalizationType
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.util import (
+    EventEmitter,
+    PhotonLogger,
+    Timed,
+    TrainingFinishEvent,
+    TrainingStartEvent,
+)
+from photon_ml_tpu.util.timed import reset_timings, timing_summary
+
+logger = logging.getLogger(__name__)
+
+#: process-wide emitter; external telemetry registers listeners here
+#: (reference Driver event emission, Driver.scala:120-393)
+events = EventEmitter()
+
+
+@dataclasses.dataclass
+class GameTrainingParams:
+    """Validated driver parameters (reference GameTrainingDriver params)."""
+
+    input_data_path: str
+    root_output_dir: str
+    feature_shards: dict
+    coordinates: dict[str, CoordinateCliConfig]
+    task_type: TaskType
+    validation_data_path: str | None = None
+    update_sequence: tuple[str, ...] = ()
+    coordinate_descent_iterations: int = 1
+    evaluators: tuple[str, ...] = ()
+    normalization: NormalizationType = NormalizationType.NONE
+    data_validation: DataValidationType = DataValidationType.VALIDATE_DISABLED
+    model_input_dir: str | None = None  # warm start
+    partial_retrain_locked_coordinates: tuple[str, ...] = ()
+    model_output_mode: ModelOutputMode = ModelOutputMode.ALL
+    hyperparameter_tuning: HyperparameterTuningMode = HyperparameterTuningMode.NONE
+    hyperparameter_tuning_iter: int = 10
+    hyperparameter_tuning_range: tuple[float, float] = (1e-4, 1e4)
+    input_format: str = "avro"
+    override_output: bool = False
+
+    def validate(self) -> None:
+        """Cross-parameter checks (reference validateParams:196-298)."""
+        problems = []
+        sequence = self.update_sequence or tuple(self.coordinates.keys())
+        for cid in sequence:
+            if cid not in self.coordinates:
+                problems.append(f"update sequence names unknown coordinate '{cid}'")
+        for cid in self.partial_retrain_locked_coordinates:
+            if cid not in sequence:
+                problems.append(f"locked coordinate '{cid}' not in update sequence")
+        if self.partial_retrain_locked_coordinates and self.model_input_dir is None:
+            problems.append("partial retraining requires --model-input-dir")
+        for name, cfg in self.coordinates.items():
+            if cfg.feature_shard not in self.feature_shards:
+                problems.append(
+                    f"coordinate '{name}' references undefined feature shard "
+                    f"'{cfg.feature_shard}'"
+                )
+        if self.evaluators and self.validation_data_path is None:
+            problems.append(
+                "--evaluators are validation evaluators and require "
+                "--validation-data-path"
+            )
+        if (
+            self.hyperparameter_tuning != HyperparameterTuningMode.NONE
+            and not self.evaluators
+        ):
+            problems.append("hyperparameter tuning requires --evaluators")
+        if problems:
+            raise ValueError("invalid driver parameters: " + "; ".join(problems))
+
+
+def run(params: GameTrainingParams) -> dict:
+    """Execute the training pipeline; returns a result summary dict."""
+    params.validate()
+    out = params.root_output_dir
+    if os.path.isdir(out) and os.listdir(out) and not params.override_output:
+        raise ValueError(
+            f"output dir {out!r} is non-empty (pass --override-output to replace)"
+        )
+    os.makedirs(out, exist_ok=True)
+
+    reset_timings()  # per-run phase timings (a sweep may call run() repeatedly)
+    events.send(TrainingStartEvent(job_name="game-training"))
+    job_log = PhotonLogger(os.path.join(out, "driver.log"))
+    try:
+        return _run_inner(params, job_log)
+    except Exception:
+        events.send(TrainingFinishEvent(job_name="game-training", succeeded=False))
+        raise
+    finally:
+        job_log.close()
+
+
+def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
+    out = params.root_output_dir
+    re_columns = tuple(
+        sorted({c.random_effect_type for c in params.coordinates.values() if c.random_effect_type})
+    )
+    eval_columns = evaluation_id_columns(params.evaluators)
+
+    with Timed("read training data"):
+        train = read_merged(
+            params.input_data_path,
+            params.feature_shards,
+            random_effect_id_columns=re_columns,
+            evaluation_id_columns=eval_columns,
+            fmt=params.input_format,
+        )
+    job_log.info(
+        "read %d training samples, shards %s",
+        train.dataset.num_samples,
+        {k: v.size for k, v in train.index_maps.items()},
+    )
+
+    validation = None
+    if params.validation_data_path:
+        with Timed("read validation data"):
+            validation = read_merged(
+                params.validation_data_path,
+                params.feature_shards,
+                index_maps=train.index_maps,
+                random_effect_id_columns=re_columns,
+                evaluation_id_columns=eval_columns,
+                entity_vocabs=train.dataset.entity_vocabs,
+                fmt=params.input_format,
+            )
+
+    with Timed("validate data"):
+        validate_game_dataset(train.dataset, params.task_type, params.data_validation)
+        if validation is not None:
+            validate_game_dataset(
+                validation.dataset, params.task_type, params.data_validation
+            )
+
+    with Timed("feature shard stats"):
+        for shard_id, features in train.dataset.feature_shards.items():
+            stats = summarize(np.asarray(features), np.asarray(train.dataset.weights))
+            write_feature_stats(
+                os.path.join(out, "feature-stats", shard_id, "part-00000.avro"),
+                stats,
+                train.index_maps[shard_id],
+            )
+
+    initial_model = None
+    if params.model_input_dir:
+        with Timed("load warm-start model"):
+            initial_model = load_game_model(params.model_input_dir, train.index_maps)
+
+    # save index maps next to the models so scoring is self-contained
+    for shard_id, imap in train.index_maps.items():
+        imap.save(os.path.join(out, "index-maps"), shard_id)
+
+    def make_estimator(reg_weights) -> GameEstimator:
+        return GameEstimator(
+            task=params.task_type,
+            coordinate_configs=estimator_coordinate_configs(
+                params.coordinates, reg_weights
+            ),
+            update_sequence=params.update_sequence or None,
+            num_iterations=params.coordinate_descent_iterations,
+            normalization=params.normalization,
+            validation_evaluators=params.evaluators,
+            locked_coordinates=frozenset(params.partial_retrain_locked_coordinates),
+            intercept_indices=train.intercept_indices,
+        )
+
+    grid = expand_reg_weight_grid(params.coordinates)
+    job_log.info("expanded λ grid to %d configurations", len(grid))
+    first_evaluator = parse_evaluator(params.evaluators[0]) if params.evaluators else None
+
+    results = []
+    warm_model = initial_model
+    best_index, best_metric = -1, float("nan")
+    for i, reg_weights in enumerate(grid):
+        with Timed(f"train config {i}"):
+            est = make_estimator(reg_weights)
+            result = est.fit(
+                train.dataset,
+                validation_dataset=None if validation is None else validation.dataset,
+                initial_model=warm_model,
+            )
+        # warm start the next grid point (reference GameEstimator.fit:352-366)
+        warm_model = result.model
+        results.append((reg_weights, result))
+        metric = result.best_metric
+        job_log.info("config %d %s -> metric %s", i, reg_weights, metric)
+        if first_evaluator is None:
+            if best_index < 0:
+                best_index = i
+        elif best_index < 0 or first_evaluator.better_than(metric, best_metric):
+            best_index, best_metric = i, metric
+
+        if params.model_output_mode == ModelOutputMode.ALL:
+            save_game_model(
+                os.path.join(out, "models", str(i)),
+                result.best_model,
+                train.index_maps,
+                optimization_configurations={"regWeights": reg_weights},
+            )
+
+    summary: dict = {
+        "num_configurations": len(grid),
+        "best_configuration_index": best_index,
+        "best_reg_weights": grid[best_index],
+        "best_metric": best_metric,
+        "metric_history": [
+            {"reg_weights": rw, "metrics": r.metric_history} for rw, r in results
+        ],
+    }
+
+    if params.model_output_mode != ModelOutputMode.NONE:
+        save_game_model(
+            os.path.join(out, "best"),
+            results[best_index][1].best_model,
+            train.index_maps,
+            optimization_configurations={"regWeights": grid[best_index]},
+        )
+
+    if params.hyperparameter_tuning != HyperparameterTuningMode.NONE:
+        with Timed("hyperparameter tuning"):
+            tunable = {
+                name: params.hyperparameter_tuning_range
+                for name in params.coordinates
+                if name not in params.partial_retrain_locked_coordinates
+            }
+            tuner = GameHyperparameterTuner(
+                estimator=make_estimator(grid[best_index]),
+                reg_ranges=tunable,
+                mode=params.hyperparameter_tuning,
+            )
+            tuned = tuner.tune(
+                train.dataset,
+                validation.dataset,
+                num_iterations=params.hyperparameter_tuning_iter,
+                prior_observations=[
+                    (rw, r.best_metric)
+                    for rw, r in results
+                    if not np.isnan(r.best_metric)
+                ],
+            )
+        save_tuned_config(tuned, os.path.join(out, "tuned-hyperparameters.json"))
+        summary["tuned_reg_weights"] = tuned.best_reg_weights
+        summary["tuned_metric"] = tuned.best_value
+
+    summary["timings"] = timing_summary()
+    with open(os.path.join(out, "training-summary.json"), "w") as f:
+        json.dump(_json_safe(summary), f, indent=2, default=float)
+    events.send(TrainingFinishEvent(job_name="game-training", succeeded=True))
+    return summary
+
+
+def _json_safe(obj):
+    """NaN/Inf -> None so the summary is strict JSON."""
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, float) and not np.isfinite(obj):
+        return None
+    return obj
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="game_training_driver", description=__doc__.split("\n")[0]
+    )
+    p.add_argument("--input-data-path", required=True)
+    p.add_argument("--validation-data-path")
+    p.add_argument("--root-output-dir", required=True)
+    p.add_argument(
+        "--feature-shard-configurations", action="append", required=True,
+        help="name=NAME,feature.bags=BAG|BAG,intercept=true (repeatable)",
+    )
+    p.add_argument(
+        "--coordinate-configurations", action="append", required=True,
+        help="name=NAME,feature.shard=SHARD,reg.weights=0.1|1,... (repeatable)",
+    )
+    p.add_argument("--task-type", required=True,
+                   choices=[t.name for t in TaskType if t != TaskType.NONE])
+    p.add_argument("--update-sequence", default="",
+                   help="comma-separated coordinate order")
+    p.add_argument("--coordinate-descent-iterations", type=int, default=1)
+    p.add_argument("--evaluators", default="", help="comma-separated specs")
+    p.add_argument("--normalization", default="NONE",
+                   choices=[n.name for n in NormalizationType])
+    p.add_argument("--data-validation", default="VALIDATE_DISABLED",
+                   choices=[v.name for v in DataValidationType])
+    p.add_argument("--model-input-dir", help="warm-start model directory")
+    p.add_argument("--partial-retrain-locked-coordinates", default="")
+    p.add_argument("--model-output-mode", default="ALL",
+                   choices=[m.name for m in ModelOutputMode])
+    p.add_argument("--hyperparameter-tuning", default="NONE",
+                   choices=[m.name for m in HyperparameterTuningMode])
+    p.add_argument("--hyperparameter-tuning-iter", type=int, default=10)
+    p.add_argument("--hyperparameter-tuning-range", default="1e-4,1e4",
+                   help="low,high λ search range (log-scale)")
+    p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
+    p.add_argument("--override-output", action="store_true")
+    return p
+
+
+def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
+    args = build_arg_parser().parse_args(argv)
+    shards = dict(
+        parse_feature_shard_config(s) for s in args.feature_shard_configurations
+    )
+    coords = {}
+    for spec in args.coordinate_configurations:
+        cfg = parse_coordinate_config(spec)
+        if cfg.name in coords:
+            raise ValueError(f"duplicate coordinate name {cfg.name!r}")
+        coords[cfg.name] = cfg
+    split = lambda s: tuple(x.strip() for x in s.split(",") if x.strip())
+    return GameTrainingParams(
+        input_data_path=args.input_data_path,
+        validation_data_path=args.validation_data_path,
+        root_output_dir=args.root_output_dir,
+        feature_shards=shards,
+        coordinates=coords,
+        task_type=TaskType[args.task_type],
+        update_sequence=split(args.update_sequence),
+        coordinate_descent_iterations=args.coordinate_descent_iterations,
+        evaluators=split(args.evaluators),
+        normalization=NormalizationType[args.normalization],
+        data_validation=DataValidationType[args.data_validation],
+        model_input_dir=args.model_input_dir,
+        partial_retrain_locked_coordinates=split(
+            args.partial_retrain_locked_coordinates
+        ),
+        model_output_mode=ModelOutputMode[args.model_output_mode],
+        hyperparameter_tuning=HyperparameterTuningMode[args.hyperparameter_tuning],
+        hyperparameter_tuning_iter=args.hyperparameter_tuning_iter,
+        hyperparameter_tuning_range=tuple(
+            float(x) for x in args.hyperparameter_tuning_range.split(",")
+        ),
+        input_format=args.input_format,
+        override_output=args.override_output,
+    )
+
+
+def main(argv: Sequence[str] | None = None) -> dict:
+    logging.basicConfig(level=logging.INFO)
+    return run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

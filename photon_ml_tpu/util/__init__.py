@@ -1,0 +1,24 @@
+"""Utilities: timing, logging, events (reference photon-lib util/, photon-client event/)."""
+
+from photon_ml_tpu.util.events import (
+    Event,
+    EventEmitter,
+    OptimizationLogEvent,
+    SetupEvent,
+    TrainingFinishEvent,
+    TrainingStartEvent,
+)
+from photon_ml_tpu.util.logging_util import PhotonLogger
+from photon_ml_tpu.util.timed import Timed, timed
+
+__all__ = [
+    "Event",
+    "EventEmitter",
+    "OptimizationLogEvent",
+    "SetupEvent",
+    "TrainingFinishEvent",
+    "TrainingStartEvent",
+    "PhotonLogger",
+    "Timed",
+    "timed",
+]

@@ -1,0 +1,73 @@
+"""Training lifecycle events.
+
+Reference parity: photon-client event/ — Event, EventEmitter, EventListener;
+concrete events PhotonSetupEvent, TrainingStartEvent, TrainingFinishEvent,
+PhotonOptimizationLogEvent (emitted from Driver.scala:120-393). Listeners
+hook external telemetry into driver runs without coupling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """Base event; ``timestamp`` is seconds since epoch."""
+
+    timestamp: float = dataclasses.field(default_factory=time.time, kw_only=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class SetupEvent(Event):
+    config_summary: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingStartEvent(Event):
+    job_name: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingFinishEvent(Event):
+    job_name: str = ""
+    succeeded: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizationLogEvent(Event):
+    """Per-coordinate-update optimization telemetry (reference
+    PhotonOptimizationLogEvent)."""
+
+    coordinate_id: str = ""
+    iteration: int = 0
+    metrics: dict = dataclasses.field(default_factory=dict)
+
+
+EventListener = Callable[[Event], None]
+
+
+class EventEmitter:
+    """Synchronous fan-out of events to registered listeners; listener
+    exceptions are logged, never propagated (reference EventEmitter.scala)."""
+
+    def __init__(self):
+        self._listeners: list[EventListener] = []
+
+    def register(self, listener: EventListener) -> None:
+        self._listeners.append(listener)
+
+    def unregister(self, listener: EventListener) -> None:
+        self._listeners.remove(listener)
+
+    def send(self, event: Event) -> None:
+        for listener in self._listeners:
+            try:
+                listener(event)
+            except Exception:
+                logger.exception("event listener failed on %r", event)

@@ -1,0 +1,84 @@
+"""Wall-clock profiling of named blocks.
+
+Reference parity: photon-lib util/Timed.scala:33-77 — ``Timed("name"){...}``
+logs the duration of the block; used pervasively by the drivers and the
+coordinate-descent loop. Here a context manager / decorator; durations are
+also collected in a process-wide registry so drivers can print a phase
+summary, and each block emits a jax.profiler StepTraceAnnotation so phases
+line up with device traces in TensorBoard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+from functools import wraps
+
+logger = logging.getLogger("photon_ml_tpu.timing")
+
+#: name -> list of durations (seconds)
+_TIMINGS: dict[str, list[float]] = defaultdict(list)
+
+
+class Timed(contextlib.AbstractContextManager):
+    """``with Timed("read training data"): ...`` — logs and records."""
+
+    def __init__(self, name: str, log_level: int = logging.INFO):
+        self.name = name
+        self.log_level = log_level
+        self.duration: float | None = None
+
+    def __enter__(self):
+        self._annotation = None
+        try:
+            import jax.profiler
+
+            self._annotation = jax.profiler.TraceAnnotation(self.name)
+            self._annotation.__enter__()
+        except Exception:  # profiler unavailable: timing still works
+            self._annotation = None
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.duration = time.perf_counter() - self._start
+        if self._annotation is not None:
+            self._annotation.__exit__(exc_type, exc, tb)
+        _TIMINGS[self.name].append(self.duration)
+        logger.log(self.log_level, "%s took %.3f s", self.name, self.duration)
+        return False
+
+
+def timed(name: str | None = None):
+    """Decorator form of Timed."""
+
+    def decorate(fn):
+        label = name or fn.__qualname__
+
+        @wraps(fn)
+        def wrapper(*args, **kwargs):
+            with Timed(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorate
+
+
+def timing_summary() -> dict[str, dict[str, float]]:
+    """name -> {count, total, mean} over everything timed so far."""
+    return {
+        name: {
+            "count": len(durations),
+            "total": sum(durations),
+            "mean": sum(durations) / len(durations),
+        }
+        for name, durations in _TIMINGS.items()
+        if durations
+    }
+
+
+def reset_timings() -> None:
+    _TIMINGS.clear()

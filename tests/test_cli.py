@@ -1,0 +1,284 @@
+"""CLI driver tests: config grammar (reference ScoptParserHelpers tests) and
+end-to-end train -> score through the drivers (reference
+GameTrainingDriverIntegTest / GameScoringDriverIntegTest intent)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.configs import (
+    CoordinateCliConfig,
+    expand_reg_weight_grid,
+    parse_coordinate_config,
+    parse_feature_shard_config,
+    parse_kv_list,
+)
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import photon_schemas as schemas
+from photon_ml_tpu.optim.optimizer import OptimizerType
+from photon_ml_tpu.projector.projectors import ProjectorType
+
+
+class TestConfigGrammar:
+    def test_parse_kv_list(self):
+        assert parse_kv_list("a=1, b=x|y") == {"a": "1", "b": "x|y"}
+        with pytest.raises(ValueError, match="key=value"):
+            parse_kv_list("a=1,b")
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_kv_list("a=1,a=2")
+
+    def test_feature_shard(self):
+        name, cfg = parse_feature_shard_config(
+            "name=global,feature.bags=features|userFeatures,intercept=false"
+        )
+        assert name == "global"
+        assert cfg.feature_bags == ("features", "userFeatures")
+        assert not cfg.has_intercept
+        with pytest.raises(ValueError, match="unknown"):
+            parse_feature_shard_config("name=g,feature.bags=f,bogus=1")
+
+    def test_coordinate_fixed_effect(self):
+        cfg = parse_coordinate_config(
+            "name=fe,feature.shard=global,optimizer=TRON,"
+            "reg.weights=0.1|1|10,max.iter=25,variance=true"
+        )
+        assert not cfg.is_random_effect
+        assert cfg.optimizer == OptimizerType.TRON
+        assert cfg.reg_weights == (0.1, 1.0, 10.0)
+        assert cfg.max_iterations == 25
+        assert cfg.compute_variance
+        opt = cfg.optimization_config(1.0)
+        assert opt.l2_weight == 1.0 and opt.l1_weight == 0.0
+
+    def test_coordinate_random_effect_with_projection(self):
+        cfg = parse_coordinate_config(
+            "name=per-user,feature.shard=user,random.effect.type=userId,"
+            "active.data.upper.bound=512,projector=INDEX_MAP,reg.weights=1"
+        )
+        assert cfg.is_random_effect
+        assert cfg.active_data_upper_bound == 512
+        assert cfg.projector == ProjectorType.INDEX_MAP
+        est = cfg.estimator_config(1.0)
+        assert est.random_effect_type == "userId"
+
+    def test_elastic_net_split(self):
+        cfg = parse_coordinate_config(
+            "name=fe,feature.shard=g,reg.weights=10,reg.alpha=0.25"
+        )
+        opt = cfg.optimization_config(10.0)
+        assert opt.l1_weight == pytest.approx(2.5)
+        assert opt.l2_weight == pytest.approx(7.5)
+
+    def test_grid_expansion(self):
+        configs = {
+            "a": CoordinateCliConfig(name="a", feature_shard="g", reg_weights=(0.1, 1.0)),
+            "b": CoordinateCliConfig(name="b", feature_shard="g", reg_weights=(2.0,)),
+        }
+        grid = expand_reg_weight_grid(configs)
+        assert grid == [{"a": 0.1, "b": 2.0}, {"a": 1.0, "b": 2.0}]
+
+
+def _write_game_avro(path, n, seed, n_users=12, d=6):
+    """Synthetic GAME training file: global features + per-user effects via
+    metadataMap userId (TrainingExampleAvro layout). The ground truth is
+    drawn from a fixed seed so train/val share it; only the samples vary."""
+    truth = np.random.default_rng(1234)
+    w = truth.normal(size=d)
+    user_w = {f"u{i}": truth.normal(scale=0.5, size=d) for i in range(n_users)}
+    rng = np.random.default_rng(seed)
+    records = []
+    for i in range(n):
+        uid = f"u{rng.integers(0, n_users)}"
+        x = rng.normal(size=d)
+        y = x @ (w + user_w[uid]) + rng.normal(scale=0.1)
+        records.append(
+            {
+                "uid": str(i),
+                "label": float(y),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ],
+                "weight": 1.0,
+                "offset": 0.0,
+                "foldId": None,
+                "metadataMap": {"userId": uid, "queryId": f"q{i % 7}"},
+            }
+        )
+    os.makedirs(path, exist_ok=True)
+    avro_io.write_container(
+        os.path.join(path, "part-00000.avro"), schemas.TRAINING_EXAMPLE_AVRO, records
+    )
+
+
+@pytest.fixture(scope="module")
+def game_data(tmp_path_factory):
+    base = tmp_path_factory.mktemp("game-data")
+    _write_game_avro(base / "train", 800, seed=0)
+    _write_game_avro(base / "val", 300, seed=1)
+    return base
+
+
+class TestEndToEnd:
+    def test_train_then_score(self, game_data, tmp_path):
+        from photon_ml_tpu.cli import game_scoring_driver, game_training_driver
+
+        out = tmp_path / "out"
+        summary = game_training_driver.main(
+            [
+                "--input-data-path", str(game_data / "train"),
+                "--validation-data-path", str(game_data / "val"),
+                "--root-output-dir", str(out),
+                "--feature-shard-configurations",
+                "name=global,feature.bags=features,intercept=true",
+                "--coordinate-configurations",
+                "name=fe,feature.shard=global,reg.weights=0.01|1.0,max.iter=40",
+                "--coordinate-configurations",
+                "name=per-user,feature.shard=global,random.effect.type=userId,"
+                "reg.weights=0.1,max.iter=30",
+                "--task-type", "LINEAR_REGRESSION",
+                "--coordinate-descent-iterations", "2",
+                "--evaluators", "RMSE,RMSE:queryId",
+                "--data-validation", "VALIDATE_FULL",
+            ]
+        )
+        assert summary["num_configurations"] == 2
+        assert np.isfinite(summary["best_metric"])
+        assert summary["best_metric"] < 1.0  # signal recovered
+        # reference layout on disk
+        assert (out / "best" / "model-metadata.json").exists()
+        assert (out / "best" / "fixed-effect" / "fe" / "id-info").exists()
+        assert (out / "best" / "random-effect" / "per-user" / "id-info").exists()
+        assert (out / "models" / "0").is_dir() and (out / "models" / "1").is_dir()
+        assert (out / "index-maps" / "global.keys").exists()
+        assert (out / "training-summary.json").exists()
+        assert (out / "driver.log").exists()
+        assert (out / "feature-stats" / "global" / "part-00000.avro").exists()
+
+        score_out = tmp_path / "scores"
+        s = game_scoring_driver.main(
+            [
+                "--input-data-path", str(game_data / "val"),
+                "--model-input-dir", str(out / "best"),
+                "--output-dir", str(score_out),
+                "--evaluators", "RMSE",
+            ]
+        )
+        assert s["num_scored"] == 300
+        assert s["evaluations"]["RMSE"] == pytest.approx(summary["best_metric"], rel=0.2)
+        from photon_ml_tpu.io.model_io import read_scores
+
+        scores = read_scores(score_out / "scores")
+        assert len(scores) == 300
+        assert all(np.isfinite(r["predictionScore"]) for r in scores)
+
+    def test_output_dir_protection(self, game_data, tmp_path):
+        from photon_ml_tpu.cli import game_training_driver
+
+        out = tmp_path / "occupied"
+        out.mkdir()
+        (out / "something").write_text("x")
+        with pytest.raises(ValueError, match="non-empty"):
+            game_training_driver.main(
+                [
+                    "--input-data-path", str(game_data / "train"),
+                    "--root-output-dir", str(out),
+                    "--feature-shard-configurations",
+                    "name=global,feature.bags=features",
+                    "--coordinate-configurations",
+                    "name=fe,feature.shard=global",
+                    "--task-type", "LINEAR_REGRESSION",
+                ]
+            )
+
+    def test_param_validation(self, game_data, tmp_path):
+        from photon_ml_tpu.cli import game_training_driver
+
+        with pytest.raises(ValueError, match="undefined feature shard"):
+            game_training_driver.main(
+                [
+                    "--input-data-path", str(game_data / "train"),
+                    "--root-output-dir", str(tmp_path / "o1"),
+                    "--feature-shard-configurations",
+                    "name=global,feature.bags=features",
+                    "--coordinate-configurations",
+                    "name=fe,feature.shard=WRONG",
+                    "--task-type", "LINEAR_REGRESSION",
+                ]
+            )
+
+    def test_warm_start_and_partial_retrain(self, game_data, tmp_path):
+        from photon_ml_tpu.cli import game_training_driver
+
+        out1 = tmp_path / "stage1"
+        game_training_driver.main(
+            [
+                "--input-data-path", str(game_data / "train"),
+                "--root-output-dir", str(out1),
+                "--feature-shard-configurations",
+                "name=global,feature.bags=features",
+                "--coordinate-configurations",
+                "name=fe,feature.shard=global,max.iter=30",
+                "--task-type", "LINEAR_REGRESSION",
+            ]
+        )
+        out2 = tmp_path / "stage2"
+        summary = game_training_driver.main(
+            [
+                "--input-data-path", str(game_data / "train"),
+                "--validation-data-path", str(game_data / "val"),
+                "--root-output-dir", str(out2),
+                "--feature-shard-configurations",
+                "name=global,feature.bags=features",
+                "--coordinate-configurations",
+                "name=fe,feature.shard=global,max.iter=30",
+                "--coordinate-configurations",
+                "name=per-user,feature.shard=global,random.effect.type=userId,"
+                "reg.weights=0.1,max.iter=30",
+                "--task-type", "LINEAR_REGRESSION",
+                "--model-input-dir", str(out1 / "best"),
+                "--partial-retrain-locked-coordinates", "fe",
+                "--evaluators", "RMSE",
+            ]
+        )
+        assert np.isfinite(summary["best_metric"])
+        # locked fe model must be identical to stage1's
+        from photon_ml_tpu.io.index_map import IndexMap
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        imaps = {"global": IndexMap.load(out1 / "index-maps", "global")}
+        m1 = load_game_model(out1 / "best", imaps)
+        m2 = load_game_model(out2 / "best", imaps)
+        np.testing.assert_allclose(
+            np.asarray(m2.get("fe").glm.coefficients.means),
+            np.asarray(m1.get("fe").glm.coefficients.means),
+            atol=1e-6,
+        )
+
+    def test_feature_indexing_and_name_term_drivers(self, game_data, tmp_path):
+        from photon_ml_tpu.cli import (
+            feature_indexing_driver,
+            name_term_feature_bags_driver,
+        )
+
+        sizes = feature_indexing_driver.main(
+            [
+                "--input-data-path", str(game_data / "train"),
+                "--output-dir", str(tmp_path / "index"),
+                "--feature-shard-configurations",
+                "name=global,feature.bags=features",
+            ]
+        )
+        assert sizes["global"] == 7  # 6 features + intercept
+        counts = name_term_feature_bags_driver.main(
+            [
+                "--input-data-path", str(game_data / "train"),
+                "--output-dir", str(tmp_path / "bags"),
+                "--feature-bags", "features",
+            ]
+        )
+        assert counts["features"] == 6
+        lines = (tmp_path / "bags" / "features" / "part-00000.tsv").read_text().splitlines()
+        assert lines[0].split("\t")[0] == "f0"

@@ -1,0 +1,148 @@
+"""Tests for data validators (reference DataValidatorsTest intent) and the
+Timed/PhotonLogger/EventEmitter utilities."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.validators import (
+    DataValidationError,
+    DataValidationType,
+    validate_arrays,
+    validate_game_dataset,
+)
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.util import (
+    EventEmitter,
+    OptimizationLogEvent,
+    PhotonLogger,
+    Timed,
+    TrainingStartEvent,
+)
+from photon_ml_tpu.util.timed import reset_timings, timed, timing_summary
+
+
+class TestValidators:
+    def test_clean_data_passes(self):
+        validate_arrays(
+            labels=np.array([0.0, 1.0]),
+            task=TaskType.LOGISTIC_REGRESSION,
+            offsets=np.zeros(2),
+            weights=np.ones(2),
+            feature_shards={"g": np.ones((2, 3))},
+        )
+
+    def test_nan_label_fails(self):
+        with pytest.raises(DataValidationError, match="labels"):
+            validate_arrays(
+                labels=np.array([0.0, np.nan]), task=TaskType.LINEAR_REGRESSION
+            )
+
+    def test_non_binary_labels_fail_logistic(self):
+        with pytest.raises(DataValidationError, match="binary"):
+            validate_arrays(
+                labels=np.array([0.0, 2.0]), task=TaskType.LOGISTIC_REGRESSION
+            )
+
+    def test_negative_labels_fail_poisson(self):
+        with pytest.raises(DataValidationError, match="non-negative"):
+            validate_arrays(
+                labels=np.array([1.0, -1.0]), task=TaskType.POISSON_REGRESSION
+            )
+
+    def test_multiple_failures_aggregated(self):
+        with pytest.raises(DataValidationError) as err:
+            validate_arrays(
+                labels=np.array([np.inf, 2.0]),
+                task=TaskType.LOGISTIC_REGRESSION,
+                weights=np.array([-1.0, 1.0]),
+                feature_shards={"g": np.full((2, 2), np.nan)},
+            )
+        msg = str(err.value)
+        assert "labels" in msg and "binary" in msg
+        assert "negative" in msg and "shard 'g'" in msg
+
+    def test_disabled_skips(self):
+        validate_arrays(
+            labels=np.array([np.nan]),
+            task=TaskType.LINEAR_REGRESSION,
+            validation_type=DataValidationType.VALIDATE_DISABLED,
+        )
+
+    def test_sample_mode_checks_subset(self):
+        # clean data passes in sample mode on a large array
+        validate_arrays(
+            labels=np.zeros(100_000),
+            task=TaskType.LINEAR_REGRESSION,
+            validation_type=DataValidationType.VALIDATE_SAMPLE,
+        )
+
+    def test_game_dataset_validation(self):
+        from photon_ml_tpu.data.game_data import build_game_dataset
+
+        ds = build_game_dataset(
+            labels=np.array([0.0, 1.0]), feature_shards={"g": np.ones((2, 2))}
+        )
+        validate_game_dataset(ds, TaskType.LOGISTIC_REGRESSION)
+        bad = build_game_dataset(
+            labels=np.array([0.0, 3.0]), feature_shards={"g": np.ones((2, 2))}
+        )
+        with pytest.raises(DataValidationError):
+            validate_game_dataset(bad, TaskType.LOGISTIC_REGRESSION)
+
+
+class TestTimed:
+    def test_records_duration(self):
+        reset_timings()
+        with Timed("block") as t:
+            pass
+        assert t.duration is not None and t.duration >= 0
+        summary = timing_summary()
+        assert summary["block"]["count"] == 1
+
+    def test_decorator(self):
+        reset_timings()
+
+        @timed("fn")
+        def f(x):
+            return x + 1
+
+        assert f(1) == 2
+        assert timing_summary()["fn"]["count"] == 1
+
+
+class TestLogger:
+    def test_copies_on_close(self, tmp_path):
+        dest = tmp_path / "out" / "job.log"
+        with PhotonLogger(dest, level=logging.INFO, name="test.job") as log:
+            log.info("hello %s", "world")
+            log.debug("hidden at INFO level")
+        text = dest.read_text()
+        assert "hello world" in text
+        assert "hidden" not in text
+
+
+class TestEvents:
+    def test_fan_out_and_error_isolation(self):
+        emitter = EventEmitter()
+        seen = []
+        emitter.register(seen.append)
+
+        def bad(_):
+            raise RuntimeError("boom")
+
+        emitter.register(bad)
+        emitter.send(TrainingStartEvent(job_name="j"))
+        emitter.send(OptimizationLogEvent(coordinate_id="fe", iteration=1, metrics={"loss": 1.0}))
+        assert len(seen) == 2
+        assert seen[0].job_name == "j"
+        assert seen[1].metrics == {"loss": 1.0}
+
+    def test_unregister(self):
+        emitter = EventEmitter()
+        seen = []
+        emitter.register(seen.append)
+        emitter.unregister(seen.append)
+        emitter.send(TrainingStartEvent(job_name="x"))
+        assert seen == []
