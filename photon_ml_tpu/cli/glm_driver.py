@@ -1,0 +1,294 @@
+"""Legacy single-GLM training driver with staged pipeline + diagnostics.
+
+Reference parity: photon-client Driver.scala — staged pipeline
+INIT -> PREPROCESSED -> TRAINED -> VALIDATED -> DIAGNOSED (:158-218), train
+via ModelTraining over the λ grid with warm starts (:334-368), validation
+metrics + best-model selection (:373-450, ModelSelection.scala), diagnostics
++ HTML report (:608-635, 719-739), text model output (IOUtils
+writeModelsInText, :211-215).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import enum
+import json
+import logging
+import os
+from typing import Sequence
+
+import numpy as np
+
+from photon_ml_tpu.data.batch import LabeledPointBatch, summarize
+from photon_ml_tpu.data.validators import DataValidationType, validate_arrays
+from photon_ml_tpu.diagnostics.metrics import METRIC_DIRECTIONS, evaluate_model
+from photon_ml_tpu.diagnostics.report_builder import build_diagnostic_report
+from photon_ml_tpu.diagnostics.reporting import render_html, render_text
+from photon_ml_tpu.estimators import train_glm
+from photon_ml_tpu.io.data_reader import (
+    FeatureShardConfiguration,
+    build_index_maps,
+    read_avro_records,
+    read_libsvm,
+    records_to_game_dataset,
+)
+from photon_ml_tpu.io.model_io import write_glm_text
+from photon_ml_tpu.ops.normalization import NormalizationType, build_normalization
+from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.util import PhotonLogger, Timed
+
+logger = logging.getLogger(__name__)
+
+
+class DriverStage(enum.Enum):
+    """Reference: DriverStage.scala."""
+
+    INIT = 0
+    PREPROCESSED = 1
+    TRAINED = 2
+    VALIDATED = 3
+    DIAGNOSED = 4
+
+
+#: model selection metric per task (reference ModelSelection.scala:
+#: best AUC for classification, best RMSE for regression)
+_SELECTION_METRIC = {
+    TaskType.LOGISTIC_REGRESSION: "AUC",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: "AUC",
+    TaskType.LINEAR_REGRESSION: "RMSE",
+    TaskType.POISSON_REGRESSION: "POISSON_LOSS",
+}
+
+
+@dataclasses.dataclass
+class GLMDriverParams:
+    input_data_path: str
+    output_dir: str
+    task_type: TaskType
+    validation_data_path: str | None = None
+    regularization_weights: tuple[float, ...] = (0.0,)
+    elastic_net_alpha: float = 0.0
+    optimizer: OptimizerType = OptimizerType.LBFGS
+    max_iterations: int = 100
+    tolerance: float = 1e-7
+    normalization: NormalizationType = NormalizationType.NONE
+    data_validation: DataValidationType = DataValidationType.VALIDATE_DISABLED
+    enable_diagnostics: bool = False
+    num_bootstraps: int = 0
+    compute_variance: bool = False
+    input_format: str = "avro"
+
+
+@dataclasses.dataclass
+class GLMDriverResult:
+    stage: DriverStage
+    models: dict
+    best_lambda: float | None
+    validation_metrics: dict
+    summary_path: str
+
+
+def _read_batch(path: str, fmt: str, shard_cfg, index_maps=None):
+    records = read_avro_records(path) if fmt == "avro" else read_libsvm(path)
+    records = list(records)
+    if index_maps is None:
+        index_maps = build_index_maps(records, shard_cfg)
+    result = records_to_game_dataset(records, shard_cfg, index_maps)
+    ds = result.dataset
+    batch = LabeledPointBatch(
+        features=ds.feature_shards["features"],
+        labels=ds.labels,
+        offsets=ds.offsets,
+        weights=ds.weights,
+    )
+    return batch, result.index_maps, result.intercept_indices.get("features")
+
+
+def run(params: GLMDriverParams) -> GLMDriverResult:
+    os.makedirs(params.output_dir, exist_ok=True)
+    stage = DriverStage.INIT
+    shard_cfg = {"features": FeatureShardConfiguration(feature_bags=("features",))}
+
+    with PhotonLogger(os.path.join(params.output_dir, "driver.log")) as job_log:
+        # PREPROCESS
+        with Timed("glm preprocess"):
+            batch, index_maps, intercept_index = _read_batch(
+                params.input_data_path, params.input_format, shard_cfg
+            )
+            validate_arrays(
+                labels=np.asarray(batch.labels),
+                task=params.task_type,
+                offsets=np.asarray(batch.offsets),
+                weights=np.asarray(batch.weights),
+                feature_shards={"features": np.asarray(batch.features)},
+                validation_type=params.data_validation,
+            )
+            norm = None
+            if params.normalization != NormalizationType.NONE:
+                stats = summarize(np.asarray(batch.features), np.asarray(batch.weights))
+                import jax.numpy as jnp
+
+                norm = build_normalization(
+                    params.normalization,
+                    mean=jnp.asarray(stats["mean"]),
+                    variance=jnp.asarray(stats["variance"]),
+                    max_magnitude=jnp.asarray(stats["max_magnitude"]),
+                    intercept_index=intercept_index,
+                )
+        stage = DriverStage.PREPROCESSED
+        job_log.info("preprocessed %d samples, %d features", batch.num_samples, batch.dim)
+
+        # TRAIN
+        opt = OptimizerConfig(
+            optimizer_type=params.optimizer,
+            max_iterations=params.max_iterations,
+            tolerance=params.tolerance,
+        )
+
+        def fit(b: LabeledPointBatch, lams) -> dict:
+            return train_glm(
+                b,
+                params.task_type,
+                optimizer=opt,
+                regularization_weights=lams,
+                elastic_net_alpha=params.elastic_net_alpha,
+                normalization=norm,
+                intercept_index=intercept_index,
+                compute_variance=params.compute_variance,
+            )
+
+        with Timed("glm train"):
+            models = fit(batch, params.regularization_weights)
+        stage = DriverStage.TRAINED
+        write_glm_text(
+            os.path.join(params.output_dir, "models-text"),
+            models,
+            index_maps["features"],
+        )
+
+        # VALIDATE
+        best_lambda = None
+        validation_metrics: dict = {}
+        val_batch = None
+        if params.validation_data_path:
+            with Timed("glm validate"):
+                val_batch, _, _ = _read_batch(
+                    params.validation_data_path, params.input_format, shard_cfg,
+                    index_maps,
+                )
+                metric = _SELECTION_METRIC[params.task_type]
+                larger = METRIC_DIRECTIONS[metric]
+                best_value = None
+                for lam, model in sorted(models.items()):
+                    m = evaluate_model(model, val_batch)
+                    validation_metrics[lam] = m
+                    value = m[metric]
+                    if best_value is None or (value > best_value) == larger:
+                        best_value, best_lambda = value, lam
+            stage = DriverStage.VALIDATED
+            job_log.info("best λ=%s by %s=%s", best_lambda, metric, best_value)
+
+        # DIAGNOSE
+        if params.enable_diagnostics:
+            if val_batch is None:
+                raise ValueError("diagnostics require --validation-data-path")
+            with Timed("glm diagnose"):
+                report = build_diagnostic_report(
+                    models,
+                    batch,
+                    val_batch,
+                    task=params.task_type,
+                    train_fn_for_lambda=lambda lam: (
+                        lambda b: fit(b, (lam,))[lam]
+                    ),
+                    best_lambda=best_lambda if best_lambda is not None else
+                    sorted(models)[0],
+                    index_map=index_maps["features"],
+                    num_bootstraps=params.num_bootstraps,
+                    validation_metrics=validation_metrics,
+                )
+                with open(
+                    os.path.join(params.output_dir, "diagnostic-report.html"), "w"
+                ) as f:
+                    f.write(render_html(report))
+                with open(
+                    os.path.join(params.output_dir, "diagnostic-report.txt"), "w"
+                ) as f:
+                    f.write(render_text(report))
+            stage = DriverStage.DIAGNOSED
+
+    summary_path = os.path.join(params.output_dir, "glm-summary.json")
+    with open(summary_path, "w") as f:
+        json.dump(
+            {
+                "stage": stage.name,
+                "lambdas": sorted(models),
+                "best_lambda": best_lambda,
+                "validation_metrics": {
+                    str(k): v for k, v in validation_metrics.items()
+                },
+            },
+            f,
+            indent=2,
+            default=float,
+        )
+    return GLMDriverResult(
+        stage=stage,
+        models=models,
+        best_lambda=best_lambda,
+        validation_metrics=validation_metrics,
+        summary_path=summary_path,
+    )
+
+
+def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(prog="glm_driver", description=__doc__.split("\n")[0])
+    p.add_argument("--input-data-path", required=True)
+    p.add_argument("--validation-data-path")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--task-type", required=True,
+                   choices=[t.name for t in TaskType if t != TaskType.NONE])
+    p.add_argument("--regularization-weights", default="0",
+                   help="comma-separated λ grid")
+    p.add_argument("--elastic-net-alpha", type=float, default=0.0)
+    p.add_argument("--optimizer", default="LBFGS",
+                   choices=[o.name for o in OptimizerType])
+    p.add_argument("--max-iterations", type=int, default=100)
+    p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument("--normalization", default="NONE",
+                   choices=[n.name for n in NormalizationType])
+    p.add_argument("--data-validation", default="VALIDATE_DISABLED",
+                   choices=[v.name for v in DataValidationType])
+    p.add_argument("--enable-diagnostics", action="store_true")
+    p.add_argument("--num-bootstraps", type=int, default=0)
+    p.add_argument("--compute-variance", action="store_true")
+    p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
+    args = p.parse_args(argv)
+    return run(
+        GLMDriverParams(
+            input_data_path=args.input_data_path,
+            validation_data_path=args.validation_data_path,
+            output_dir=args.output_dir,
+            task_type=TaskType[args.task_type],
+            regularization_weights=tuple(
+                float(x) for x in args.regularization_weights.split(",") if x
+            ),
+            elastic_net_alpha=args.elastic_net_alpha,
+            optimizer=OptimizerType[args.optimizer],
+            max_iterations=args.max_iterations,
+            tolerance=args.tolerance,
+            normalization=NormalizationType[args.normalization],
+            data_validation=DataValidationType[args.data_validation],
+            enable_diagnostics=args.enable_diagnostics,
+            num_bootstraps=args.num_bootstraps,
+            compute_variance=args.compute_variance,
+            input_format=args.input_format,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Feature importance diagnostics.
+
+Reference parity: photon-diagnostics diagnostics/featureimportance/ —
+expected-magnitude importance (|w_j|·E|x_j|: contribution scale of the
+feature to the margin) and variance-based importance (w_j²·Var[x_j]:
+contribution to margin variance), ranked descending.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureImportance:
+    index: int
+    name: str
+    importance: float
+
+
+@dataclasses.dataclass
+class FeatureImportanceReport:
+    kind: str  # "expected_magnitude" | "variance"
+    ranked: list[FeatureImportance]
+
+    def top(self, k: int) -> list[FeatureImportance]:
+        return self.ranked[:k]
+
+
+def feature_importance(
+    model: GeneralizedLinearModel,
+    batch: LabeledPointBatch,
+    *,
+    kind: str = "expected_magnitude",
+    index_map: IndexMap | None = None,
+) -> FeatureImportanceReport:
+    w = np.asarray(model.coefficients.means, dtype=np.float64)
+    x = np.asarray(batch.features, dtype=np.float64)
+    sw = np.asarray(batch.weights, dtype=np.float64)
+    total = sw.sum()
+    if kind == "expected_magnitude":
+        scores = np.abs(w) * (sw @ np.abs(x)) / total
+    elif kind == "variance":
+        mean = (sw @ x) / total
+        var = (sw @ (x - mean) ** 2) / total
+        scores = w**2 * var
+    else:
+        raise ValueError(f"unknown importance kind {kind!r}")
+
+    order = np.argsort(-scores)
+    ranked = [
+        FeatureImportance(
+            index=int(j),
+            name=(index_map.get_feature_name(int(j)) or str(j)) if index_map else str(j),
+            importance=float(scores[j]),
+        )
+        for j in order
+    ]
+    return FeatureImportanceReport(kind=kind, ranked=ranked)

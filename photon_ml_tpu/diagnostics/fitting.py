@@ -1,0 +1,71 @@
+"""Fitting (learning-curve) diagnostic.
+
+Reference parity: photon-diagnostics diagnostics/fitting/
+FittingDiagnostic.scala:1-131 — train on growing portions of the data,
+record train and held-out metrics per portion; diverging curves indicate
+over/under-fitting.
+
+TPU-native: portions are weight masks over the fixed-shape batch (prefix of
+a stable shuffled order), so every portion reuses the compiled solver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.diagnostics.metrics import evaluate_model
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+
+TrainFn = Callable[[LabeledPointBatch], GeneralizedLinearModel]
+
+DEFAULT_PORTIONS = (0.125, 0.25, 0.5, 0.75, 1.0)
+
+
+@dataclasses.dataclass
+class FittingReport:
+    portions: list[float]
+    train_metrics: list[dict[str, float]]
+    test_metrics: list[dict[str, float]]
+
+    def metric_curve(self, metric: str) -> tuple[list[float], list[float], list[float]]:
+        """(portion, train, test) series for one metric."""
+        return (
+            self.portions,
+            [m.get(metric, float("nan")) for m in self.train_metrics],
+            [m.get(metric, float("nan")) for m in self.test_metrics],
+        )
+
+
+def fitting_diagnostic(
+    train_fn: TrainFn,
+    batch: LabeledPointBatch,
+    validation_batch: LabeledPointBatch,
+    *,
+    portions: Sequence[float] = DEFAULT_PORTIONS,
+    seed: int = 0,
+) -> FittingReport:
+    rng = np.random.default_rng(seed)
+    n = batch.num_samples
+    order = rng.permutation(n)
+    base_weights = np.asarray(batch.weights)
+
+    train_metrics, test_metrics = [], []
+    for portion in portions:
+        if not 0.0 < portion <= 1.0:
+            raise ValueError(f"portion must be in (0, 1], got {portion}")
+        k = max(1, int(round(portion * n)))
+        mask = np.zeros(n, dtype=base_weights.dtype)
+        mask[order[:k]] = 1.0
+        sub = batch.replace(weights=base_weights * mask)
+        model = train_fn(sub)
+        train_metrics.append(evaluate_model(model, sub))
+        test_metrics.append(evaluate_model(model, validation_batch))
+    return FittingReport(
+        portions=list(portions),
+        train_metrics=train_metrics,
+        test_metrics=test_metrics,
+    )

@@ -65,7 +65,9 @@ def read_libsvm(path: str | os.PathLike, *, zero_based: bool = False) -> Iterato
                 continue
             parts = line.split()
             raw_label = float(parts[0])
-            label = 1.0 if raw_label > 0 else 0.0
+            # ±1 is the LibSVM binary-classification convention (a1a); map it
+            # to {0,1}. Any other value is a regression target — keep it.
+            label = (1.0 if raw_label > 0 else 0.0) if raw_label in (-1.0, 1.0) else raw_label
             features = []
             for tok in parts[1:]:
                 idx_s, _, val_s = tok.partition(":")
