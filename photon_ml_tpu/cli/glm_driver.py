@@ -26,13 +26,7 @@ from photon_ml_tpu.diagnostics.metrics import METRIC_DIRECTIONS, evaluate_model
 from photon_ml_tpu.diagnostics.report_builder import build_diagnostic_report
 from photon_ml_tpu.diagnostics.reporting import render_html, render_text
 from photon_ml_tpu.estimators import train_glm
-from photon_ml_tpu.io.data_reader import (
-    FeatureShardConfiguration,
-    build_index_maps,
-    read_avro_records,
-    read_libsvm,
-    records_to_game_dataset,
-)
+from photon_ml_tpu.io.data_reader import FeatureShardConfiguration, read_merged
 from photon_ml_tpu.io.model_io import write_glm_text
 from photon_ml_tpu.ops.normalization import NormalizationType, build_normalization
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
@@ -91,11 +85,7 @@ class GLMDriverResult:
 
 
 def _read_batch(path: str, fmt: str, shard_cfg, index_maps=None):
-    records = read_avro_records(path) if fmt == "avro" else read_libsvm(path)
-    records = list(records)
-    if index_maps is None:
-        index_maps = build_index_maps(records, shard_cfg)
-    result = records_to_game_dataset(records, shard_cfg, index_maps)
+    result = read_merged(path, shard_cfg, index_maps=index_maps, fmt=fmt)
     ds = result.dataset
     batch = LabeledPointBatch(
         features=ds.feature_shards["features"],
@@ -185,6 +175,8 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
                     m = evaluate_model(model, val_batch)
                     validation_metrics[lam] = m
                     value = m[metric]
+                    if np.isnan(value):  # a diverged model never wins
+                        continue
                     if best_value is None or (value > best_value) == larger:
                         best_value, best_lambda = value, lam
             stage = DriverStage.VALIDATED
@@ -194,6 +186,10 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
         if params.enable_diagnostics:
             if val_batch is None:
                 raise ValueError("diagnostics require --validation-data-path")
+            if best_lambda is None:
+                raise ValueError(
+                    "no model produced a finite validation metric; nothing to diagnose"
+                )
             with Timed("glm diagnose"):
                 report = build_diagnostic_report(
                     models,
@@ -203,8 +199,7 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
                     train_fn_for_lambda=lambda lam: (
                         lambda b: fit(b, (lam,))[lam]
                     ),
-                    best_lambda=best_lambda if best_lambda is not None else
-                    sorted(models)[0],
+                    best_lambda=best_lambda,
                     index_map=index_maps["features"],
                     num_bootstraps=params.num_bootstraps,
                     validation_metrics=validation_metrics,

@@ -1,0 +1,275 @@
+"""Off-heap (memory-mapped) feature index maps.
+
+Reference parity: photon-api index/PalDBIndexMap.scala:26-56 and
+PalDBIndexMapBuilder — the reference keeps huge feature vocabularies out of
+JVM heap in partitioned PalDB stores. Here a native C++ mmap hash store
+(photon_ml_tpu/native/offheap_store.cpp) serves lookups with zero Python
+heap cost per key; partitioning (hash(key) % P, global indices stored
+directly) matches the reference's partitioned layout without its offset
+arithmetic. A pure-Python mmap reader covers compiler-less environments.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import struct
+from typing import Iterator, Mapping
+
+import numpy as np
+
+from photon_ml_tpu.io.index_map import INTERCEPT_KEY, IndexMap
+
+_MAGIC = b"PHOTONIX"
+_HEADER = struct.Struct("<8sQQQQ")
+
+
+def _fnv1a(data: bytes) -> int:
+    h = 1469598103934665603
+    for b in data:
+        h ^= b
+        h = (h * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class _PyStore:
+    """Pure-Python reader for the photonix format (fallback)."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "rb")
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        magic, version, n, table_size, _blob = _HEADER.unpack_from(self._mm, 0)
+        if magic != _MAGIC or version != 1:
+            raise ValueError(f"{path} is not a photonix store")
+        self.n = n
+        self.table_size = table_size
+        self._off_base = _HEADER.size
+        self._table_base = self._off_base + 8 * (n + 1)
+        self._blob_base = self._table_base + 8 * table_size
+
+    def _offset(self, i: int) -> int:
+        return struct.unpack_from("<Q", self._mm, self._off_base + 8 * i)[0]
+
+    def _key_bytes(self, idx: int) -> bytes:
+        start, end = self._offset(idx), self._offset(idx + 1)
+        return self._mm[self._blob_base + start : self._blob_base + end]
+
+    def get(self, key: bytes) -> int:
+        mask = self.table_size - 1
+        slot = _fnv1a(key) & mask
+        while True:
+            entry = struct.unpack_from("<Q", self._mm, self._table_base + 8 * slot)[0]
+            if entry == 0:
+                return -1
+            idx = entry - 1
+            if self._key_bytes(idx) == key:
+                return idx
+            slot = (slot + 1) & mask
+
+    def key_at(self, idx: int) -> bytes | None:
+        if 0 <= idx < self.n:
+            return self._key_bytes(idx)
+        return None
+
+    def close(self):
+        self._mm.close()
+        self._f.close()
+
+
+class _NativeStore:
+    """ctypes wrapper over the C++ store."""
+
+    def __init__(self, path: str):
+        from photon_ml_tpu.native import load_offheap_library
+
+        self._lib = load_offheap_library()
+        self._handle = self._lib.om_open(path.encode())
+        if not self._handle:
+            raise ValueError(f"cannot open photonix store at {path}")
+        self.n = self._lib.om_size(self._handle)
+        self._buf = ctypes.create_string_buffer(4096)
+
+    def get(self, key: bytes) -> int:
+        return self._lib.om_get(self._handle, key, len(key))
+
+    def key_at(self, idx: int) -> bytes | None:
+        length = self._lib.om_key_at(self._handle, idx, self._buf, len(self._buf))
+        if length < 0:
+            return None
+        if length > len(self._buf):
+            self._buf = ctypes.create_string_buffer(length)
+            self._lib.om_key_at(self._handle, idx, self._buf, len(self._buf))
+        return self._buf.raw[:length]
+
+    def close(self):
+        if self._handle:
+            self._lib.om_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def build_offheap_store(
+    directory: str | os.PathLike,
+    index_map: Mapping[str, int],
+    *,
+    num_partitions: int = 1,
+    name: str = "index",
+) -> list[str]:
+    """Write an IndexMap to ``num_partitions`` photonix store files.
+
+    Partition of a key = hash(key_bytes) % P (reference PalDBIndexMap
+    partitioning); each store holds its keys sorted by global index, and the
+    global index is recovered as offsets stored per partition.
+    """
+    os.makedirs(directory, exist_ok=True)
+    ordered = sorted(index_map.items(), key=lambda kv: kv[1])
+    if [i for _, i in ordered] != list(range(len(ordered))):
+        raise ValueError("index map must be dense 0..n-1")
+
+    partitions: list[list[tuple[bytes, int]]] = [[] for _ in range(num_partitions)]
+    for key, idx in ordered:
+        kb = key.encode("utf-8")
+        partitions[_fnv1a(kb) % num_partitions].append((kb, idx))
+
+    from photon_ml_tpu.native import load_offheap_library
+
+    lib = load_offheap_library()
+    paths = []
+    for p, members in enumerate(partitions):
+        blob = b"".join(kb for kb, _ in members)
+        offsets = [0]
+        for kb, _ in members:
+            offsets.append(offsets[-1] + len(kb))
+        # global index of each local slot, stored as a sidecar array
+        globals_arr = [idx for _, idx in members]
+        path = os.path.join(str(directory), f"{name}.part-{p:05d}.photonix")
+        off_arr = (ctypes.c_uint64 * len(offsets))(*offsets)
+        rc = lib.om_build(path.encode(), blob, off_arr, len(members))
+        if rc != 0:
+            raise RuntimeError(f"om_build failed with code {rc} for {path}")
+        with open(path + ".idx", "wb") as f:
+            f.write(struct.pack(f"<{len(globals_arr)}Q", *globals_arr))
+        paths.append(path)
+    with open(os.path.join(str(directory), f"{name}.photonix.json"), "w") as f:
+        import json
+
+        json.dump(
+            {"num_partitions": num_partitions, "size": len(ordered), "name": name}, f
+        )
+    return paths
+
+
+class OffHeapIndexMap(Mapping[str, int]):
+    """IndexMap-compatible reader over partitioned photonix stores.
+
+    Drop-in for io.index_map.IndexMap in readers/writers: supports
+    get_index / get_feature_name / size / intercept lookups with O(1) mmap
+    probes and no per-key Python objects.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        name: str = "index",
+        *,
+        force_python: bool = False,
+    ):
+        import json
+
+        with open(os.path.join(str(directory), f"{name}.photonix.json")) as f:
+            meta = json.load(f)
+        self._size = meta["size"]
+        self._num_partitions = meta["num_partitions"]
+        self._stores = []
+        #: per-partition numpy uint64 arrays — no per-key Python objects
+        self._globals: list["np.ndarray"] = []
+        #: lazy argsort-based reverse index (global -> partition/local)
+        self._rev_part: "np.ndarray | None" = None
+        self._rev_local: "np.ndarray | None" = None
+        use_native = not force_python
+        if use_native:
+            from photon_ml_tpu.native import native_available
+
+            use_native = native_available()
+        for p in range(self._num_partitions):
+            path = os.path.join(str(directory), f"{name}.part-{p:05d}.photonix")
+            store = _NativeStore(path) if use_native else _PyStore(path)
+            self._stores.append(store)
+            with open(path + ".idx", "rb") as f:
+                raw = f.read()
+            self._globals.append(np.frombuffer(raw, dtype=np.uint64))
+
+    # Reference API ----------------------------------------------------------
+    def get_index(self, key: str) -> int:
+        kb = key.encode("utf-8")
+        p = _fnv1a(kb) % self._num_partitions
+        local = self._stores[p].get(kb)
+        return -1 if local < 0 else int(self._globals[p][local])
+
+    def get_feature_name(self, index: int) -> str | None:
+        if not 0 <= index < self._size:
+            return None
+        if self._rev_part is None:
+            # dense flat arrays indexed by global id: partition + local slot
+            self._rev_part = np.zeros(self._size, dtype=np.int32)
+            self._rev_local = np.zeros(self._size, dtype=np.int64)
+            for p, globals_arr in enumerate(self._globals):
+                g = globals_arr.astype(np.int64)
+                self._rev_part[g] = p
+                self._rev_local[g] = np.arange(len(g), dtype=np.int64)
+        p = int(self._rev_part[index])
+        kb = self._stores[p].key_at(int(self._rev_local[index]))
+        return None if kb is None else kb.decode("utf-8")
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    @property
+    def has_intercept(self) -> bool:
+        return self.get_index(INTERCEPT_KEY) >= 0
+
+    @property
+    def intercept_index(self) -> int | None:
+        idx = self.get_index(INTERCEPT_KEY)
+        return None if idx < 0 else idx
+
+    def close(self) -> None:
+        for store in self._stores:
+            store.close()
+
+    # Mapping protocol -------------------------------------------------------
+    def __getitem__(self, key: str) -> int:
+        idx = self.get_index(key)
+        if idx < 0:
+            raise KeyError(key)
+        return idx
+
+    def __len__(self) -> int:
+        return self._size
+
+    def __iter__(self) -> Iterator[str]:
+        for index in range(self._size):
+            name = self.get_feature_name(index)
+            if name is not None:
+                yield name
+
+    @classmethod
+    def build(
+        cls,
+        directory: str | os.PathLike,
+        index_map: Mapping[str, int] | IndexMap,
+        *,
+        num_partitions: int = 1,
+        name: str = "index",
+    ) -> "OffHeapIndexMap":
+        build_offheap_store(
+            directory, index_map, num_partitions=num_partitions, name=name
+        )
+        return cls(directory, name)

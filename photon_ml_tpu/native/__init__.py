@@ -1,0 +1,5 @@
+"""Native (C++) runtime components, loaded via ctypes."""
+
+from photon_ml_tpu.native.build import load_offheap_library, native_available
+
+__all__ = ["load_offheap_library", "native_available"]

@@ -1,0 +1,107 @@
+"""Compile-on-demand loader for the native off-heap store library.
+
+The .so is built once from offheap_store.cpp with the system g++ and cached
+next to the source (rebuilt when the source changes, keyed by mtime+size).
+Everything degrades gracefully: ``native_available()`` is False when no
+compiler exists, and callers fall back to the pure-Python reader.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import shutil
+import subprocess
+import tempfile
+import threading
+
+logger = logging.getLogger(__name__)
+
+_SOURCE = os.path.join(os.path.dirname(__file__), "offheap_store.cpp")
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_LOAD_FAILED = False
+
+
+def _lib_path() -> str:
+    src_stat = os.stat(_SOURCE)
+    tag = f"{src_stat.st_mtime_ns}-{src_stat.st_size}"
+    return os.path.join(
+        os.path.dirname(_SOURCE), f"_offheap_store-{tag}.so"
+    )
+
+
+def _compile(out_path: str) -> None:
+    gxx = shutil.which("g++") or shutil.which("c++")
+    if gxx is None:
+        raise RuntimeError("no C++ compiler found")
+    # build into a temp file then atomically rename (concurrent test workers)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(out_path))
+    os.close(fd)
+    try:
+        subprocess.run(
+            [gxx, "-O2", "-std=c++17", "-shared", "-fPIC", _SOURCE, "-o", tmp],
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+        os.replace(tmp, out_path)
+    except subprocess.CalledProcessError as e:
+        os.unlink(tmp)
+        raise RuntimeError(f"g++ failed: {e.stderr}") from e
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_offheap_library() -> ctypes.CDLL:
+    """Load (compiling if needed) the native library; raises on failure."""
+    global _LIB, _LOAD_FAILED
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _LOAD_FAILED:
+            raise RuntimeError("native off-heap library previously failed to load")
+        try:
+            path = _lib_path()
+            if not os.path.exists(path):
+                logger.info("compiling native off-heap store library")
+                _compile(path)
+            lib = ctypes.CDLL(path)
+            lib.om_build.restype = ctypes.c_int64
+            lib.om_build.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_uint64,
+            ]
+            lib.om_open.restype = ctypes.c_void_p
+            lib.om_open.argtypes = [ctypes.c_char_p]
+            lib.om_close.restype = None
+            lib.om_close.argtypes = [ctypes.c_void_p]
+            lib.om_size.restype = ctypes.c_int64
+            lib.om_size.argtypes = [ctypes.c_void_p]
+            lib.om_get.restype = ctypes.c_int64
+            lib.om_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+            lib.om_key_at.restype = ctypes.c_int64
+            lib.om_key_at.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_uint64,
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+            ]
+            _LIB = lib
+            return lib
+        except Exception:
+            _LOAD_FAILED = True
+            raise
+
+
+def native_available() -> bool:
+    try:
+        load_offheap_library()
+        return True
+    except Exception:
+        return False
