@@ -53,18 +53,24 @@ class GLMObjective:
         l2_weight: float = 0.0,
         normalization: NormalizationContext | None = None,
         axis_name: str | None = None,
+        use_pallas: bool = False,
     ):
         self.loss = loss
         self.l2_weight = float(l2_weight)
         self.normalization = normalization if normalization is not None else no_normalization()
         self.axis_name = axis_name
+        #: route value_and_gradient through the fused Pallas kernel
+        #: (ops/pallas_glm.py). Only taken on the plain un-normalized,
+        #: un-sharded objective; anything else falls back to autodiff.
+        self.use_pallas = use_pallas
 
     # Value-based identity so jit static-arg caching works across repeated
     # construction (coordinate-descent iterations reuse compiled programs).
     # Normalization contexts hold arrays, so they compare by object identity;
     # coordinates construct theirs once.
     def _key(self):
-        return (type(self.loss), self.l2_weight, self.axis_name, id(self.normalization))
+        return (type(self.loss), self.l2_weight, self.axis_name,
+                id(self.normalization), self.use_pallas)
 
     def __eq__(self, other):
         return isinstance(other, GLMObjective) and self._key() == other._key()
@@ -98,6 +104,17 @@ class GLMObjective:
     def value_and_gradient(
         self, coefficients: Array, batch: LabeledPointBatch
     ) -> tuple[Array, Array]:
+        if (
+            self.use_pallas
+            and self.axis_name is None
+            and self.normalization.factors is None
+            and self.normalization.shifts is None
+        ):
+            from photon_ml_tpu.ops.pallas_glm import fused_value_and_gradient
+
+            return fused_value_and_gradient(
+                self.loss, coefficients, batch, l2_weight=self.l2_weight
+            )
         return jax.value_and_grad(self.value)(coefficients, batch)
 
     def gradient(self, coefficients: Array, batch: LabeledPointBatch) -> Array:

@@ -1,0 +1,148 @@
+"""Pallas TPU kernel: fused GLM value + gradient in one pass over X.
+
+This is the reference's hot loop (ValueAndGradientAggregator.scala:133-177 —
+per-sample margin dot product, pointwise loss, axpy accumulation, merged
+tree-wise) as a single Pallas kernel. The autodiff path reads the [n, d]
+feature block twice per evaluation (X@w forward, Xᵀr backward); this kernel
+streams each row tile through VMEM once, computing the margin (MXU), the
+pointwise loss/derivative (VPU), and the gradient outer-accumulation (MXU)
+before the tile leaves the chip — halving HBM traffic on the op that
+dominates L-BFGS wall-clock.
+
+Grid: 1-D over row tiles; the value/gradient outputs map to the same block
+in every grid step, making them sequential accumulators (TPU grids are
+serialized), initialized at step 0. Padding rows carry weight 0 and padded
+feature/coefficient columns are 0, so they contribute nothing.
+
+Falls back to interpreter mode off-TPU, so the same code path is testable
+on CPU (the guide's `interpret=True`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific namespace; absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.ops.losses import PointwiseLoss
+
+Array = jax.Array
+
+_LANE = 128  # TPU lane width: last dim of every tile
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024  # target VMEM footprint for the X tile
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _row_tile(d_pad: int) -> int:
+    """Rows per grid step: fill the VMEM budget, stay MXU-aligned."""
+    rows = _VMEM_BUDGET_BYTES // (4 * d_pad)
+    return int(np.clip(_round_up(rows, 8) if rows >= 8 else 8, 8, 1024))
+
+
+def _kernel(loss: PointwiseLoss, x_ref, y_ref, o_ref, ws_ref, w_ref,
+            val_ref, grad_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        val_ref[0, 0] = jnp.float32(0.0)
+        grad_ref[:] = jnp.zeros_like(grad_ref)
+
+    x = x_ref[:]  # [tile, d_pad]
+    # Margins via broadcast-multiply + lane reduction (constant accumulator —
+    # Mosaic rejects reductions fused with a non-constant init, so the offset
+    # is added in a separate op). M/N=1 dots lower to reductions anyway; the
+    # op is HBM-bandwidth-bound, so the VPU path costs nothing.
+    margins = jnp.sum(x * w_ref[:], axis=1, keepdims=True)  # [tile, 1]
+    margins = margins + o_ref[:]
+    l, dz = loss.loss_and_dz(margins, y_ref[:])
+    ws = ws_ref[:]
+    val_ref[0, 0] += jnp.sum(ws * l)
+    # gradient tile: [1, d_pad] = Σ_rows r ⊙ x with r = ws * dz
+    g = jnp.sum((ws * dz) * x, axis=0, keepdims=True)
+    grad_ref[:] = grad_ref[:] + g
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5))
+def _fused_padded(loss: PointwiseLoss, x, y, o, ws, interpret: bool, w):
+    n_pad, d_pad = x.shape
+    tile = _row_tile(d_pad)
+    grid = (n_pad // tile,)
+
+    vmem = dict(memory_space=pltpu.VMEM) if (_HAS_PLTPU and not interpret) else {}
+    smem = dict(memory_space=pltpu.SMEM) if (_HAS_PLTPU and not interpret) else {}
+    value, grad = pl.pallas_call(
+        functools.partial(_kernel, loss),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, d_pad), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((1, d_pad), lambda i: (0, 0), **vmem),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), **smem),
+            pl.BlockSpec((1, d_pad), lambda i: (0, 0), **vmem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, d_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, y, o, ws, w.reshape(1, d_pad))
+    return value[0, 0], grad[0]
+
+
+def _should_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def fused_value_and_gradient(
+    loss: PointwiseLoss,
+    coefficients: Array,
+    batch: LabeledPointBatch,
+    *,
+    l2_weight: float = 0.0,
+    interpret: bool | None = None,
+) -> tuple[Array, Array]:
+    """Fused (value, gradient) of the weighted GLM objective.
+
+    Numerically equivalent to ``jax.value_and_grad`` of
+    GLMObjective.value on an un-normalized objective; use inside jit.
+    Inputs of any shape are zero-padded to (8k rows, 128m cols); padded rows
+    get weight 0 and padded columns 0 coefficients, contributing nothing.
+    """
+    if interpret is None:
+        interpret = _should_interpret()
+    x = jnp.asarray(batch.features, jnp.float32)
+    n, d = x.shape
+    tile = _row_tile(_round_up(d, _LANE))
+    n_pad, d_pad = _round_up(max(n, 1), tile), _round_up(d, _LANE)
+    x = jnp.pad(x, ((0, n_pad - n), (0, d_pad - d)))
+    col = lambda v: jnp.pad(
+        jnp.asarray(v, jnp.float32).reshape(-1, 1), ((0, n_pad - n), (0, 0))
+    )
+    w = jnp.pad(jnp.asarray(coefficients, jnp.float32), (0, d_pad - d))
+    value, grad = _fused_padded(
+        loss, x, col(batch.labels), col(batch.offsets), col(batch.weights),
+        bool(interpret), w,
+    )
+    grad = grad[:d].astype(coefficients.dtype)
+    if l2_weight > 0.0:
+        value = value + 0.5 * l2_weight * jnp.vdot(coefficients, coefficients)
+        grad = grad + l2_weight * coefficients
+    return value.astype(coefficients.dtype), grad
