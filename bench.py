@@ -29,6 +29,8 @@ def _make_data(n: int, d: int, seed: int = 0):
 
 
 def bench_tpu(x, y, max_iter: int) -> tuple[float, int]:
+    import functools
+
     import jax
     import jax.numpy as jnp
 
@@ -37,20 +39,23 @@ def bench_tpu(x, y, max_iter: int) -> tuple[float, int]:
     from photon_ml_tpu.ops.objective import GLMObjective
     from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
 
-    batch = LabeledPointBatch.create(x, y)
+    # Batch enters as a jit ARGUMENT (device-resident), never a closure
+    # constant — closing over it would bake the [n, d] block into the HLO as
+    # a literal, ballooning compile time.
+    batch = LabeledPointBatch.create(jax.device_put(x), jax.device_put(y))
     objective = GLMObjective(LogisticLoss(), l2_weight=1.0)
-    bound = objective.bind(batch)
 
-    @jax.jit
-    def run(w0):
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def run(max_iter, batch, w0):
         return minimize_lbfgs(
-            bound.value_and_grad, w0, max_iter=max_iter, tolerance=0.0
+            objective.bind(batch).value_and_grad, w0,
+            max_iter=max_iter, tolerance=0.0,
         )
 
     w0 = jnp.zeros((x.shape[1],), dtype=jnp.float32)
-    result = jax.block_until_ready(run(w0))  # compile + warm up
+    result = jax.block_until_ready(run(max_iter, batch, w0))  # compile + warm up
     t0 = time.perf_counter()
-    result = jax.block_until_ready(run(w0))
+    result = jax.block_until_ready(run(max_iter, batch, w0))
     elapsed = time.perf_counter() - t0
     return elapsed, int(result.iterations)
 

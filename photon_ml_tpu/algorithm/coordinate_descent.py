@@ -49,18 +49,68 @@ def run_coordinate_descent(
     validation_evaluators: Sequence[Evaluator] = (),
     validation_scorer=None,
     validation_data: EvaluationData | None = None,
+    checkpointer=None,
+    checkpoint_every: int = 1,
+    resume: bool = True,
+    check_finite: bool = True,
 ) -> CoordinateDescentResult:
     """Run block coordinate descent.
 
     validation_scorer: callable(GameModel) -> np.ndarray of validation scores
     (the transformer path); the FIRST validation evaluator selects the best
     model across update sequences, as in the reference (:183-192).
+
+    checkpointer: optional ``io.checkpoint.TrainingCheckpointer``. When set,
+    full CD progress (current models, best model, metric history) is saved
+    every ``checkpoint_every`` coordinate updates (and at the final update);
+    with ``resume=True`` a later call restores the latest checkpoint and
+    fast-forwards past completed updates. This is a capability the reference
+    lacks (SURVEY.md §5 — Spark lineage only).
+
+    check_finite: raise ``io.checkpoint.DivergenceError`` the moment a
+    coordinate update produces non-finite scores, instead of training on.
     """
+    from photon_ml_tpu.io.checkpoint import (
+        DivergenceError,
+        pack_cd_state,
+        unpack_cd_state,
+    )
+
     models: dict[str, DatumScoringModel] = {}
     scores: dict[str, jnp.ndarray] = {}
+
+    best_model: GameModel | None = None
+    best_metric = float("nan")
+    history: list[dict[str, float]] = []
+    start_slot = 0  # global update counter: iteration * len(seq) + position
+
+    restored = None
+    if checkpointer is not None and resume:
+        ckpt = checkpointer.restore()
+        if ckpt is not None:
+            saved_order = ckpt.meta.get("model", {}).get("order")
+            # exact ordered match: the fast-forward below maps the checkpoint
+            # step onto (iteration, position) slots of THIS sequence, so a
+            # reordering would skip the wrong coordinates
+            if saved_order is not None and list(saved_order) != list(update_sequence):
+                raise ValueError(
+                    "checkpoint is incompatible with this run: it holds "
+                    f"coordinates {saved_order} but the update sequence is "
+                    f"{list(update_sequence)}; pass resume=False or a fresh "
+                    "checkpoint directory"
+                )
+            restored_model, best_model, best_metric, history = unpack_cd_state(ckpt)
+            restored = restored_model.models
+            start_slot = int(ckpt.step)
+            logger.info(
+                "Resuming coordinate descent from checkpoint step %d", start_slot
+            )
+
     for cid in update_sequence:
         coord = coordinates[cid]
-        if initial_models and cid in initial_models:
+        if restored is not None and cid in restored:
+            models[cid] = restored[cid]
+        elif initial_models and cid in initial_models:
             models[cid] = initial_models[cid]
         else:
             models[cid] = coord.initial_model()
@@ -73,20 +123,44 @@ def run_coordinate_descent(
             total = total + s
         return total
 
-    best_model: GameModel | None = None
-    best_metric = float("nan")
-    history: list[dict[str, float]] = []
-
+    n_seq = len(update_sequence)
+    # the final slot that actually performs an update (locked coordinates
+    # never reach the save site) — the guaranteed-checkpoint point
+    unlocked = [i for i, c in enumerate(update_sequence) if c not in locked_coordinates]
+    final_update_slot = (
+        (num_iterations - 1) * n_seq + max(unlocked) if unlocked else -1
+    )
     for iteration in range(num_iterations):
-        for cid in update_sequence:
+        for position, cid in enumerate(update_sequence):
+            slot = iteration * n_seq + position
             coord = coordinates[cid]
             if cid in locked_coordinates:
                 continue
+            if slot < start_slot:
+                continue  # already completed before the restored checkpoint
             # partial score = everything except this coordinate
             partial = full_score() - scores[cid]
             model, _info = coord.update_model(models[cid], partial)
             models[cid] = model
             scores[cid] = coord.score(model)
+            finite = True
+            if check_finite:
+                finite = bool(np.all(np.isfinite(np.asarray(scores[cid]))))
+                if finite and _info is not None and hasattr(_info, "value"):
+                    # a failed solve can leave finite warm-start coefficients
+                    # but a non-finite objective (e.g. NaN labels) — catch too
+                    finite = bool(np.isfinite(float(_info.value)))
+            if not finite:
+                raise DivergenceError(
+                    f"coordinate '{cid}' produced non-finite scores at CD "
+                    f"iteration {iteration}"
+                    + (
+                        f"; last good checkpoint: step {checkpointer.latest_step()}"
+                        f" in {checkpointer.directory}"
+                        if checkpointer is not None
+                        else ""
+                    )
+                )
 
             metrics: dict[str, float] = {}
             if training_evaluator is not None and training_data is not None:
@@ -108,6 +182,15 @@ def run_coordinate_descent(
             if metrics:
                 logger.info("CD iter %d coord %s: %s", iteration, cid, metrics)
                 history.append({"iteration": iteration, "coordinate": cid, **metrics})
+
+            if checkpointer is not None and (
+                (slot + 1) % max(1, checkpoint_every) == 0
+                or slot == final_update_slot
+            ):
+                arrays, meta = pack_cd_state(
+                    GameModel(models=dict(models)), best_model, best_metric, history
+                )
+                checkpointer.save(slot + 1, arrays, meta)
 
     final = GameModel(models=dict(models))
     if best_model is None:

@@ -75,6 +75,11 @@ class GameTrainingParams:
     coordinates: dict[str, CoordinateCliConfig]
     task_type: TaskType
     validation_data_path: str | None = None
+    #: "yyyyMMdd-yyyyMMdd" or "N-M" days-ago; expands the input path into
+    #: its <base>/daily/yyyy/MM/dd subdirectories (reference GameDriver
+    #: date-range params + IOUtils.getInputPathsWithinDateRange)
+    input_date_range: str | None = None
+    validation_data_date_range: str | None = None
     update_sequence: tuple[str, ...] = ()
     coordinate_descent_iterations: int = 1
     evaluators: tuple[str, ...] = ()
@@ -88,6 +93,13 @@ class GameTrainingParams:
     hyperparameter_tuning_range: tuple[float, float] = (1e-4, 1e4)
     input_format: str = "avro"
     override_output: bool = False
+    #: mid-training checkpoint/resume (io/checkpoint.py); one subdirectory
+    #: per λ-grid configuration. Empty = disabled.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 1
+    resume: bool = True
+    #: jax.profiler trace output dir (TensorBoard); empty = disabled
+    profile_dir: str | None = None
 
     def validate(self) -> None:
         """Cross-parameter checks (reference validateParams:196-298)."""
@@ -135,7 +147,10 @@ def run(params: GameTrainingParams) -> dict:
     events.send(TrainingStartEvent(job_name="game-training"))
     job_log = PhotonLogger(os.path.join(out, "driver.log"))
     try:
-        return _run_inner(params, job_log)
+        from photon_ml_tpu.util.timed import profile_trace
+
+        with profile_trace(params.profile_dir):
+            return _run_inner(params, job_log)
     except Exception:
         events.send(TrainingFinishEvent(job_name="game-training", succeeded=False))
         raise
@@ -150,9 +165,19 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
     )
     eval_columns = evaluation_id_columns(params.evaluators)
 
+    def resolve(path, range_spec):
+        if not range_spec:
+            return path
+        from photon_ml_tpu.util.date_range import (
+            parse_date_or_days_range,
+            resolve_input_paths,
+        )
+
+        return resolve_input_paths([path], parse_date_or_days_range(range_spec))
+
     with Timed("read training data"):
         train = read_merged(
-            params.input_data_path,
+            resolve(params.input_data_path, params.input_date_range),
             params.feature_shards,
             random_effect_id_columns=re_columns,
             evaluation_id_columns=eval_columns,
@@ -168,7 +193,9 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
     if params.validation_data_path:
         with Timed("read validation data"):
             validation = read_merged(
-                params.validation_data_path,
+                resolve(
+                    params.validation_data_path, params.validation_data_date_range
+                ),
                 params.feature_shards,
                 index_maps=train.index_maps,
                 random_effect_id_columns=re_columns,
@@ -202,7 +229,7 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
     for shard_id, imap in train.index_maps.items():
         imap.save(os.path.join(out, "index-maps"), shard_id)
 
-    def make_estimator(reg_weights) -> GameEstimator:
+    def make_estimator(reg_weights, checkpointer=None) -> GameEstimator:
         return GameEstimator(
             task=params.task_type,
             coordinate_configs=estimator_coordinate_configs(
@@ -214,6 +241,26 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
             validation_evaluators=params.evaluators,
             locked_coordinates=frozenset(params.partial_retrain_locked_coordinates),
             intercept_indices=train.intercept_indices,
+            checkpointer=checkpointer,
+            checkpoint_every=params.checkpoint_every,
+            resume=params.resume,
+        )
+
+    def make_checkpointer(config_index: int, reg_weights):
+        if not params.checkpoint_dir:
+            return None
+        import hashlib
+
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+
+        # key the directory by the configuration CONTENT, not just its grid
+        # position — editing the λ grid between runs must not resume a
+        # checkpoint trained under different regularization weights
+        digest = hashlib.sha256(
+            json.dumps(sorted(reg_weights.items()), default=float).encode()
+        ).hexdigest()[:12]
+        return TrainingCheckpointer(
+            os.path.join(params.checkpoint_dir, f"config_{config_index}_{digest}")
         )
 
     grid = expand_reg_weight_grid(params.coordinates)
@@ -225,7 +272,7 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
     best_index, best_metric = -1, float("nan")
     for i, reg_weights in enumerate(grid):
         with Timed(f"train config {i}"):
-            est = make_estimator(reg_weights)
+            est = make_estimator(reg_weights, make_checkpointer(i, reg_weights))
             result = est.fit(
                 train.dataset,
                 validation_dataset=None if validation is None else validation.dataset,
@@ -317,7 +364,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
         prog="game_training_driver", description=__doc__.split("\n")[0]
     )
     p.add_argument("--input-data-path", required=True)
+    p.add_argument("--input-date-range",
+                   help="yyyyMMdd-yyyyMMdd or N-M days ago: read "
+                        "<input>/daily/yyyy/MM/dd dirs in the range")
     p.add_argument("--validation-data-path")
+    p.add_argument("--validation-data-date-range")
     p.add_argument("--root-output-dir", required=True)
     p.add_argument(
         "--feature-shard-configurations", action="append", required=True,
@@ -348,6 +399,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="low,high λ search range (log-scale)")
     p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
     p.add_argument("--override-output", action="store_true")
+    p.add_argument("--checkpoint-dir",
+                   help="mid-training checkpoint/resume directory")
+    p.add_argument("--checkpoint-every", type=int, default=1,
+                   help="save every N coordinate updates")
+    p.add_argument("--no-resume", action="store_true",
+                   help="ignore existing checkpoints (fresh run)")
+    p.add_argument("--profile-dir",
+                   help="write a jax.profiler (TensorBoard) trace here")
     return p
 
 
@@ -365,7 +424,9 @@ def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
     split = lambda s: tuple(x.strip() for x in s.split(",") if x.strip())
     return GameTrainingParams(
         input_data_path=args.input_data_path,
+        input_date_range=args.input_date_range,
         validation_data_path=args.validation_data_path,
+        validation_data_date_range=args.validation_data_date_range,
         root_output_dir=args.root_output_dir,
         feature_shards=shards,
         coordinates=coords,
@@ -387,11 +448,20 @@ def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
         ),
         input_format=args.input_format,
         override_output=args.override_output,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=not args.no_resume,
+        profile_dir=args.profile_dir,
     )
 
 
 def main(argv: Sequence[str] | None = None) -> dict:
     logging.basicConfig(level=logging.INFO)
+    # Multi-host pods: rendezvous before any jax.devices() call; a no-op for
+    # single-process runs (parallel/multihost.py).
+    from photon_ml_tpu.parallel import multihost
+
+    multihost.initialize()
     return run(parse_args(argv))
 
 

@@ -101,6 +101,15 @@ class GameEstimator:
     #: absorbs the standardization margin shift). Required per shard when
     #: normalization is STANDARDIZATION.
     intercept_indices: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    #: optional io.checkpoint.TrainingCheckpointer for mid-training
+    #: checkpoint/resume of the coordinate-descent loop (SURVEY.md §5 — a
+    #: capability the reference lacks).
+    checkpointer: object | None = None
+    checkpoint_every: int = 1
+    #: set False to ignore an existing checkpoint directory (fresh fit)
+    resume: bool = True
+    #: raise DivergenceError on non-finite coordinate updates
+    check_finite: bool = True
 
     def fit(
         self,
@@ -189,6 +198,10 @@ class GameEstimator:
             validation_evaluators=evaluators,
             validation_scorer=validation_scorer,
             validation_data=validation_data,
+            checkpointer=self.checkpointer,
+            checkpoint_every=self.checkpoint_every,
+            resume=self.resume,
+            check_finite=self.check_finite,
         )
 
     def _prepare_normalization(self, dataset: GameDataset) -> dict[str, NormalizationContext]:

@@ -1,0 +1,271 @@
+"""Mid-training checkpoint / resume for GAME coordinate descent.
+
+The reference has **no** mid-training checkpoints: recovery is Spark lineage
+recompute plus coarse warm-start from models saved per optimization config
+(SURVEY.md §5; GameTrainingDriver.scala:748-815, GameEstimator.scala:392-411).
+This module goes beyond it with first-class checkpoint/resume:
+
+- ``TrainingCheckpointer`` writes one atomic step directory per save
+  (``step_<k>/`` with ``arrays.npz`` + ``meta.json`` + per-coordinate entity
+  key vocabularies), prunes to ``max_to_keep``, and restores the latest
+  intact step. Atomicity = write to a temp dir, ``os.replace`` into place —
+  a crash mid-save never corrupts the latest good checkpoint.
+- ``run_coordinate_descent(..., checkpointer=...)`` (algorithm/
+  coordinate_descent.py) saves after every coordinate update and fast-
+  forwards past completed updates on resume.
+- ``train_distributed(..., checkpointer=...)`` (parallel/distributed.py)
+  saves the mesh-sharded ``GameTrainState`` per CD sweep; arrays are pulled
+  to host with ``jax.device_get`` (works for sharded arrays — all shards on
+  this host are gathered) and re-sharded on restore by the caller's
+  ``shard_inputs``.
+
+Checkpoints are plain numpy + JSON: portable across backends (save on TPU,
+restore on CPU), no framework version pinning, diffable metadata.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.game import (
+    DatumScoringModel,
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.types import TaskType
+
+_STEP_PREFIX = "step_"
+_META_FILE = "meta.json"
+_ARRAYS_FILE = "arrays.npz"
+
+
+@dataclasses.dataclass
+class Checkpoint:
+    """One restored checkpoint: step id, array pytree, JSON metadata."""
+
+    step: int
+    arrays: dict[str, np.ndarray]
+    meta: dict[str, Any]
+
+
+class TrainingCheckpointer:
+    """Atomic, pruned, numbered checkpoints under one directory.
+
+    Layout::
+
+        <directory>/
+          step_00000007/
+            arrays.npz     flat {key: array} — numeric state
+            meta.json      structure + scalars (task types, shard ids, ...)
+          step_00000008/
+            ...
+
+    ``save`` never leaves a partially-written ``step_*`` dir: content goes to
+    a ``tmp.*`` sibling first and is renamed into place, then older steps are
+    pruned down to ``max_to_keep``.
+    """
+
+    def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 3):
+        self.directory = str(directory)
+        self.max_to_keep = max(1, int(max_to_keep))
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- core save/restore ---------------------------------------------------
+
+    def save(self, step: int, arrays: Mapping[str, np.ndarray], meta: dict) -> str:
+        step_dir = os.path.join(self.directory, f"{_STEP_PREFIX}{step:08d}")
+        tmp_dir = tempfile.mkdtemp(prefix="tmp.", dir=self.directory)
+        try:
+            host_arrays = {k: np.asarray(jax.device_get(v)) for k, v in arrays.items()}
+            np.savez(os.path.join(tmp_dir, _ARRAYS_FILE), **host_arrays)
+            with open(os.path.join(tmp_dir, _META_FILE), "w") as f:
+                json.dump({"step": step, **meta}, f, indent=2, default=str)
+            if os.path.isdir(step_dir):
+                shutil.rmtree(step_dir)
+            os.replace(tmp_dir, step_dir)
+        except BaseException:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            raise
+        self._prune()
+        return step_dir
+
+    def steps(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith(_STEP_PREFIX):
+                path = os.path.join(self.directory, name)
+                # intact = both files present (a partially-pruned or
+                # partially-deleted dir must not be offered for restore)
+                if os.path.isfile(os.path.join(path, _META_FILE)) and os.path.isfile(
+                    os.path.join(path, _ARRAYS_FILE)
+                ):
+                    try:
+                        out.append(int(name[len(_STEP_PREFIX):]))
+                    except ValueError:
+                        continue
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: int | None = None) -> Checkpoint | None:
+        """Restore ``step`` (default: latest intact step).
+
+        Returns None when no intact checkpoint exists; raises ValueError for
+        an explicitly-requested step that is missing or not intact.
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        elif step not in self.steps():
+            raise ValueError(
+                f"checkpoint step {step} not found (intact steps: {self.steps()})"
+            )
+        step_dir = os.path.join(self.directory, f"{_STEP_PREFIX}{step:08d}")
+        with open(os.path.join(step_dir, _META_FILE)) as f:
+            meta = json.load(f)
+        with np.load(os.path.join(step_dir, _ARRAYS_FILE), allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+        return Checkpoint(step=step, arrays=arrays, meta=meta)
+
+    def _prune(self) -> None:
+        steps = self.steps()
+        for s in steps[: -self.max_to_keep]:
+            shutil.rmtree(
+                os.path.join(self.directory, f"{_STEP_PREFIX}{s:08d}"),
+                ignore_errors=True,
+            )
+
+
+# -- GAME model (de)serialization to flat array dicts -------------------------
+
+
+def game_model_to_arrays(model: GameModel) -> tuple[dict[str, np.ndarray], dict]:
+    """Flatten a GameModel into (arrays, structure-metadata) for checkpointing."""
+    arrays: dict[str, np.ndarray] = {}
+    coords_meta: dict[str, dict] = {}
+    for cid, sub in model.models.items():
+        if isinstance(sub, FixedEffectModel):
+            arrays[f"{cid}/means"] = np.asarray(sub.glm.coefficients.means)
+            if sub.glm.coefficients.variances is not None:
+                arrays[f"{cid}/variances"] = np.asarray(sub.glm.coefficients.variances)
+            coords_meta[cid] = {
+                "kind": "fixed",
+                "feature_shard_id": sub.feature_shard_id,
+                "task": sub.glm.task.name,
+            }
+        elif isinstance(sub, RandomEffectModel):
+            arrays[f"{cid}/coefficients"] = np.asarray(sub.coefficients)
+            arrays[f"{cid}/entity_keys"] = np.asarray(sub.entity_keys)
+            if sub.variances is not None:
+                arrays[f"{cid}/variances"] = np.asarray(sub.variances)
+            coords_meta[cid] = {
+                "kind": "random",
+                "random_effect_type": sub.random_effect_type,
+                "feature_shard_id": sub.feature_shard_id,
+                "task": sub.task.name,
+            }
+        else:
+            raise TypeError(f"Cannot checkpoint sub-model type {type(sub)!r}")
+    return arrays, {"coordinates": coords_meta, "order": list(model.models)}
+
+
+def _with_prefix(arrays: Mapping[str, np.ndarray], prefix: str) -> dict[str, np.ndarray]:
+    return {f"{prefix}{k}": v for k, v in arrays.items()}
+
+
+def _strip_prefix(arrays: Mapping[str, np.ndarray], prefix: str) -> dict[str, np.ndarray]:
+    return {k[len(prefix):]: v for k, v in arrays.items() if k.startswith(prefix)}
+
+
+def pack_cd_state(
+    model: GameModel,
+    best_model: GameModel | None,
+    best_metric: float,
+    metric_history: list[dict],
+) -> tuple[dict[str, np.ndarray], dict]:
+    """Flatten full coordinate-descent progress (current + best model) for save."""
+    arrays, model_meta = game_model_to_arrays(model)
+    out = _with_prefix(arrays, "model/")
+    meta: dict[str, Any] = {
+        "model": model_meta,
+        "best_metric": None if np.isnan(best_metric) else float(best_metric),
+        "metric_history": metric_history,
+    }
+    if best_model is not None:
+        best_arrays, best_meta = game_model_to_arrays(best_model)
+        out.update(_with_prefix(best_arrays, "best/"))
+        meta["best"] = best_meta
+    return out, meta
+
+
+def unpack_cd_state(
+    ckpt: Checkpoint,
+) -> tuple[GameModel, GameModel | None, float, list[dict]]:
+    """Inverse of :func:`pack_cd_state`."""
+    model = game_model_from_arrays(_strip_prefix(ckpt.arrays, "model/"), ckpt.meta["model"])
+    best_model = None
+    if "best" in ckpt.meta and ckpt.meta["best"] is not None:
+        best_model = game_model_from_arrays(
+            _strip_prefix(ckpt.arrays, "best/"), ckpt.meta["best"]
+        )
+    raw = ckpt.meta.get("best_metric")
+    best_metric = float("nan") if raw is None else float(raw)
+    return model, best_model, best_metric, list(ckpt.meta.get("metric_history", []))
+
+
+class DivergenceError(RuntimeError):
+    """Raised when training state goes non-finite (failure detection).
+
+    The reference relies on Spark lineage recompute and has no divergence
+    handling (SURVEY.md §5); here a non-finite coordinate update is caught at
+    the CD level so the driver can restore the last good checkpoint instead
+    of silently training on NaNs.
+    """
+
+
+def game_model_from_arrays(
+    arrays: Mapping[str, np.ndarray], meta: Mapping[str, Any]
+) -> GameModel:
+    """Inverse of :func:`game_model_to_arrays`."""
+    models: dict[str, DatumScoringModel] = {}
+    coords_meta = meta["coordinates"]
+    for cid in meta["order"]:
+        info = coords_meta[cid]
+        task = TaskType[info["task"]]
+        variances = arrays.get(f"{cid}/variances")
+        if info["kind"] == "fixed":
+            glm = GeneralizedLinearModel(
+                coefficients=Coefficients(
+                    means=arrays[f"{cid}/means"], variances=variances
+                ),
+                task=task,
+            )
+            models[cid] = FixedEffectModel(
+                glm=glm, feature_shard_id=info["feature_shard_id"]
+            )
+        elif info["kind"] == "random":
+            models[cid] = RandomEffectModel(
+                coefficients=arrays[f"{cid}/coefficients"],
+                entity_keys=arrays[f"{cid}/entity_keys"],
+                random_effect_type=info["random_effect_type"],
+                feature_shard_id=info["feature_shard_id"],
+                task=task,
+                variances=variances,
+            )
+        else:
+            raise ValueError(f"Unknown checkpoint coordinate kind {info['kind']!r}")
+    return GameModel(models=models)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import itertools
 import os
 from typing import Iterable, Iterator, Mapping, Sequence
 
@@ -234,7 +235,7 @@ def records_to_game_dataset(
 
 
 def read_merged(
-    path: str | os.PathLike,
+    path: str | os.PathLike | Sequence[str | os.PathLike],
     shard_configs: Mapping[str, FeatureShardConfiguration],
     *,
     index_maps: Mapping[str, IndexMap] | None = None,
@@ -245,12 +246,23 @@ def read_merged(
     dtype=np.float32,
 ) -> ReadResult:
     """One-call read: build index maps if needed, then the dataset
-    (reference DataReader.readMerged)."""
+    (reference DataReader.readMerged). ``path`` may be a list of paths —
+    e.g. the daily directories of a date range
+    (util/date_range.resolve_input_paths) — read in order as one dataset.
+    """
+    paths = (
+        [path]
+        if isinstance(path, (str, os.PathLike))
+        else [p for p in path]
+    )
+    if not paths:
+        raise ValueError("read_merged needs at least one input path")
+
     def records():
         if fmt == "avro":
-            return read_avro_records(path)
+            return itertools.chain.from_iterable(read_avro_records(p) for p in paths)
         if fmt == "libsvm":
-            return read_libsvm(path)
+            return itertools.chain.from_iterable(read_libsvm(p) for p in paths)
         raise ValueError(f"unknown format {fmt!r}")
 
     if index_maps is None:

@@ -317,11 +317,40 @@ def train_distributed(
     num_iterations: int = 1,
     fe_feature_sharded: bool = False,
     state: GameTrainState | None = None,
+    checkpointer=None,
+    checkpoint_every: int = 1,
+    resume: bool = True,
 ):
     """Run ``num_iterations`` fused CD sweeps, optionally mesh-sharded.
 
+    checkpointer: optional ``io.checkpoint.TrainingCheckpointer``. Saves the
+    full ``GameTrainState`` (host-gathered) every ``checkpoint_every`` sweeps;
+    with ``resume=True`` the latest checkpoint short-circuits completed
+    sweeps. Restored arrays are re-laid-out over the mesh by the normal
+    ``shard_inputs`` path, so a run checkpointed on one topology restores
+    onto another (elastic recovery — absent in the reference, SURVEY.md §5).
+
     Returns (final_state, [loss per sweep]).
     """
+    start_sweep = 0
+    prior_losses: list[float] = []
+    # An explicit caller-supplied state takes precedence over resume: passing
+    # both a warm start and a stale checkpoint must not silently ignore the
+    # warm start.
+    if checkpointer is not None and resume and state is None:
+        ckpt = checkpointer.restore()
+        if ckpt is not None:
+            state = GameTrainState(
+                fe_coefficients=jnp.asarray(ckpt.arrays["fe_coefficients"]),
+                re_tables={
+                    k[len("re_tables/"):]: jnp.asarray(v)
+                    for k, v in ckpt.arrays.items()
+                    if k.startswith("re_tables/")
+                },
+            )
+            start_sweep = min(int(ckpt.step), num_iterations)
+            prior_losses = [float(x) for x in ckpt.meta.get("losses", [])][:start_sweep]
+
     data, buckets = program.prepare_inputs(dataset, re_datasets)
     if state is None:
         state = program.init_state(dataset, re_datasets)
@@ -329,8 +358,15 @@ def train_distributed(
         data, buckets, state = program.shard_inputs(
             mesh, data, buckets, state, fe_feature_sharded=fe_feature_sharded
         )
-    losses = []
-    for _ in range(num_iterations):
+    losses = list(prior_losses)
+    for sweep in range(start_sweep, num_iterations):
         state, loss = program.step(data, buckets, state)
         losses.append(float(loss))
+        if checkpointer is not None and (
+            (sweep + 1) % max(1, checkpoint_every) == 0 or sweep + 1 == num_iterations
+        ):
+            arrays = {"fe_coefficients": jax.device_get(state.fe_coefficients)}
+            for k, v in state.re_tables.items():
+                arrays[f"re_tables/{k}"] = jax.device_get(v)
+            checkpointer.save(sweep + 1, arrays, {"losses": losses})
     return state, losses

@@ -1,0 +1,140 @@
+"""Multi-host / multi-slice process coordination and hybrid meshes.
+
+The reference's multi-node story is the Spark driver/executor runtime: YARN
+launches executors, the driver coordinates, and all communication is shuffle/
+broadcast/treeAggregate (SURVEY.md §2.5 — "Distributed communication
+backend"). The TPU-native equivalent is:
+
+- process coordination: ``jax.distributed.initialize`` — every host runs the
+  same SPMD program, a coordinator rendezvouses them (this file);
+- collectives: XLA over ICI within a slice, DCN across slices — chosen by
+  device order in the mesh, not by hand-written NCCL/MPI calls.
+
+``initialize()`` is a thin, idempotent wrapper suitable for CLI drivers:
+single-process runs (tests, one-chip benches) skip coordination entirely,
+multi-host runs pick up the standard cluster-env variables (GKE/GCE
+metadata) or explicit arguments.
+
+``make_hybrid_mesh()`` builds the ("data", "model") mesh the rest of the
+framework assumes (parallel/mesh.py), but topology-aware for multi-slice
+pods: the "model" (feature/tensor) axis — which carries the per-L-BFGS-step
+all-gathers and reduce-scatters of giant fixed-effect coordinates — is laid
+out over ICI inside a slice, while the "data" axis (sample/entity DP, one
+psum per objective evaluation) spans the slower DCN between slices. This is
+the standard scaling-book layout: chatty axes ride fast links.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids=None,
+) -> None:
+    """Idempotently initialize multi-host JAX.
+
+    No-op when nothing indicates a multi-process run (no arguments and no
+    cluster environment), so drivers can call it unconditionally — the same
+    binary then works on a laptop CPU, one TPU chip, or a multi-host pod
+    (the reference's spark-submit local[*] vs YARN split, without the two
+    code paths).
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+    )
+    if not explicit:
+        import os
+
+        cluster_vars = (
+            "COORDINATOR_ADDRESS",  # explicit
+            "MEGASCALE_COORDINATOR_ADDRESS",  # multislice
+        )
+        # TPU_WORKER_HOSTNAMES counts only when it actually lists multiple
+        # workers — a single tunnelled chip exports it too, with one entry.
+        multi_worker = "," in os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        if not (multi_worker or any(os.environ.get(v) for v in cluster_vars)):
+            logger.debug("single-process run; skipping jax.distributed")
+            return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    except (ValueError, RuntimeError) as e:
+        if explicit:
+            raise
+        # cluster-ish environment but no usable coordinator (e.g. a single
+        # tunnelled chip that still exports TPU env vars): run single-process
+        logger.warning("jax.distributed auto-init unavailable (%s); "
+                       "continuing single-process", e)
+        return
+    _INITIALIZED = True
+    logger.info(
+        "jax.distributed initialized: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def make_hybrid_mesh(
+    data: int | None = None,
+    model: int = 1,
+    *,
+    devices=None,
+) -> Mesh:
+    """("data", "model") mesh, topology-aware across slices.
+
+    Single-slice (or CPU) topologies fall back to a plain reshape (identical
+    to parallel/mesh.make_mesh). On multi-slice TPU topologies the mesh is
+    built with ``mesh_utils.create_hybrid_device_mesh`` so the "model" axis
+    stays inside a slice (ICI) and only the "data" axis crosses DCN.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if data is None:
+        data = len(devices) // model
+    if data * model > len(devices):
+        raise ValueError(
+            f"mesh {data}x{model} needs {data * model} devices, have {len(devices)}"
+        )
+    devices = devices[: data * model]
+
+    num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if num_slices > 1:
+        from jax.experimental import mesh_utils
+
+        per_slice = len(devices) // num_slices
+        if data % num_slices != 0 or model > per_slice:
+            raise ValueError(
+                f"hybrid mesh {data}x{model} cannot split over {num_slices} "
+                "slices: the data axis must be divisible by the slice count "
+                "and the model axis must fit inside one slice"
+            )
+        grid = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(data // num_slices, model),
+            dcn_mesh_shape=(num_slices, 1),
+            devices=devices,
+        )
+    else:
+        grid = np.array(devices).reshape(data, model)
+    return Mesh(grid, axis_names=("data", "model"))

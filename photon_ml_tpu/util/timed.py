@@ -67,6 +67,26 @@ def timed(name: str | None = None):
     return decorate
 
 
+@contextlib.contextmanager
+def profile_trace(log_dir: str | None):
+    """Capture a jax.profiler device trace for the enclosed block.
+
+    ``with profile_trace("/tmp/trace"): train()`` writes a TensorBoard-
+    loadable trace (XLA op timeline, HBM usage) — the TPU-native upgrade of
+    the reference's wall-clock-only Timed blocks (util/Timed.scala:33-77;
+    it had no device-level tracing, SURVEY.md §5). A None/empty ``log_dir``
+    disables tracing so drivers can pass their flag through unconditionally.
+    """
+    if not log_dir:
+        yield
+        return
+    import jax.profiler
+
+    with jax.profiler.trace(str(log_dir)):
+        yield
+    logger.info("jax profiler trace written to %s", log_dir)
+
+
 def timing_summary() -> dict[str, dict[str, float]]:
     """name -> {count, total, mean} over everything timed so far."""
     return {

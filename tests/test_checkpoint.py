@@ -1,0 +1,244 @@
+"""Checkpoint/resume subsystem: atomic saves, pruning, CD fast-forward,
+distributed sweep resume, divergence detection.
+
+The reference has no mid-training checkpoints (SURVEY.md §5 — Spark lineage
+recompute + coarse warm start only); these tests pin down the stronger
+contract this framework provides.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.algorithm.coordinates import CoordinateOptimizationConfig
+from photon_ml_tpu.data.game_data import (
+    build_game_dataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.estimators import (
+    FixedEffectCoordinateConfig,
+    GameEstimator,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.io.checkpoint import (
+    Checkpoint,
+    DivergenceError,
+    TrainingCheckpointer,
+    game_model_from_arrays,
+    game_model_to_arrays,
+    pack_cd_state,
+    unpack_cd_state,
+)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+from photon_ml_tpu.types import TaskType
+
+
+def _game_model():
+    fe = FixedEffectModel(
+        glm=GeneralizedLinearModel(
+            Coefficients(
+                means=np.arange(4.0), variances=np.full(4, 0.5)
+            ),
+            TaskType.LINEAR_REGRESSION,
+        ),
+        feature_shard_id="global",
+    )
+    re = RandomEffectModel(
+        coefficients=np.arange(6.0).reshape(3, 2),
+        entity_keys=np.array(["a", "b", "c"]),
+        random_effect_type="userId",
+        feature_shard_id="per_user",
+        task=TaskType.LINEAR_REGRESSION,
+    )
+    return GameModel(models={"fixed": fe, "per-user": re})
+
+
+def test_game_model_array_round_trip():
+    model = _game_model()
+    arrays, meta = game_model_to_arrays(model)
+    back = game_model_from_arrays(arrays, meta)
+    assert list(back.models) == ["fixed", "per-user"]
+    fe = back.models["fixed"]
+    np.testing.assert_array_equal(fe.glm.coefficients.means, np.arange(4.0))
+    np.testing.assert_array_equal(fe.glm.coefficients.variances, np.full(4, 0.5))
+    assert fe.glm.task == TaskType.LINEAR_REGRESSION
+    re = back.models["per-user"]
+    np.testing.assert_array_equal(re.coefficients, np.arange(6.0).reshape(3, 2))
+    np.testing.assert_array_equal(re.entity_keys, np.array(["a", "b", "c"]))
+    assert re.random_effect_type == "userId"
+
+
+def test_checkpointer_save_restore_prune(tmp_path):
+    ckpt = TrainingCheckpointer(tmp_path / "ck", max_to_keep=2)
+    assert ckpt.restore() is None
+    for step in (1, 2, 3):
+        ckpt.save(step, {"w": np.full(3, float(step))}, {"note": f"s{step}"})
+    assert ckpt.steps() == [2, 3]  # pruned to max_to_keep
+    latest = ckpt.restore()
+    assert latest.step == 3
+    np.testing.assert_array_equal(latest.arrays["w"], np.full(3, 3.0))
+    assert latest.meta["note"] == "s3"
+    older = ckpt.restore(step=2)
+    np.testing.assert_array_equal(older.arrays["w"], np.full(3, 2.0))
+
+
+def test_cd_state_pack_round_trip():
+    model = _game_model()
+    history = [{"iteration": 0, "coordinate": "fixed", "train:RMSE": 1.5}]
+    arrays, meta = pack_cd_state(model, model, 1.5, history)
+    ckpt = Checkpoint(step=4, arrays=arrays, meta=meta)
+    m2, best2, metric, hist = unpack_cd_state(ckpt)
+    assert list(m2.models) == list(model.models)
+    assert best2 is not None
+    assert metric == 1.5
+    assert hist == history
+    # NaN best metric survives as NaN
+    arrays, meta = pack_cd_state(model, None, float("nan"), [])
+    _, best, metric, _ = unpack_cd_state(Checkpoint(step=1, arrays=arrays, meta=meta))
+    assert best is None and np.isnan(metric)
+
+
+def _mixed_data(rng, n_users=8, per_user=6, d_global=4, d_user=2):
+    n = n_users * per_user
+    user_ids = np.repeat(np.arange(n_users), per_user)
+    xg = rng.normal(size=(n, d_global))
+    xu = rng.normal(size=(n, d_user))
+    w_g = rng.normal(size=d_global)
+    w_u = rng.normal(size=(n_users, d_user))
+    y = xg @ w_g + np.einsum("nd,nd->n", xu, w_u[user_ids]) + 0.05 * rng.normal(size=n)
+    return build_game_dataset(
+        labels=y,
+        feature_shards={"global": xg, "per_user": xu},
+        entity_keys={"userId": user_ids},
+        dtype=np.float64,
+    )
+
+
+def _estimator(ckpt=None, num_iterations=2):
+    opt = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=40),
+        l2_weight=0.1,
+    )
+    return GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig("global", opt),
+            "per-user": RandomEffectCoordinateConfig("userId", "per_user", opt),
+        },
+        num_iterations=num_iterations,
+        checkpointer=ckpt,
+    )
+
+
+def test_cd_checkpoint_resume_matches_uninterrupted(rng, tmp_path):
+    dataset = _mixed_data(rng)
+
+    # Uninterrupted 2-iteration run.
+    full = _estimator(None, num_iterations=2).fit(dataset)
+
+    # Interrupted run: 1 iteration with checkpointing (2 coordinate updates),
+    # then a fresh estimator resumes from the checkpoint dir for 2 iterations
+    # total — it must fast-forward the first 2 slots and produce the same
+    # final model as the uninterrupted run.
+    ck1 = TrainingCheckpointer(tmp_path / "cd")
+    _estimator(ck1, num_iterations=1).fit(dataset)
+    assert ck1.latest_step() == 2
+
+    ck2 = TrainingCheckpointer(tmp_path / "cd")
+    resumed = _estimator(ck2, num_iterations=2).fit(dataset)
+
+    f1 = np.asarray(full.model.models["fixed"].glm.coefficients.means)
+    f2 = np.asarray(resumed.model.models["fixed"].glm.coefficients.means)
+    np.testing.assert_allclose(f2, f1, rtol=1e-6, atol=1e-8)
+    r1 = np.asarray(full.model.models["per-user"].coefficients)
+    r2 = np.asarray(resumed.model.models["per-user"].coefficients)
+    np.testing.assert_allclose(r2, r1, rtol=1e-6, atol=1e-8)
+
+
+def test_cd_resume_rejects_incompatible_sequence(rng, tmp_path):
+    dataset = _mixed_data(rng)
+    ck = TrainingCheckpointer(tmp_path / "cd")
+    _estimator(ck, num_iterations=1).fit(dataset)
+
+    opt = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=10),
+        l2_weight=0.1,
+    )
+    # Same checkpoint dir, different coordinate set -> must refuse to resume.
+    changed = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={"fixed": FixedEffectCoordinateConfig("global", opt)},
+        num_iterations=1,
+        checkpointer=TrainingCheckpointer(tmp_path / "cd"),
+    )
+    with pytest.raises(ValueError, match="incompatible"):
+        changed.fit(dataset)
+    # resume=False ignores the stale checkpoint and trains fresh.
+    changed_fresh = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={"fixed": FixedEffectCoordinateConfig("global", opt)},
+        num_iterations=1,
+        checkpointer=TrainingCheckpointer(tmp_path / "cd2"),
+        resume=False,
+    )
+    result = changed_fresh.fit(dataset)
+    assert "fixed" in result.model.models
+
+
+def test_cd_divergence_detection(rng):
+    dataset = _mixed_data(rng)
+    # Poison the labels: a non-finite label makes the FE solve produce NaNs.
+    bad = np.asarray(dataset.labels).copy()
+    bad[0] = np.nan
+    keys = dataset.entity_vocabs["userId"][np.asarray(dataset.entity_idx["userId"])]
+    poisoned = build_game_dataset(
+        labels=bad,
+        feature_shards={k: np.asarray(v) for k, v in dataset.feature_shards.items()},
+        entity_keys={"userId": keys},
+        dtype=np.float64,
+    )
+    with pytest.raises(DivergenceError, match="non-finite"):
+        _estimator(None, num_iterations=1).fit(poisoned)
+
+
+def test_distributed_checkpoint_resume(rng, tmp_path):
+    import jax
+    from jax.sharding import Mesh
+
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig as OC
+    from photon_ml_tpu.parallel.distributed import (
+        FixedEffectStepSpec,
+        GameTrainProgram,
+        RandomEffectStepSpec,
+        train_distributed,
+    )
+
+    dataset = _mixed_data(rng, n_users=8, per_user=4)
+    re_datasets = {
+        "userId": build_random_effect_dataset(dataset, "userId", "per_user")
+    }
+    opt = OC(optimizer_type=OptimizerType.LBFGS, max_iterations=5)
+    program = GameTrainProgram(
+        TaskType.LINEAR_REGRESSION,
+        FixedEffectStepSpec("global", opt, l2_weight=0.5),
+        (RandomEffectStepSpec("userId", "per_user", opt, l2_weight=0.5),),
+    )
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), axis_names=("data",))
+
+    _, losses_full = train_distributed(
+        program, dataset, re_datasets, mesh=mesh, num_iterations=3
+    )
+
+    ck = TrainingCheckpointer(tmp_path / "dist")
+    train_distributed(
+        program, dataset, re_datasets, mesh=mesh, num_iterations=2, checkpointer=ck
+    )
+    assert ck.latest_step() == 2
+    state, losses_resumed = train_distributed(
+        program, dataset, re_datasets, mesh=mesh, num_iterations=3, checkpointer=ck
+    )
+    assert len(losses_resumed) == 3
+    np.testing.assert_allclose(losses_resumed, losses_full, rtol=1e-6)
+    assert ck.latest_step() == 3

@@ -1,0 +1,73 @@
+"""Date ranges, daily-path resolution, and multi-path reads (reference
+photon-client util/DateRange.scala, DaysRange.scala,
+IOUtils.getInputPathsWithinDateRange)."""
+
+import datetime
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.data_reader import FeatureShardConfiguration, read_merged
+from photon_ml_tpu.util.date_range import (
+    DateRange,
+    DaysRange,
+    daily_path,
+    parse_date_or_days_range,
+    resolve_input_paths,
+)
+
+
+def test_date_range_parse_and_dates():
+    r = DateRange.parse("20260101-20260103")
+    assert [d.day for d in r.dates()] == [1, 2, 3]
+    assert str(r) == "20260101-20260103"
+    with pytest.raises(ValueError, match="after end"):
+        DateRange.parse("20260105-20260101")
+    with pytest.raises(ValueError, match="bad date range"):
+        DateRange.parse("2026-01-01")
+
+
+def test_days_range_to_date_range():
+    today = datetime.date(2026, 7, 29)
+    r = DaysRange.parse("3-1").to_date_range(today)
+    assert r.start == datetime.date(2026, 7, 26)
+    assert r.end == datetime.date(2026, 7, 28)
+    with pytest.raises(ValueError, match="further in the past"):
+        DaysRange.parse("1-3")
+    # dispatcher accepts both grammars
+    assert parse_date_or_days_range("20260101-20260102").start.year == 2026
+    assert parse_date_or_days_range("3-1", today).end == datetime.date(2026, 7, 28)
+
+
+def test_resolve_input_paths(tmp_path):
+    r = DateRange.parse("20260101-20260104")
+    for day in (1, 3):
+        os.makedirs(daily_path(tmp_path, datetime.date(2026, 1, day)))
+    got = resolve_input_paths([tmp_path], r)
+    assert [p[-2:] for p in got] == ["01", "03"]
+    assert resolve_input_paths([tmp_path]) == [str(tmp_path)]
+    with pytest.raises(FileNotFoundError, match="no daily input"):
+        resolve_input_paths([tmp_path], DateRange.parse("20270101-20270102"))
+
+
+def _write_libsvm(path, rows, d=3, seed=0):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(rows):
+            feats = " ".join(f"{j + 1}:{rng.normal():.4f}" for j in range(d))
+            f.write(f"{int(rng.uniform() < 0.5)} {feats}\n")
+
+
+def test_read_merged_multiple_paths(tmp_path):
+    _write_libsvm(tmp_path / "a.libsvm", 5, seed=1)
+    _write_libsvm(tmp_path / "b.libsvm", 7, seed=2)
+    shards = {"g": FeatureShardConfiguration(feature_bags=("default",))}
+    both = read_merged(
+        [tmp_path / "a.libsvm", tmp_path / "b.libsvm"], shards, fmt="libsvm"
+    )
+    assert both.dataset.num_samples == 12
+    one = read_merged(tmp_path / "a.libsvm", shards, fmt="libsvm")
+    assert one.dataset.num_samples == 5
+    with pytest.raises(ValueError, match="at least one input path"):
+        read_merged([], shards, fmt="libsvm")
