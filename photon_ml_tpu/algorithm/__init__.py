@@ -9,3 +9,8 @@ from photon_ml_tpu.algorithm.coordinate_descent import (  # noqa: F401
     CoordinateDescentResult,
     run_coordinate_descent,
 )
+from photon_ml_tpu.algorithm.mf_coordinate import (  # noqa: F401
+    MatrixFactorizationCoordinate,
+    MFDataset,
+    build_mf_dataset,
+)

@@ -145,7 +145,8 @@ def run_coordinate_descent(
             scores[cid] = coord.score(model)
             finite = True
             if check_finite:
-                finite = bool(np.all(np.isfinite(np.asarray(scores[cid]))))
+                # reduce on device: only a scalar crosses to the host
+                finite = bool(jnp.isfinite(jnp.asarray(scores[cid])).all())
                 if finite and _info is not None and hasattr(_info, "value"):
                     # a failed solve can leave finite warm-start coefficients
                     # but a non-finite objective (e.g. NaN labels) — catch too

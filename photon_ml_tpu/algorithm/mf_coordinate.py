@@ -1,0 +1,256 @@
+"""Matrix-factorization coordinate: alternating vmapped latent-factor solves.
+
+The reference promises an MF coordinate (README.md:92-95,
+LatentFactorAvro.avsc) but never implemented it; this module supplies the
+missing capability as a first-class GAME coordinate so MF factors train on
+coordinate-descent residuals alongside fixed/random effects
+(algorithm/CoordinateDescent parity: photon-lib algorithm/Coordinate.scala).
+
+Training is alternating minimization. With column factors held fixed, the
+objective restricted to one row-entity r is an ordinary GLM over its
+samples whose "feature vector" for sample i is ``col_factors[col_idx_i]``
+— exactly the local subproblem shape of a random-effect entity. So each
+half-step gathers the fixed side's factors as features and reuses the
+vmapped per-entity solver (`coordinates._solve_bucket_entities`) over
+size-bucketed padded blocks. The gather happens *inside* jit, so a bucket's
+HLO is (embedding-lookup → vmapped LBFGS) fused by XLA, and each half-step
+scatters straight back into the [E, k] factor table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+from photon_ml_tpu.algorithm.coordinates import (
+    Coordinate,
+    CoordinateOptimizationConfig,
+    _make_objective,
+    _solve_bucket_entities,
+    _solve_config,
+)
+from photon_ml_tpu.data.game_data import GameDataset, group_entities_into_buckets
+from photon_ml_tpu.models.matrix_factorization import (
+    MatrixFactorizationModel,
+    init_factors,
+)
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.optim.optimizer import OptimizerConfig
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class MFSideBucket:
+    """One size-bucket of per-entity sample groups for one MF side.
+
+    Unlike EntityBucket there is no static feature block — features are the
+    *other* side's factor rows, gathered at solve time (they change every
+    half-step).
+
+    labels/weights: [e, cap] (weight 0 marks padding)
+    entity_rows:    [e]      row in this side's entity vocab
+    sample_rows:    [e, cap] global sample row per slot, -1 pad
+    """
+
+    labels: Array
+    weights: Array
+    entity_rows: Array
+    sample_rows: Array
+
+    @property
+    def num_entities(self) -> int:
+        return int(self.entity_rows.shape[0])
+
+
+@dataclasses.dataclass
+class MFDataset:
+    """Bucketed per-entity views of both MF sides."""
+
+    row_effect_type: str
+    col_effect_type: str
+    row_buckets: list[MFSideBucket]
+    col_buckets: list[MFSideBucket]
+    num_row_entities: int
+    num_col_entities: int
+
+
+def _build_side_buckets(
+    entity_idx: np.ndarray,
+    other_idx: np.ndarray,
+    labels: np.ndarray,
+    weights: np.ndarray,
+    unique_ids: np.ndarray,
+    *,
+    bucket_sizes,
+    active_data_upper_bound: int | None,
+    seed: int,
+) -> list[MFSideBucket]:
+    """Group samples by this side's entity (shared bucketing with
+    build_random_effect_dataset; reservoir caps keyed on stable sample ids).
+    Samples whose other-side entity is unseen get weight 0 — they cannot
+    contribute a factor-feature."""
+    per_bucket = group_entities_into_buckets(
+        entity_idx,
+        unique_ids,
+        bucket_sizes=bucket_sizes,
+        active_data_upper_bound=active_data_upper_bound,
+        seed=seed,
+    )
+    buckets: list[MFSideBucket] = []
+    for cap, members in per_bucket.items():
+        if not members:
+            continue
+        e = len(members)
+        bl = np.zeros((e, cap), dtype=labels.dtype)
+        bw = np.zeros((e, cap), dtype=weights.dtype)
+        be = np.zeros((e,), dtype=np.int32)
+        bs = np.full((e, cap), -1, dtype=np.int32)
+        for i, (entity, sample_rows) in enumerate(members):
+            k = len(sample_rows)
+            bl[i, :k] = labels[sample_rows]
+            bw[i, :k] = weights[sample_rows] * (other_idx[sample_rows] >= 0)
+            be[i] = entity
+            bs[i, :k] = sample_rows
+        buckets.append(
+            MFSideBucket(
+                labels=jnp.asarray(bl),
+                weights=jnp.asarray(bw),
+                entity_rows=jnp.asarray(be),
+                sample_rows=jnp.asarray(bs),
+            )
+        )
+    return buckets
+
+
+def build_mf_dataset(
+    dataset: GameDataset,
+    row_effect_type: str,
+    col_effect_type: str,
+    *,
+    bucket_sizes=(8, 32, 128, 512, 2048),
+    active_data_upper_bound: int | None = None,
+    seed: int = 0,
+) -> MFDataset:
+    labels = np.asarray(dataset.labels)
+    weights = np.asarray(dataset.weights)
+    unique_ids = np.asarray(dataset.unique_ids)
+    row_idx = np.asarray(dataset.entity_idx[row_effect_type])
+    col_idx = np.asarray(dataset.entity_idx[col_effect_type])
+    return MFDataset(
+        row_effect_type=row_effect_type,
+        col_effect_type=col_effect_type,
+        row_buckets=_build_side_buckets(
+            row_idx, col_idx, labels, weights, unique_ids,
+            bucket_sizes=bucket_sizes,
+            active_data_upper_bound=active_data_upper_bound, seed=seed,
+        ),
+        col_buckets=_build_side_buckets(
+            col_idx, row_idx, labels, weights, unique_ids,
+            bucket_sizes=bucket_sizes,
+            active_data_upper_bound=active_data_upper_bound, seed=seed,
+        ),
+        num_row_entities=len(dataset.entity_vocabs[row_effect_type]),
+        num_col_entities=len(dataset.entity_vocabs[col_effect_type]),
+    )
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _jitted_mf_side_solve(
+    objective: GLMObjective,
+    opt: OptimizerConfig,
+    labels: Array,        # [e, cap]
+    weights: Array,       # [e, cap]
+    entity_rows: Array,   # [e]
+    sample_rows: Array,   # [e, cap]
+    other_idx_full: Array,  # [n] the fixed side's per-sample entity index
+    other_factors: Array,   # [E_other, k] the fixed side's factor table
+    full_offsets: Array,    # [n] base + residual offsets
+    table: Array,           # [E_this, k] this side's factor table
+) -> Array:
+    """One alternating half-step over one bucket: gather the fixed side's
+    factors as features, vmap-solve every entity, scatter back."""
+    safe_rows = jnp.maximum(sample_rows, 0)
+    oidx = other_idx_full[safe_rows]                       # [e, cap]
+    feats = other_factors[jnp.maximum(oidx, 0)]            # [e, cap, k]
+    pad = sample_rows < 0
+    feats = jnp.where(pad[..., None] | (oidx < 0)[..., None], 0.0, feats)
+    offsets = jnp.where(pad, 0.0, full_offsets[safe_rows])
+    solved = _solve_bucket_entities(
+        objective, opt, feats, labels, weights, offsets, table[entity_rows]
+    )
+    return table.at[entity_rows].set(solved)
+
+
+@dataclasses.dataclass
+class MatrixFactorizationCoordinate(Coordinate):
+    """Trains (row_factors, col_factors) on the residual offsets.
+
+    ``num_alternations`` inner row/col sweeps per coordinate update; the
+    outer coordinate-descent loop supplies further alternations, so small
+    values (1-2) suffice.
+    """
+
+    coordinate_id: str
+    dataset: GameDataset
+    mf_dataset: MFDataset
+    task: TaskType
+    config: CoordinateOptimizationConfig
+    num_latent_factors: int
+    num_alternations: int = 2
+    seed: int = 0
+
+    def initial_model(self) -> MatrixFactorizationModel:
+        mf = self.mf_dataset
+        row, col = init_factors(
+            mf.num_row_entities, mf.num_col_entities, self.num_latent_factors,
+            seed=self.seed, dtype=self.dataset.labels.dtype,
+        )
+        return MatrixFactorizationModel(
+            row_factors=row,
+            col_factors=col,
+            row_effect_type=mf.row_effect_type,
+            col_effect_type=mf.col_effect_type,
+            row_keys=self.dataset.entity_vocabs[mf.row_effect_type],
+            col_keys=self.dataset.entity_vocabs[mf.col_effect_type],
+            task=self.task,
+        )
+
+    def update_model(
+        self, model: MatrixFactorizationModel, extra_offsets: Array | None = None
+    ):
+        if self.config.l1_weight > 0.0:
+            raise ValueError(
+                "L1 regularization is not supported on latent factors "
+                "(use l2_weight; the reference's MF design is L2-only)"
+            )
+        objective = _make_objective(self.task, self.config, None)
+        opt = _solve_config(self.config)
+        full_offsets = self.dataset.offsets
+        if extra_offsets is not None:
+            full_offsets = full_offsets + extra_offsets
+
+        mf = self.mf_dataset
+        row_idx = self.dataset.entity_idx[mf.row_effect_type]
+        col_idx = self.dataset.entity_idx[mf.col_effect_type]
+        rows, cols = model.row_factors, model.col_factors
+        for _ in range(self.num_alternations):
+            for b in mf.row_buckets:
+                rows = _jitted_mf_side_solve(
+                    objective, opt, b.labels, b.weights, b.entity_rows,
+                    b.sample_rows, col_idx, cols, full_offsets, rows,
+                )
+            for b in mf.col_buckets:
+                cols = _jitted_mf_side_solve(
+                    objective, opt, b.labels, b.weights, b.entity_rows,
+                    b.sample_rows, row_idx, rows, full_offsets, cols,
+                )
+        return model.with_factors(rows, cols), None
+
+    def score(self, model: MatrixFactorizationModel) -> Array:
+        return model.score_dataset(self.dataset)

@@ -22,6 +22,7 @@ from typing import Mapping, Sequence
 from photon_ml_tpu.algorithm.coordinates import CoordinateOptimizationConfig
 from photon_ml_tpu.estimators import (
     FixedEffectCoordinateConfig,
+    MatrixFactorizationCoordinateConfig,
     RandomEffectCoordinateConfig,
 )
 from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
@@ -100,10 +101,20 @@ class CoordinateCliConfig:
     active_data_upper_bound: int | None = None
     projector: ProjectorType = ProjectorType.IDENTITY
     projected_dim: int | None = None
+    # matrix-factorization only (feature_shard is unused: the "features" of
+    # an MF coordinate are the other side's latent factors)
+    mf_row_effect_type: str | None = None
+    mf_col_effect_type: str | None = None
+    mf_latent_factors: int = 0
+    mf_alternations: int = 2
 
     @property
     def is_random_effect(self) -> bool:
         return self.random_effect_type is not None
+
+    @property
+    def is_matrix_factorization(self) -> bool:
+        return self.mf_row_effect_type is not None
 
     def optimization_config(self, reg_weight: float) -> CoordinateOptimizationConfig:
         l1 = self.reg_alpha * reg_weight
@@ -121,6 +132,15 @@ class CoordinateCliConfig:
         )
 
     def estimator_config(self, reg_weight: float):
+        if self.is_matrix_factorization:
+            return MatrixFactorizationCoordinateConfig(
+                row_effect_type=self.mf_row_effect_type,
+                col_effect_type=self.mf_col_effect_type,
+                num_latent_factors=self.mf_latent_factors,
+                optimization=self.optimization_config(reg_weight),
+                num_alternations=self.mf_alternations,
+                active_data_upper_bound=self.active_data_upper_bound,
+            )
         if self.is_random_effect:
             return RandomEffectCoordinateConfig(
                 random_effect_type=self.random_effect_type,
@@ -144,12 +164,19 @@ def parse_coordinate_config(spec: str) -> CoordinateCliConfig:
     kv = parse_kv_list(spec)
     try:
         name = kv.pop("name")
-        shard = kv.pop("feature.shard")
+        # MF coordinates take no feature shard (their features are the other
+        # side's latent factors); everything else requires one.
+        if "mf.row.effect.type" in kv:
+            shard = kv.pop("feature.shard", "")
+        else:
+            shard = kv.pop("feature.shard")
     except KeyError as e:
         raise ValueError(f"coordinate config missing {e} in {spec!r}") from None
 
     def pop(key, default=None):
         return kv.pop(key, default)
+
+    mf_keys_given = sorted(k for k in kv if k.startswith("mf."))
 
     cfg = CoordinateCliConfig(
         name=name,
@@ -172,11 +199,27 @@ def parse_coordinate_config(spec: str) -> CoordinateCliConfig:
         ),
         projector=ProjectorType(pop("projector", "IDENTITY").upper()),
         projected_dim=(int(v) if (v := pop("projected.dim")) else None),
+        mf_row_effect_type=pop("mf.row.effect.type"),
+        mf_col_effect_type=pop("mf.col.effect.type"),
+        mf_latent_factors=int(pop("mf.latent.factors", "0")),
+        mf_alternations=int(pop("mf.alternations", "2")),
     )
     if kv:
         raise ValueError(f"unknown coordinate config keys {sorted(kv)} in {spec!r}")
     if not cfg.reg_weights:
         raise ValueError(f"coordinate {name!r} has an empty reg.weights grid")
+    # Any mf.* key makes this an MF coordinate; partial specs (e.g. col+factors
+    # without row) must fail loudly, not silently train a fixed effect.
+    if mf_keys_given and (
+        cfg.mf_row_effect_type is None
+        or cfg.mf_col_effect_type is None
+        or cfg.mf_latent_factors <= 0
+    ):
+        raise ValueError(
+            f"coordinate {name!r} sets {mf_keys_given} but a matrix-"
+            "factorization coordinate requires all of mf.row.effect.type, "
+            "mf.col.effect.type, and mf.latent.factors > 0"
+        )
     return cfg
 
 

@@ -24,6 +24,7 @@ from photon_ml_tpu.io.data_reader import read_merged
 from photon_ml_tpu.io.index_map import IndexMap
 from photon_ml_tpu.io.model_io import load_game_model, write_scores
 from photon_ml_tpu.models.game import RandomEffectModel
+from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
 from photon_ml_tpu.transformers import GameTransformer
 from photon_ml_tpu.util import Timed
 
@@ -71,18 +72,14 @@ def run(
 
     with Timed("load model"):
         model = load_game_model(model_input_dir, index_maps)
-    re_columns = tuple(
-        sorted(
-            m.random_effect_type
-            for m in model.models.values()
-            if isinstance(m, RandomEffectModel)
-        )
-    )
-    entity_vocabs = {
-        m.random_effect_type: np.asarray(m.entity_keys)
-        for m in model.models.values()
-        if isinstance(m, RandomEffectModel)
-    }
+    entity_vocabs: dict[str, np.ndarray] = {}
+    for m in model.models.values():
+        if isinstance(m, RandomEffectModel):
+            entity_vocabs[m.random_effect_type] = np.asarray(m.entity_keys)
+        elif isinstance(m, MatrixFactorizationModel):
+            entity_vocabs[m.row_effect_type] = np.asarray(m.row_keys)
+            entity_vocabs[m.col_effect_type] = np.asarray(m.col_keys)
+    re_columns = tuple(sorted(entity_vocabs))
 
     with Timed("read scoring data"):
         data = read_merged(

@@ -114,6 +114,8 @@ class GameTrainingParams:
         if self.partial_retrain_locked_coordinates and self.model_input_dir is None:
             problems.append("partial retraining requires --model-input-dir")
         for name, cfg in self.coordinates.items():
+            if cfg.is_matrix_factorization:
+                continue  # MF coordinates take no feature shard
             if cfg.feature_shard not in self.feature_shards:
                 problems.append(
                     f"coordinate '{name}' references undefined feature shard "
@@ -160,9 +162,16 @@ def run(params: GameTrainingParams) -> dict:
 
 def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
     out = params.root_output_dir
-    re_columns = tuple(
-        sorted({c.random_effect_type for c in params.coordinates.values() if c.random_effect_type})
-    )
+    entity_columns = {
+        c.random_effect_type
+        for c in params.coordinates.values()
+        if c.random_effect_type
+    }
+    for c in params.coordinates.values():
+        # MF coordinates consume two entity-id columns (row + col)
+        if c.is_matrix_factorization:
+            entity_columns.update((c.mf_row_effect_type, c.mf_col_effect_type))
+    re_columns = tuple(sorted(entity_columns))
     eval_columns = evaluation_id_columns(params.evaluators)
 
     def resolve(path, range_spec):

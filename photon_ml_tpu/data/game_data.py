@@ -153,6 +153,53 @@ def _stable_priorities(sample_ids: np.ndarray, seed: int) -> np.ndarray:
     return stable_uniform(sample_ids, seed)
 
 
+def group_entities_into_buckets(
+    entity_idx: np.ndarray,
+    unique_ids: np.ndarray,
+    *,
+    bucket_sizes: Sequence[int],
+    active_data_upper_bound: int | None = None,
+    active_data_lower_bound: int | None = None,
+    seed: int = 0,
+) -> dict[int, list[tuple[int, np.ndarray]]]:
+    """Group sample rows by entity into size buckets.
+
+    Returns {bucket_capacity: [(entity_row, sample_rows), ...]}. Applies the
+    per-entity reservoir cap (stable-id keyed, reference
+    RandomEffectDataSet.scala:354-420) and the lower-bound filter (:320-341).
+    Shared by random-effect and matrix-factorization bucketing.
+    """
+    valid = entity_idx >= 0
+    order = np.argsort(entity_idx[valid], kind="stable")
+    rows = np.nonzero(valid)[0][order]
+    ents = entity_idx[rows]
+    per_bucket: dict[int, list[tuple[int, np.ndarray]]] = {c: [] for c in bucket_sizes}
+    if len(ents) == 0:
+        return per_bucket
+    boundaries = np.concatenate(
+        [[0], np.nonzero(ents[1:] != ents[:-1])[0] + 1, [len(ents)]]
+    )
+    max_bucket = max(bucket_sizes)
+    for start, end in zip(boundaries[:-1], boundaries[1:]):
+        entity = int(ents[start])
+        sample_rows = rows[start:end]
+        count = len(sample_rows)
+        if active_data_lower_bound is not None and count < active_data_lower_bound:
+            continue
+        # The largest bucket is an implicit cap: sampling (not head-truncation)
+        # applies either way, so the kept subset is unbiased.
+        cap = min(active_data_upper_bound or max_bucket, max_bucket)
+        if count > cap:
+            # stable reservoir: keep the `cap` samples with smallest priority
+            prio = _stable_priorities(unique_ids[sample_rows], seed)
+            keep = np.argsort(prio, kind="stable")[:cap]
+            sample_rows = sample_rows[np.sort(keep)]
+            count = cap
+        bucket_cap = next(c for c in bucket_sizes if c >= count)
+        per_bucket[bucket_cap].append((entity, sample_rows))
+    return per_bucket
+
+
 def build_random_effect_dataset(
     dataset: GameDataset,
     re_type: str,
@@ -193,34 +240,14 @@ def build_random_effect_dataset(
         projection = RandomProjectionMatrix.create(dim, projected_dim, seed)
         features = projection.project_features(features).astype(features.dtype)
 
-    # samples per entity (ignore rows with no entity)
-    valid = entity_idx >= 0
-    order = np.argsort(entity_idx[valid], kind="stable")
-    rows = np.nonzero(valid)[0][order]
-    ents = entity_idx[rows]
-    boundaries = np.concatenate(
-        [[0], np.nonzero(ents[1:] != ents[:-1])[0] + 1, [len(ents)]]
+    per_bucket = group_entities_into_buckets(
+        entity_idx,
+        unique_ids,
+        bucket_sizes=bucket_sizes,
+        active_data_upper_bound=active_data_upper_bound,
+        active_data_lower_bound=active_data_lower_bound,
+        seed=seed,
     )
-
-    max_bucket = max(bucket_sizes)
-    per_bucket: dict[int, list[tuple[int, np.ndarray]]] = {c: [] for c in bucket_sizes}
-    for start, end in zip(boundaries[:-1], boundaries[1:]):
-        entity = int(ents[start])
-        sample_rows = rows[start:end]
-        count = len(sample_rows)
-        if active_data_lower_bound is not None and count < active_data_lower_bound:
-            continue
-        # The largest bucket is an implicit cap: sampling (not head-truncation)
-        # applies either way, so the kept subset is unbiased.
-        cap = min(active_data_upper_bound or max_bucket, max_bucket)
-        if count > cap:
-            # stable reservoir: keep the `cap` samples with smallest priority
-            prio = _stable_priorities(unique_ids[sample_rows], seed)
-            keep = np.argsort(prio, kind="stable")[:cap]
-            sample_rows = sample_rows[np.sort(keep)]
-            count = cap
-        bucket_cap = next(c for c in bucket_sizes if c >= count)
-        per_bucket[bucket_cap].append((entity, sample_rows))
 
     index_projected = projector_type == ProjectorType.INDEX_MAP
     buckets: list[EntityBucket] = []

@@ -31,6 +31,10 @@ from photon_ml_tpu.algorithm.coordinates import (
     ModelCoordinate,
     RandomEffectCoordinate,
 )
+from photon_ml_tpu.algorithm.mf_coordinate import (
+    MatrixFactorizationCoordinate,
+    build_mf_dataset,
+)
 from photon_ml_tpu.data.batch import LabeledPointBatch, summarize
 from photon_ml_tpu.data.game_data import (
     GameDataset,
@@ -83,7 +87,26 @@ class RandomEffectCoordinateConfig:
     projected_dim: int | None = None  # RANDOM only
 
 
-CoordinateConfig = FixedEffectCoordinateConfig | RandomEffectCoordinateConfig
+@dataclasses.dataclass(frozen=True)
+class MatrixFactorizationCoordinateConfig:
+    """MF coordinate over a (row entity, col entity) pair — the model family
+    the reference declares (README.md:92-95, LatentFactorAvro.avsc) but
+    never implemented."""
+
+    row_effect_type: str
+    col_effect_type: str
+    num_latent_factors: int
+    optimization: CoordinateOptimizationConfig
+    num_alternations: int = 2
+    active_data_upper_bound: int | None = None
+    seed: int = 0
+
+
+CoordinateConfig = (
+    FixedEffectCoordinateConfig
+    | RandomEffectCoordinateConfig
+    | MatrixFactorizationCoordinateConfig
+)
 
 
 @dataclasses.dataclass
@@ -143,6 +166,24 @@ class GameEstimator:
                     config=cfg.optimization,
                     normalization=norms.get(cfg.feature_shard_id),
                     intercept_index=self.intercept_indices.get(cfg.feature_shard_id),
+                )
+            elif isinstance(cfg, MatrixFactorizationCoordinateConfig):
+                mf_dataset = build_mf_dataset(
+                    dataset,
+                    cfg.row_effect_type,
+                    cfg.col_effect_type,
+                    active_data_upper_bound=cfg.active_data_upper_bound,
+                    seed=cfg.seed,
+                )
+                coordinates[cid] = MatrixFactorizationCoordinate(
+                    coordinate_id=cid,
+                    dataset=dataset,
+                    mf_dataset=mf_dataset,
+                    task=self.task,
+                    config=cfg.optimization,
+                    num_latent_factors=cfg.num_latent_factors,
+                    num_alternations=cfg.num_alternations,
+                    seed=cfg.seed,
                 )
             else:
                 re_dataset = build_random_effect_dataset(

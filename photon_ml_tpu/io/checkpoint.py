@@ -43,6 +43,7 @@ from photon_ml_tpu.models.game import (
     RandomEffectModel,
 )
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
 from photon_ml_tpu.types import TaskType
 
 _STEP_PREFIX = "step_"
@@ -178,6 +179,17 @@ def game_model_to_arrays(model: GameModel) -> tuple[dict[str, np.ndarray], dict]
                 "feature_shard_id": sub.feature_shard_id,
                 "task": sub.task.name,
             }
+        elif isinstance(sub, MatrixFactorizationModel):
+            arrays[f"{cid}/row_factors"] = np.asarray(sub.row_factors)
+            arrays[f"{cid}/col_factors"] = np.asarray(sub.col_factors)
+            arrays[f"{cid}/row_keys"] = np.asarray(sub.row_keys)
+            arrays[f"{cid}/col_keys"] = np.asarray(sub.col_keys)
+            coords_meta[cid] = {
+                "kind": "matrix_factorization",
+                "row_effect_type": sub.row_effect_type,
+                "col_effect_type": sub.col_effect_type,
+                "task": sub.task.name,
+            }
         else:
             raise TypeError(f"Cannot checkpoint sub-model type {type(sub)!r}")
     return arrays, {"coordinates": coords_meta, "order": list(model.models)}
@@ -265,6 +277,16 @@ def game_model_from_arrays(
                 feature_shard_id=info["feature_shard_id"],
                 task=task,
                 variances=variances,
+            )
+        elif info["kind"] == "matrix_factorization":
+            models[cid] = MatrixFactorizationModel(
+                row_factors=arrays[f"{cid}/row_factors"],
+                col_factors=arrays[f"{cid}/col_factors"],
+                row_effect_type=info["row_effect_type"],
+                col_effect_type=info["col_effect_type"],
+                row_keys=arrays[f"{cid}/row_keys"],
+                col_keys=arrays[f"{cid}/col_keys"],
+                task=task,
             )
         else:
             raise ValueError(f"Unknown checkpoint coordinate kind {info['kind']!r}")

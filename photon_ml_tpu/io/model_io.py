@@ -29,13 +29,17 @@ from photon_ml_tpu.io import photon_schemas as schemas
 from photon_ml_tpu.io.index_map import IndexMap, split_feature_key
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.types import TaskType
 
 FIXED_EFFECT = "fixed-effect"
 RANDOM_EFFECT = "random-effect"
+MATRIX_FACTORIZATION = "matrix-factorization"
 ID_INFO = "id-info"
 COEFFICIENTS = "coefficients"
+ROW_LATENT_FACTORS = "row-latent-factors"
+COL_LATENT_FACTORS = "col-latent-factors"
 METADATA_FILE = "model-metadata.json"
 
 #: Default sparsity threshold below which coefficients are not persisted
@@ -90,6 +94,27 @@ def _glm_to_record(
             np.asarray(glm.coefficients.variances), index_map, 0.0
         )
     return record
+
+
+def _write_chunked(
+    directory: str, schema: dict, records: Iterable[dict], per_file: int
+) -> None:
+    """Write records into part-NNNNN.avro files of at most per_file records
+    (reference randomEffectModelFileLimit)."""
+    it = iter(records)
+    part = 0
+    while True:
+        chunk = []
+        for record in it:
+            chunk.append(record)
+            if len(chunk) >= per_file:
+                break
+        if not chunk:
+            break
+        avro_io.write_container(
+            os.path.join(directory, f"part-{part:05d}.avro"), schema, chunk
+        )
+        part += 1
 
 
 def _record_to_coefficients(record: dict, index_map: IndexMap, dtype) -> Coefficients:
@@ -166,23 +191,41 @@ def save_game_model(
                     )
                     yield _glm_to_record(key, glm, index_map, sparsity_threshold)
 
-            # chunk into part files (reference randomEffectModelFileLimit)
-            it = iter(records())
-            part = 0
-            while True:
-                chunk = []
-                for record in it:
-                    chunk.append(record)
-                    if len(chunk) >= random_effect_records_per_file:
-                        break
-                if not chunk:
-                    break
-                avro_io.write_container(
-                    os.path.join(base, COEFFICIENTS, f"part-{part:05d}.avro"),
-                    schemas.BAYESIAN_LINEAR_MODEL_AVRO,
-                    chunk,
+            _write_chunked(
+                os.path.join(base, COEFFICIENTS),
+                schemas.BAYESIAN_LINEAR_MODEL_AVRO,
+                records(),
+                random_effect_records_per_file,
+            )
+        elif isinstance(model, MatrixFactorizationModel):
+            # LatentFactorAvro (the reference's declared-but-unimplemented MF
+            # wire format, LatentFactorAvro.avsc): effectId + latentFactor.
+            base = os.path.join(output_dir, MATRIX_FACTORIZATION, name)
+            os.makedirs(base, exist_ok=True)
+            with open(os.path.join(base, ID_INFO), "w") as f:
+                f.write(model.row_effect_type + "\n")
+                f.write(model.col_effect_type + "\n")
+            for sub, factors, keys in (
+                (ROW_LATENT_FACTORS, model.row_factors, model.row_keys),
+                (COL_LATENT_FACTORS, model.col_factors, model.col_keys),
+            ):
+                table = np.asarray(factors)
+                key_list = [str(k) for k in np.asarray(keys).tolist()]
+                os.makedirs(os.path.join(base, sub), exist_ok=True)
+
+                def lf_records() -> Iterable[dict]:
+                    for i, key in enumerate(key_list):
+                        yield {
+                            "effectId": key,
+                            "latentFactor": [float(v) for v in table[i]],
+                        }
+
+                _write_chunked(
+                    os.path.join(base, sub),
+                    schemas.LATENT_FACTOR_AVRO,
+                    lf_records(),
+                    random_effect_records_per_file,
                 )
-                part += 1
         else:
             raise TypeError(f"cannot save coordinate '{name}' of type {type(model)}")
 
@@ -257,6 +300,38 @@ def load_game_model(
                 random_effect_type=re_type,
                 feature_shard_id=shard_id,
                 task=model_task,
+            )
+
+    mf_dir = os.path.join(models_dir, MATRIX_FACTORIZATION)
+    if os.path.isdir(mf_dir):
+        for name in sorted(os.listdir(mf_dir)):
+            if coordinates_to_load is not None and name not in coordinates_to_load:
+                continue
+            base = os.path.join(mf_dir, name)
+            with open(os.path.join(base, ID_INFO)) as f:
+                lines = f.read().strip().splitlines()
+            row_type, col_type = lines[0], lines[1]
+
+            def read_factors(sub: str) -> tuple[np.ndarray, np.ndarray]:
+                recs = list(avro_io.read_directory(os.path.join(base, sub)))
+                keys = sorted(r["effectId"] for r in recs)
+                row_of = {k: i for i, k in enumerate(keys)}
+                k_dim = len(recs[0]["latentFactor"]) if recs else 0
+                table = np.zeros((len(keys), k_dim), dtype=dtype)
+                for r in recs:
+                    table[row_of[r["effectId"]]] = r["latentFactor"]
+                return table, np.asarray(keys)
+
+            row_table, row_keys = read_factors(ROW_LATENT_FACTORS)
+            col_table, col_keys = read_factors(COL_LATENT_FACTORS)
+            models[name] = MatrixFactorizationModel(
+                row_factors=jnp.asarray(row_table),
+                col_factors=jnp.asarray(col_table),
+                row_effect_type=row_type,
+                col_effect_type=col_type,
+                row_keys=row_keys,
+                col_keys=col_keys,
+                task=task,
             )
 
     if not models:

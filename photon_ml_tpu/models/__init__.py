@@ -7,3 +7,8 @@ from photon_ml_tpu.models.game import (  # noqa: F401
     RandomEffectModel,
     score_random_effect,
 )
+from photon_ml_tpu.models.matrix_factorization import (  # noqa: F401
+    MatrixFactorizationModel,
+    init_factors,
+    score_matrix_factorization,
+)

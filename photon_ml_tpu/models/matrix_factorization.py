@@ -1,0 +1,110 @@
+"""Matrix-factorization model: latent factors over two entity axes.
+
+Reference parity: the reference *declares* this model family but never
+implements it — only the wire format survives
+(photon-avro-schemas/src/main/avro/LatentFactorAvro.avsc: effectId +
+latentFactor array) plus dead converter helpers
+(photon-client data/avro/AvroUtils.scala:418-445) and the README mention of
+a matrix-factorization coordinate (README.md:92-95). This module implements
+the capability the schema promises: a GAME coordinate whose score for a
+sample is ``dot(row_factor[rowId], col_factor[colId])``, trained on the
+coordinate-descent residuals.
+
+TPU-native: both factor tables are dense [num_entities, k] arrays; scoring
+is two gathers + a fused row-wise dot, and training (algorithm/
+mf_coordinate.py) is alternating minimization where each half-step is the
+same vmapped per-entity GLM solve used by random-effect coordinates — the
+"features" of a row-entity's local problem are the gathered column factors
+of its samples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.models.game import DatumScoringModel
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class MatrixFactorizationModel(DatumScoringModel):
+    """Latent-factor model over a (row entity, col entity) pair.
+
+    row_factors: [num_row_entities, k]
+    col_factors: [num_col_entities, k]
+    row/col_keys: host-side vocabs, position == row index (same convention
+    as RandomEffectModel.entity_keys).
+    """
+
+    row_factors: Array
+    col_factors: Array
+    row_effect_type: str
+    col_effect_type: str
+    row_keys: np.ndarray
+    col_keys: np.ndarray
+    task: TaskType
+
+    @property
+    def num_latent_factors(self) -> int:
+        return int(self.row_factors.shape[1])
+
+    def score_dataset(self, dataset) -> Array:
+        row_idx = dataset.entity_indices(self.row_effect_type)
+        col_idx = dataset.entity_indices(self.col_effect_type)
+        return score_matrix_factorization(
+            self.row_factors, self.col_factors, row_idx, col_idx
+        )
+
+    def with_factors(
+        self, row_factors: Array, col_factors: Array
+    ) -> "MatrixFactorizationModel":
+        return dataclasses.replace(
+            self, row_factors=row_factors, col_factors=col_factors
+        )
+
+
+def score_matrix_factorization(
+    row_factors: Array, col_factors: Array, row_idx: Array, col_idx: Array
+) -> Array:
+    """scores_i = row_factors[row_idx_i] . col_factors[col_idx_i].
+
+    Samples whose row OR col entity is unseen (idx < 0) score 0 — the same
+    missing-entity semantics as RandomEffectModel scoring.
+    """
+    both = (row_idx >= 0) & (col_idx >= 0)
+    rows = row_factors[jnp.maximum(row_idx, 0)]
+    cols = col_factors[jnp.maximum(col_idx, 0)]
+    scores = jnp.einsum("nk,nk->n", rows, cols)
+    return jnp.where(both, scores, 0.0)
+
+
+def init_factors(
+    num_rows: int,
+    num_cols: int,
+    num_latent: int,
+    *,
+    seed: int = 0,
+    scale: float | None = None,
+    dtype=jnp.float32,
+) -> tuple[Array, Array]:
+    """Seeded small-random factor init.
+
+    Zeros are a saddle point of the bilinear objective (each side's gradient
+    is proportional to the other side's factors), so MF must start off-zero;
+    the default scale keeps initial scores O(scale²).
+    """
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(num_latent))
+    # Python-float scale: a numpy scalar would promote float32 tables to
+    # float64 under jax_enable_x64.
+    scale = float(scale)
+    kr, kc = jax.random.split(jax.random.PRNGKey(seed))
+    row = scale * jax.random.normal(kr, (num_rows, num_latent), dtype=dtype)
+    col = scale * jax.random.normal(kc, (num_cols, num_latent), dtype=dtype)
+    return row, col

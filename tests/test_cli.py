@@ -257,6 +257,88 @@ class TestEndToEnd:
             atol=1e-6,
         )
 
+    def test_train_then_score_with_mf_coordinate(self, tmp_path):
+        """FE + matrix-factorization coordinate through both drivers —
+        the model family the reference declares but never implemented."""
+        from photon_ml_tpu.cli import game_scoring_driver, game_training_driver
+
+        # data with a true low-rank user x item interaction on the residual
+        truth = np.random.default_rng(7)
+        d, k, n_users, n_items = 4, 2, 10, 8
+        w = truth.normal(size=d)
+        u = truth.normal(size=(n_users, k))
+        v = truth.normal(size=(n_items, k))
+        rng = np.random.default_rng(0)
+        base = tmp_path / "mf-data"
+        for split, n, seed in (("train", 900, 0), ("val", 300, 1)):
+            rng = np.random.default_rng(seed)
+            records = []
+            for i in range(n):
+                ui, vi = rng.integers(0, n_users), rng.integers(0, n_items)
+                x = rng.normal(size=d)
+                y = x @ w + u[ui] @ v[vi] + rng.normal(scale=0.05)
+                records.append(
+                    {
+                        "uid": str(i),
+                        "label": float(y),
+                        "features": [
+                            {"name": f"f{j}", "term": "", "value": float(x[j])}
+                            for j in range(d)
+                        ],
+                        "weight": 1.0,
+                        "offset": 0.0,
+                        "foldId": None,
+                        "metadataMap": {"userId": f"u{ui}", "itemId": f"i{vi}"},
+                    }
+                )
+            os.makedirs(base / split, exist_ok=True)
+            avro_io.write_container(
+                os.path.join(base / split, "part-00000.avro"),
+                schemas.TRAINING_EXAMPLE_AVRO,
+                records,
+            )
+
+        out = tmp_path / "out"
+        summary = game_training_driver.main(
+            [
+                "--input-data-path", str(base / "train"),
+                "--validation-data-path", str(base / "val"),
+                "--root-output-dir", str(out),
+                "--feature-shard-configurations",
+                "name=global,feature.bags=features,intercept=true",
+                "--coordinate-configurations",
+                "name=fe,feature.shard=global,reg.weights=0.001,max.iter=40",
+                "--coordinate-configurations",
+                "name=mf,mf.row.effect.type=userId,mf.col.effect.type=itemId,"
+                "mf.latent.factors=2,reg.weights=0.001,max.iter=25",
+                "--task-type", "LINEAR_REGRESSION",
+                "--coordinate-descent-iterations", "4",
+                "--evaluators", "RMSE",
+            ]
+        )
+        # FE alone leaves the u.v residual (std ~ k=2 products of unit
+        # normals); the MF coordinate must soak most of it up
+        assert summary["best_metric"] < 0.6
+        assert (out / "best" / "matrix-factorization" / "mf" / "id-info").exists()
+        assert (
+            out / "best" / "matrix-factorization" / "mf" / "row-latent-factors"
+            / "part-00000.avro"
+        ).exists()
+
+        score_out = tmp_path / "scores"
+        s = game_scoring_driver.main(
+            [
+                "--input-data-path", str(base / "val"),
+                "--model-input-dir", str(out / "best"),
+                "--output-dir", str(score_out),
+                "--evaluators", "RMSE",
+            ]
+        )
+        assert s["num_scored"] == 300
+        assert s["evaluations"]["RMSE"] == pytest.approx(
+            summary["best_metric"], rel=0.2
+        )
+
     def test_feature_indexing_and_name_term_drivers(self, game_data, tmp_path):
         from photon_ml_tpu.cli import (
             feature_indexing_driver,
