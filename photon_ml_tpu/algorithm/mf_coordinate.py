@@ -29,6 +29,7 @@ from functools import partial
 from photon_ml_tpu.algorithm.coordinates import (
     Coordinate,
     CoordinateOptimizationConfig,
+    _bucket_offsets,
     _make_objective,
     _solve_bucket_entities,
     _solve_config,
@@ -78,6 +79,18 @@ class MFDataset:
     col_buckets: list[MFSideBucket]
     num_row_entities: int
     num_col_entities: int
+
+    def trained_masks(self) -> tuple[np.ndarray, np.ndarray]:
+        """Boolean [R] / [C] masks of entities that appear in any bucket.
+        Entities outside (vocab members with zero samples) are never
+        trained and must score 0, matching random-effect semantics."""
+        row = np.zeros(self.num_row_entities, dtype=bool)
+        for b in self.row_buckets:
+            row[np.asarray(b.entity_rows)] = True
+        col = np.zeros(self.num_col_entities, dtype=bool)
+        for b in self.col_buckets:
+            col[np.asarray(b.entity_rows)] = True
+        return row, col
 
 
 def _build_side_buckets(
@@ -180,7 +193,7 @@ def _jitted_mf_side_solve(
     feats = other_factors[jnp.maximum(oidx, 0)]            # [e, cap, k]
     pad = sample_rows < 0
     feats = jnp.where(pad[..., None] | (oidx < 0)[..., None], 0.0, feats)
-    offsets = jnp.where(pad, 0.0, full_offsets[safe_rows])
+    offsets = _bucket_offsets(sample_rows, full_offsets)
     solved = _solve_bucket_entities(
         objective, opt, feats, labels, weights, offsets, table[entity_rows]
     )
@@ -211,6 +224,11 @@ class MatrixFactorizationCoordinate(Coordinate):
             mf.num_row_entities, mf.num_col_entities, self.num_latent_factors,
             seed=self.seed, dtype=self.dataset.labels.dtype,
         )
+        # Vocab entities with no training samples keep zero factors (they are
+        # never solved, so a random init would leak noise into their scores).
+        row_mask, col_mask = mf.trained_masks()
+        row = jnp.where(jnp.asarray(row_mask)[:, None], row, 0.0)
+        col = jnp.where(jnp.asarray(col_mask)[:, None], col, 0.0)
         return MatrixFactorizationModel(
             row_factors=row,
             col_factors=col,

@@ -220,6 +220,16 @@ def parse_coordinate_config(spec: str) -> CoordinateCliConfig:
             "factorization coordinate requires all of mf.row.effect.type, "
             "mf.col.effect.type, and mf.latent.factors > 0"
         )
+    if cfg.is_matrix_factorization and cfg.is_random_effect:
+        raise ValueError(
+            f"coordinate {name!r} sets both random.effect.type and mf.* keys; "
+            "a coordinate is either a random effect or a matrix factorization"
+        )
+    if cfg.is_matrix_factorization and cfg.reg_alpha > 0.0:
+        raise ValueError(
+            f"MF coordinate {name!r}: L1 (reg.alpha > 0) is not supported on "
+            "latent factors; use pure L2"
+        )
     return cfg
 
 

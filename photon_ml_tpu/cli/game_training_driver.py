@@ -126,6 +126,12 @@ class GameTrainingParams:
                 "--evaluators are validation evaluators and require "
                 "--validation-data-path"
             )
+        for spec in self.evaluators:
+            # fail fast on bad specs, before any data is read
+            try:
+                parse_evaluator(spec)
+            except ValueError as e:
+                problems.append(str(e))
         if (
             self.hyperparameter_tuning != HyperparameterTuningMode.NONE
             and not self.evaluators

@@ -267,11 +267,14 @@ class GameEstimator:
                 )
                 norm_type = NormalizationType.SCALE_WITH_STANDARD_DEVIATION
             stats = summarize(np.asarray(features), weights)
+            # match the shard dtype: float64 stats scattered into float32
+            # coefficient tables would trip jax's strict promotion rules
+            dtype = np.asarray(features).dtype
             norms[shard_id] = build_normalization(
                 norm_type,
-                mean=jnp.asarray(stats["mean"]),
-                variance=jnp.asarray(stats["variance"]),
-                max_magnitude=jnp.asarray(stats["max_magnitude"]),
+                mean=jnp.asarray(stats["mean"], dtype=dtype),
+                variance=jnp.asarray(stats["variance"], dtype=dtype),
+                max_magnitude=jnp.asarray(stats["max_magnitude"], dtype=dtype),
                 intercept_index=intercept,
             )
         return norms

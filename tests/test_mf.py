@@ -220,6 +220,49 @@ def test_mf_cli_config_partial_spec_rejected():
         )
 
 
+def test_mf_cli_config_conflicts_rejected():
+    from photon_ml_tpu.cli.configs import parse_coordinate_config
+
+    with pytest.raises(ValueError, match="either a random effect or"):
+        parse_coordinate_config(
+            "name=x,feature.shard=g,random.effect.type=u,"
+            "mf.row.effect.type=u,mf.col.effect.type=i,mf.latent.factors=2"
+        )
+    with pytest.raises(ValueError, match="L1"):
+        parse_coordinate_config(
+            "name=x,mf.row.effect.type=u,mf.col.effect.type=i,"
+            "mf.latent.factors=2,reg.alpha=0.5"
+        )
+
+
+def test_mf_untrained_vocab_entities_score_zero(rng):
+    """Vocab entities with zero samples must score 0, not random-init noise
+    (random-effect missing-entity semantics)."""
+    rows, cols, y = _mf_problem(rng, n=60, n_rows=5, n_cols=4)
+    vocab_rows = np.concatenate([np.unique(rows), ["ghost-user"]])
+    ds = build_game_dataset(
+        labels=y,
+        feature_shards={},
+        entity_keys={"user": rows, "item": cols},
+        entity_vocabs={"user": vocab_rows},
+        dtype=np.float64,
+    )
+    coord = MatrixFactorizationCoordinate(
+        coordinate_id="mf",
+        dataset=ds,
+        mf_dataset=build_mf_dataset(ds, "user", "item"),
+        task=TaskType.LINEAR_REGRESSION,
+        config=CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=5), l2_weight=1e-3
+        ),
+        num_latent_factors=2,
+        num_alternations=1,
+    )
+    model, _ = coord.update_model(coord.initial_model())
+    ghost = int(np.nonzero(np.asarray(model.row_keys) == "ghost-user")[0][0])
+    np.testing.assert_array_equal(np.asarray(model.row_factors)[ghost], 0.0)
+
+
 def test_mf_model_avro_round_trip(tmp_path, rng):
     rows = np.array(["u0", "u1", "u2"])
     cols = np.array(["i0", "i1"])
