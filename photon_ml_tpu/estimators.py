@@ -266,10 +266,11 @@ class GameEstimator:
                     shard_id,
                 )
                 norm_type = NormalizationType.SCALE_WITH_STANDARD_DEVIATION
-            stats = summarize(np.asarray(features), weights)
+            feats = np.asarray(features)
+            stats = summarize(feats, weights)
             # match the shard dtype: float64 stats scattered into float32
             # coefficient tables would trip jax's strict promotion rules
-            dtype = np.asarray(features).dtype
+            dtype = feats.dtype
             norms[shard_id] = build_normalization(
                 norm_type,
                 mean=jnp.asarray(stats["mean"], dtype=dtype),

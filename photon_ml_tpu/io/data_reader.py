@@ -71,6 +71,8 @@ def read_libsvm(path: str | os.PathLike, *, zero_based: bool = False) -> Iterato
             label = (1.0 if raw_label > 0 else 0.0) if raw_label in (-1.0, 1.0) else raw_label
             features = []
             for tok in parts[1:]:
+                if tok.startswith("#"):
+                    break  # trailing comment
                 idx_s, _, val_s = tok.partition(":")
                 idx = int(idx_s) - (0 if zero_based else 1)
                 features.append({"name": str(idx), "term": "", "value": float(val_s)})
@@ -126,6 +128,29 @@ class ReadResult:
     dataset: GameDataset
     index_maps: dict[str, IndexMap]
     intercept_indices: dict[str, int]
+
+
+def _scatter_dense(
+    n: int, d: int, row_idx: np.ndarray, col_idx: np.ndarray, vals: np.ndarray, dtype
+) -> np.ndarray:
+    """[n, d] dense block from COO triples; duplicate (row, col) accumulate
+    (the one shared accumulation rule for every reader path)."""
+    x = np.zeros((n, d), dtype=dtype)
+    if len(col_idx):
+        np.add.at(
+            x, (row_idx.astype(np.intp), col_idx.astype(np.intp)), vals.astype(dtype)
+        )
+    return x
+
+
+def _apply_intercept(
+    x: np.ndarray, imap: IndexMap, shard: str, intercept_indices: dict[str, int]
+) -> None:
+    """Set the intercept column to 1 and record its index, if the map has one."""
+    ii = imap.get_index(INTERCEPT_KEY)
+    if ii >= 0:
+        x[:, ii] = 1.0
+        intercept_indices[shard] = ii
 
 
 def records_to_game_dataset(
@@ -195,20 +220,16 @@ def records_to_game_dataset(
     intercept_indices: dict[str, int] = {}
     for shard, cfg in shard_configs.items():
         imap = index_maps[shard]
-        d = imap.size
-        x = np.zeros((n, d), dtype=dtype)
-        if rows[shard]:
-            triples = np.asarray(rows[shard], dtype=np.float64)
-            np.add.at(
-                x,
-                (triples[:, 0].astype(np.intp), triples[:, 1].astype(np.intp)),
-                triples[:, 2].astype(dtype),
-            )
+        triples = (
+            np.asarray(rows[shard], dtype=np.float64)
+            if rows[shard]
+            else np.zeros((0, 3))
+        )
+        x = _scatter_dense(
+            n, imap.size, triples[:, 0], triples[:, 1], triples[:, 2], dtype
+        )
         if cfg.has_intercept:
-            ii = imap.get_index(INTERCEPT_KEY)
-            if ii >= 0:
-                x[:, ii] = 1.0
-                intercept_indices[shard] = ii
+            _apply_intercept(x, imap, shard, intercept_indices)
         feature_shards[shard] = x
 
     entity_keys = {
@@ -258,11 +279,22 @@ def read_merged(
     if not paths:
         raise ValueError("read_merged needs at least one input path")
 
+    if fmt == "libsvm":
+        # CSR fast path: native C++ tokenizer (photon_ml_tpu/native/
+        # libsvm_loader.cpp) + vectorized dense assembly, no per-record dicts
+        return _read_merged_libsvm(
+            paths,
+            shard_configs,
+            index_maps=index_maps,
+            random_effect_id_columns=random_effect_id_columns,
+            evaluation_id_columns=evaluation_id_columns,
+            entity_vocabs=entity_vocabs,
+            dtype=dtype,
+        )
+
     def records():
         if fmt == "avro":
             return itertools.chain.from_iterable(read_avro_records(p) for p in paths)
-        if fmt == "libsvm":
-            return itertools.chain.from_iterable(read_libsvm(p) for p in paths)
         raise ValueError(f"unknown format {fmt!r}")
 
     if index_maps is None:
@@ -281,4 +313,79 @@ def read_merged(
         evaluation_id_columns=evaluation_id_columns,
         entity_vocabs=entity_vocabs,
         dtype=dtype,
+    )
+
+
+def _read_merged_libsvm(
+    paths: Sequence[str | os.PathLike],
+    shard_configs: Mapping[str, FeatureShardConfiguration],
+    *,
+    index_maps: Mapping[str, IndexMap] | None,
+    random_effect_id_columns: Sequence[str],
+    evaluation_id_columns: Sequence[str],
+    entity_vocabs: Mapping[str, np.ndarray] | None,
+    dtype,
+) -> ReadResult:
+    """Vectorized LibSVM read (same semantics as the record-dict path:
+    feature name = str(0-based index), term = "", one bag called
+    "features"; LibSVM carries no id/metadata columns)."""
+    from photon_ml_tpu.io.libsvm_native import concat_libsvm, parse_libsvm
+
+    data = concat_libsvm([parse_libsvm(p) for p in paths])
+    n = data.num_rows
+    distinct = np.unique(data.cols) if data.nnz else np.asarray([], dtype=np.uint32)
+
+    if index_maps is None:
+        index_maps = {
+            shard: IndexMap.from_keys(
+                {feature_key(str(int(j)), "") for j in distinct}
+                if "features" in cfg.feature_bags
+                else set(),
+                add_intercept=cfg.has_intercept,
+            )
+            for shard, cfg in shard_configs.items()
+        }
+
+    row_idx = np.repeat(
+        np.arange(n, dtype=np.intp), np.diff(data.row_offsets).astype(np.intp)
+    )
+    feature_shards: dict[str, np.ndarray] = {}
+    intercept_indices: dict[str, int] = {}
+    for shard, cfg in shard_configs.items():
+        imap = index_maps[shard]
+        if "features" in cfg.feature_bags and data.nnz:
+            # CSR col j -> shard column via the index map; searchsorted over
+            # the distinct indices keeps memory O(distinct), independent of
+            # the largest feature index (hashing-trick data)
+            mapped_distinct = np.asarray(
+                [imap.get_index(feature_key(str(int(j)), "")) for j in distinct],
+                dtype=np.int64,
+            )
+            mapped = mapped_distinct[np.searchsorted(distinct, data.cols)]
+            keep = mapped >= 0
+            x = _scatter_dense(
+                n, imap.size, row_idx[keep], mapped[keep], data.vals[keep], dtype
+            )
+        else:
+            x = np.zeros((n, imap.size), dtype=dtype)
+        if cfg.has_intercept:
+            _apply_intercept(x, imap, shard, intercept_indices)
+        feature_shards[shard] = x
+
+    empty_ids = np.full(n, "", dtype=object)
+    dataset = build_game_dataset(
+        labels=data.mapped_labels(),
+        feature_shards=feature_shards,
+        entity_keys={c: empty_ids for c in random_effect_id_columns},
+        offsets=np.zeros(n),
+        weights=np.ones(n),
+        unique_ids=np.arange(n, dtype=np.int64),
+        ids={c: empty_ids for c in evaluation_id_columns},
+        entity_vocabs=entity_vocabs,
+        dtype=dtype,
+    )
+    return ReadResult(
+        dataset=dataset,
+        index_maps=dict(index_maps),
+        intercept_indices=intercept_indices,
     )

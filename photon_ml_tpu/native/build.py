@@ -1,9 +1,9 @@
-"""Compile-on-demand loader for the native off-heap store library.
+"""Compile-on-demand loader for the native C++ libraries.
 
-The .so is built once from offheap_store.cpp with the system g++ and cached
-next to the source (rebuilt when the source changes, keyed by mtime+size).
-Everything degrades gracefully: ``native_available()`` is False when no
-compiler exists, and callers fall back to the pure-Python reader.
+Each .so is built once from its .cpp with the system g++ and cached next to
+the source (rebuilt when the source changes, keyed by mtime+size).
+Everything degrades gracefully: the ``*_available()`` probes return False
+when no compiler exists, and callers fall back to pure-Python paths.
 """
 
 from __future__ import annotations
@@ -15,24 +15,24 @@ import shutil
 import subprocess
 import tempfile
 import threading
+from typing import Callable
 
 logger = logging.getLogger(__name__)
 
-_SOURCE = os.path.join(os.path.dirname(__file__), "offheap_store.cpp")
+_DIR = os.path.dirname(__file__)
 _LOCK = threading.Lock()
-_LIB: ctypes.CDLL | None = None
-_LOAD_FAILED = False
+_LIBS: dict[str, ctypes.CDLL] = {}
+_FAILED: set[str] = set()
 
 
-def _lib_path() -> str:
-    src_stat = os.stat(_SOURCE)
+def _lib_path(source: str) -> str:
+    src_stat = os.stat(source)
     tag = f"{src_stat.st_mtime_ns}-{src_stat.st_size}"
-    return os.path.join(
-        os.path.dirname(_SOURCE), f"_offheap_store-{tag}.so"
-    )
+    stem = os.path.splitext(os.path.basename(source))[0]
+    return os.path.join(_DIR, f"_{stem}-{tag}.so")
 
 
-def _compile(out_path: str) -> None:
+def _compile(source: str, out_path: str) -> None:
     gxx = shutil.which("g++") or shutil.which("c++")
     if gxx is None:
         raise RuntimeError("no C++ compiler found")
@@ -41,7 +41,7 @@ def _compile(out_path: str) -> None:
     os.close(fd)
     try:
         subprocess.run(
-            [gxx, "-O2", "-std=c++17", "-shared", "-fPIC", _SOURCE, "-o", tmp],
+            [gxx, "-O2", "-std=c++17", "-shared", "-fPIC", source, "-o", tmp],
             check=True,
             capture_output=True,
             text=True,
@@ -56,52 +56,103 @@ def _compile(out_path: str) -> None:
         raise
 
 
-def load_offheap_library() -> ctypes.CDLL:
-    """Load (compiling if needed) the native library; raises on failure."""
-    global _LIB, _LOAD_FAILED
+def load_native_library(
+    source_basename: str, configure: Callable[[ctypes.CDLL], None]
+) -> ctypes.CDLL:
+    """Load (compiling if needed) a native library; raises on failure.
+
+    ``configure`` sets restype/argtypes on the freshly loaded CDLL; it runs
+    once per process per library.
+    """
+    source = os.path.join(_DIR, source_basename)
     with _LOCK:
-        if _LIB is not None:
-            return _LIB
-        if _LOAD_FAILED:
-            raise RuntimeError("native off-heap library previously failed to load")
+        if source_basename in _LIBS:
+            return _LIBS[source_basename]
+        if source_basename in _FAILED:
+            raise RuntimeError(
+                f"native library {source_basename} previously failed to load"
+            )
         try:
-            path = _lib_path()
+            path = _lib_path(source)
             if not os.path.exists(path):
-                logger.info("compiling native off-heap store library")
-                _compile(path)
+                logger.info("compiling native library %s", source_basename)
+                _compile(source, path)
             lib = ctypes.CDLL(path)
-            lib.om_build.restype = ctypes.c_int64
-            lib.om_build.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_char_p,
-                ctypes.POINTER(ctypes.c_uint64),
-                ctypes.c_uint64,
-            ]
-            lib.om_open.restype = ctypes.c_void_p
-            lib.om_open.argtypes = [ctypes.c_char_p]
-            lib.om_close.restype = None
-            lib.om_close.argtypes = [ctypes.c_void_p]
-            lib.om_size.restype = ctypes.c_int64
-            lib.om_size.argtypes = [ctypes.c_void_p]
-            lib.om_get.restype = ctypes.c_int64
-            lib.om_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
-            lib.om_key_at.restype = ctypes.c_int64
-            lib.om_key_at.argtypes = [
-                ctypes.c_void_p,
-                ctypes.c_uint64,
-                ctypes.c_char_p,
-                ctypes.c_uint64,
-            ]
-            _LIB = lib
+            configure(lib)
+            _LIBS[source_basename] = lib
             return lib
         except Exception:
-            _LOAD_FAILED = True
+            _FAILED.add(source_basename)
             raise
+
+
+def _configure_offheap(lib: ctypes.CDLL) -> None:
+    lib.om_build.restype = ctypes.c_int64
+    lib.om_build.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64,
+    ]
+    lib.om_open.restype = ctypes.c_void_p
+    lib.om_open.argtypes = [ctypes.c_char_p]
+    lib.om_close.restype = None
+    lib.om_close.argtypes = [ctypes.c_void_p]
+    lib.om_size.restype = ctypes.c_int64
+    lib.om_size.argtypes = [ctypes.c_void_p]
+    lib.om_get.restype = ctypes.c_int64
+    lib.om_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.om_key_at.restype = ctypes.c_int64
+    lib.om_key_at.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+    ]
+
+
+def load_offheap_library() -> ctypes.CDLL:
+    return load_native_library("offheap_store.cpp", _configure_offheap)
 
 
 def native_available() -> bool:
     try:
         load_offheap_library()
+        return True
+    except Exception:
+        return False
+
+
+def _configure_libsvm(lib: ctypes.CDLL) -> None:
+    lib.lsvm_parse.restype = ctypes.c_void_p
+    lib.lsvm_parse.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+    ]
+    for fn in (lib.lsvm_num_rows, lib.lsvm_nnz, lib.lsvm_max_index):
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [ctypes.c_void_p]
+    lib.lsvm_export.restype = None
+    lib.lsvm_export.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.lsvm_free.restype = None
+    lib.lsvm_free.argtypes = [ctypes.c_void_p]
+
+
+def load_libsvm_library() -> ctypes.CDLL:
+    return load_native_library("libsvm_loader.cpp", _configure_libsvm)
+
+
+def libsvm_native_available() -> bool:
+    try:
+        load_libsvm_library()
         return True
     except Exception:
         return False

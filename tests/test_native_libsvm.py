@@ -1,0 +1,172 @@
+"""Native C++ LibSVM parser tests: native/Python parity, CSR semantics, and
+read_merged fast-path equivalence with the record-dict path."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.data_reader import (
+    FeatureShardConfiguration,
+    build_index_maps,
+    read_libsvm,
+    read_merged,
+    records_to_game_dataset,
+)
+from photon_ml_tpu.io.libsvm_native import (
+    concat_libsvm,
+    parse_libsvm,
+    _parse_python,
+)
+from photon_ml_tpu.native.build import libsvm_native_available
+
+A1A_SNIPPET = """\
+# comment line
+-1 3:1 11:1 14:1 19:1 39:1
++1 5:0.5 7:2.25 11:1
+
+-1 1:1 2:1 40:0.125  # trailing comment
+2.5 4:1
+"""
+
+
+@pytest.fixture
+def svm_file(tmp_path):
+    p = tmp_path / "data.libsvm"
+    p.write_text(A1A_SNIPPET)
+    return p
+
+
+def test_native_toolchain_present():
+    """The image ships g++; the native parser must actually build."""
+    assert libsvm_native_available()
+
+
+def test_parse_basic(svm_file):
+    d = parse_libsvm(svm_file)
+    assert d.num_rows == 4
+    assert d.nnz == 5 + 3 + 3 + 1
+    np.testing.assert_array_equal(d.labels, [-1.0, 1.0, -1.0, 2.5])
+    # 1-based file indices stored 0-based
+    np.testing.assert_array_equal(d.cols[:5], [2, 10, 13, 18, 38])
+    np.testing.assert_array_equal(d.row_offsets, [0, 5, 8, 11, 12])
+    assert d.max_index == 39
+
+
+def test_native_matches_python(svm_file):
+    nat = parse_libsvm(svm_file)
+    py = _parse_python(str(svm_file), zero_based=False)
+    np.testing.assert_array_equal(nat.labels, py.labels)
+    np.testing.assert_array_equal(nat.row_offsets, py.row_offsets)
+    np.testing.assert_array_equal(nat.cols, py.cols)
+    np.testing.assert_array_equal(nat.vals, py.vals)
+
+
+def test_mapped_labels():
+    data = _make_data([-1.0, 1.0, 2.5, 0.0])
+    np.testing.assert_array_equal(data.mapped_labels(), [0.0, 1.0, 2.5, 0.0])
+
+
+def _make_data(labels):
+    from photon_ml_tpu.io.libsvm_native import LibSVMData
+
+    n = len(labels)
+    return LibSVMData(
+        labels=np.asarray(labels, dtype=np.float64),
+        row_offsets=np.arange(n + 1, dtype=np.uint64),
+        cols=np.zeros(n, dtype=np.uint32),
+        vals=np.ones(n, dtype=np.float64),
+    )
+
+
+def test_to_dense_accumulates_duplicates(tmp_path):
+    p = tmp_path / "dup.libsvm"
+    p.write_text("1 1:2 1:3 2:1\n")
+    x = parse_libsvm(p).to_dense()
+    np.testing.assert_array_equal(x, [[5.0, 1.0]])
+
+
+def test_zero_based(tmp_path):
+    p = tmp_path / "zb.libsvm"
+    p.write_text("1 0:1 3:2\n")
+    d = parse_libsvm(p, zero_based=True)
+    np.testing.assert_array_equal(d.cols, [0, 3])
+    with pytest.raises(ValueError, match="out of range|parse failed"):
+        parse_libsvm(p)  # 1-based: index 0 becomes -1
+
+
+def test_dangling_token_does_not_steal_next_line(tmp_path):
+    """A dangling 'idx:' token must error, not silently parse the next
+    line's label as its value (strtod skips whitespace incl. newlines)."""
+    p = tmp_path / "dangling.libsvm"
+    p.write_text("1 5:\n2 3:4\n")
+    with pytest.raises(ValueError):
+        parse_libsvm(p)
+    with pytest.raises(ValueError):
+        parse_libsvm(p, force_python=True)
+
+
+def test_denormal_and_overflow_values_parse(tmp_path):
+    """Parity with Python float(): denormals parse, overflow gives inf."""
+    p = tmp_path / "denorm.libsvm"
+    p.write_text("1 1:1e-310 2:1e400\n-1e400 1:1\n")
+    for force_python in (False, True):
+        d = parse_libsvm(p, force_python=force_python)
+        assert d.vals[0] == pytest.approx(1e-310)
+        assert np.isposinf(d.vals[1])
+        assert np.isneginf(d.labels[1])
+
+
+def test_malformed_raises(tmp_path):
+    for bad in ("1 nocolon\n", "notalabel 1:1\n", "1 5:xyz\n"):
+        p = tmp_path / "bad.libsvm"
+        p.write_text(bad)
+        with pytest.raises(ValueError):
+            parse_libsvm(p)
+        with pytest.raises(ValueError):
+            parse_libsvm(p, force_python=True)
+
+
+def test_concat_multiple_files(tmp_path):
+    p1 = tmp_path / "a.libsvm"
+    p1.write_text("1 1:1\n-1 2:2\n")
+    p2 = tmp_path / "b.libsvm"
+    p2.write_text("1 3:3\n")
+    d = concat_libsvm([parse_libsvm(p1), parse_libsvm(p2)])
+    assert d.num_rows == 3 and d.nnz == 3
+    np.testing.assert_array_equal(d.row_offsets, [0, 1, 2, 3])
+    np.testing.assert_array_equal(d.cols, [0, 1, 2])
+
+
+def test_read_merged_fast_path_matches_record_path(svm_file):
+    shard_cfgs = {
+        "g": FeatureShardConfiguration(feature_bags=("features",), has_intercept=True)
+    }
+    fast = read_merged(svm_file, shard_cfgs, fmt="libsvm", dtype=np.float64)
+
+    records = list(read_libsvm(svm_file))
+    imaps = build_index_maps(records, shard_cfgs)
+    slow = records_to_game_dataset(records, shard_cfgs, imaps, dtype=np.float64)
+
+    assert fast.index_maps["g"].size == slow.index_maps["g"].size
+    np.testing.assert_array_equal(
+        np.asarray(fast.dataset.labels), np.asarray(slow.dataset.labels)
+    )
+    # same column order: both index maps sort the same key set
+    np.testing.assert_allclose(
+        np.asarray(fast.dataset.feature_shards["g"]),
+        np.asarray(slow.dataset.feature_shards["g"]),
+    )
+    assert fast.intercept_indices == slow.intercept_indices
+
+
+def test_read_merged_fast_path_with_existing_index_map(svm_file):
+    shard_cfgs = {
+        "g": FeatureShardConfiguration(feature_bags=("features",), has_intercept=False)
+    }
+    first = read_merged(svm_file, shard_cfgs, fmt="libsvm")
+    again = read_merged(
+        svm_file, shard_cfgs, index_maps=first.index_maps, fmt="libsvm"
+    )
+    np.testing.assert_allclose(
+        np.asarray(first.dataset.feature_shards["g"]),
+        np.asarray(again.dataset.feature_shards["g"]),
+    )
