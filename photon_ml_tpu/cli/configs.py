@@ -101,6 +101,7 @@ class CoordinateCliConfig:
     active_data_upper_bound: int | None = None
     projector: ProjectorType = ProjectorType.IDENTITY
     projected_dim: int | None = None
+    features_to_samples_ratio: float | None = None
     # matrix-factorization only (feature_shard is unused: the "features" of
     # an MF coordinate are the other side's latent factors)
     mf_row_effect_type: str | None = None
@@ -150,6 +151,7 @@ class CoordinateCliConfig:
                 active_data_upper_bound=self.active_data_upper_bound,
                 projector_type=self.projector,
                 projected_dim=self.projected_dim,
+                features_to_samples_ratio=self.features_to_samples_ratio,
             )
         return FixedEffectCoordinateConfig(
             feature_shard_id=self.feature_shard,
@@ -199,6 +201,9 @@ def parse_coordinate_config(spec: str) -> CoordinateCliConfig:
         ),
         projector=ProjectorType(pop("projector", "IDENTITY").upper()),
         projected_dim=(int(v) if (v := pop("projected.dim")) else None),
+        features_to_samples_ratio=(
+            float(v) if (v := pop("features.to.samples.ratio")) else None
+        ),
         mf_row_effect_type=pop("mf.row.effect.type"),
         mf_col_effect_type=pop("mf.col.effect.type"),
         mf_latent_factors=int(pop("mf.latent.factors", "0")),
@@ -219,6 +224,11 @@ def parse_coordinate_config(spec: str) -> CoordinateCliConfig:
             f"coordinate {name!r} sets {mf_keys_given} but a matrix-"
             "factorization coordinate requires all of mf.row.effect.type, "
             "mf.col.effect.type, and mf.latent.factors > 0"
+        )
+    if cfg.features_to_samples_ratio is not None and not cfg.is_random_effect:
+        raise ValueError(
+            f"coordinate {name!r}: features.to.samples.ratio is per-entity "
+            "Pearson selection and only applies to random-effect coordinates"
         )
     if cfg.is_matrix_factorization and cfg.is_random_effect:
         raise ValueError(

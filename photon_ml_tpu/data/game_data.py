@@ -200,6 +200,37 @@ def group_entities_into_buckets(
     return per_bucket
 
 
+def _pearson_keep_mask(x: np.ndarray, y: np.ndarray, num_keep: int) -> np.ndarray:
+    """Boolean [d] mask of the ``num_keep`` columns of x most correlated
+    (|Pearson|) with y. Zero-variance columns (e.g. an intercept) score +inf
+    and are always retained — the reference's LocalDataSet Pearson filter
+    assigns the intercept a perfect score (LocalDataSet.scala:221-280)."""
+    d = x.shape[1]
+    if num_keep >= d:
+        return np.ones(d, dtype=bool)
+    xc = x - x.mean(axis=0)
+    yc = y - y.mean()
+    var_x = (xc * xc).sum(axis=0)
+    var_y = float(yc @ yc)
+    all_zero = ~np.any(x != 0.0, axis=0)
+    const_nonzero = (var_x == 0.0) & ~all_zero  # intercept-like
+    if var_y == 0.0:
+        # constant labels carry no correlation signal; prefer active,
+        # high-variance columns rather than degenerating to first-K-by-index
+        score = var_x.astype(np.float64)
+    else:
+        denom = np.sqrt(var_x * var_y)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            score = np.abs(xc.T @ yc) / denom
+        score = np.where(var_x == 0.0, 0.0, score)
+    score = np.where(const_nonzero, np.inf, score)  # intercept always kept
+    score = np.where(all_zero, -np.inf, score)  # inactive columns rank last
+    keep = np.argsort(-score, kind="stable")[:num_keep]
+    mask = np.zeros(d, dtype=bool)
+    mask[keep] = True
+    return mask
+
+
 def build_random_effect_dataset(
     dataset: GameDataset,
     re_type: str,
@@ -211,6 +242,7 @@ def build_random_effect_dataset(
     seed: int = 0,
     projector_type: ProjectorType = ProjectorType.IDENTITY,
     projected_dim: int | None = None,
+    features_to_samples_ratio: float | None = None,
 ) -> RandomEffectDataset:
     """Group samples by entity into padded, size-bucketed blocks.
 
@@ -224,6 +256,13 @@ def build_random_effect_dataset(
     - projector (reference projector/*.scala): INDEX_MAP bakes per-entity
       active-column gathers into the buckets; RANDOM applies one shared
       Gaussian [dim, projected_dim] matrix.
+    - features_to_samples_ratio: per-entity Pearson feature selection
+      (reference RandomEffectDataSetPartitioner's
+      numFeaturesToSamplesRatioUpperBound + LocalDataSet Pearson filter,
+      LocalDataSet.scala:221-280): an entity with c samples keeps only its
+      ceil(ratio * c) best features by |Pearson corr| with the label;
+      dropped columns are zeroed in its block (and therefore excluded from
+      INDEX_MAP active columns).
     """
     entity_idx = np.asarray(dataset.entity_idx[re_type])
     features = np.asarray(dataset.feature_shards[shard_id])
@@ -249,18 +288,35 @@ def build_random_effect_dataset(
         seed=seed,
     )
 
+    if features_to_samples_ratio is not None and projector_type == ProjectorType.RANDOM:
+        raise ValueError(
+            "features_to_samples_ratio (Pearson selection) operates on "
+            "original feature columns and cannot combine with RANDOM "
+            "projection; use IDENTITY or INDEX_MAP"
+        )
+
+    def entity_feature_block(sample_rows: np.ndarray) -> np.ndarray:
+        """This entity's [c, d] block, with Pearson-dropped columns zeroed."""
+        block = features[sample_rows]
+        if features_to_samples_ratio is not None:
+            num_keep = max(
+                1, int(np.ceil(features_to_samples_ratio * len(sample_rows)))
+            )
+            block = block * _pearson_keep_mask(
+                block, labels[sample_rows], num_keep
+            )
+        return block
+
     index_projected = projector_type == ProjectorType.INDEX_MAP
     buckets: list[EntityBucket] = []
     for cap, members in per_bucket.items():
         if not members:
             continue
         e = len(members)
+        blocks = [entity_feature_block(sample_rows) for _, sample_rows in members]
         entity_cols: list[np.ndarray] | None = None
         if index_projected:
-            entity_cols = [
-                entity_active_columns(features[sample_rows])
-                for _, sample_rows in members
-            ]
+            entity_cols = [entity_active_columns(b) for b in blocks]
             bdim = max(len(c) for c in entity_cols)
         else:
             bdim = features.shape[1]
@@ -274,10 +330,10 @@ def build_random_effect_dataset(
             k = len(sample_rows)
             if index_projected:
                 cols = entity_cols[i]
-                bf[i, :k, : len(cols)] = features[np.ix_(sample_rows, cols)]
+                bf[i, :k, : len(cols)] = blocks[i][:, cols]
                 bc[i, : len(cols)] = cols
             else:
-                bf[i, :k] = features[sample_rows]
+                bf[i, :k] = blocks[i]
             bl[i, :k] = labels[sample_rows]
             bw[i, :k] = weights[sample_rows]
             be[i] = entity

@@ -85,6 +85,10 @@ class RandomEffectCoordinateConfig:
     #: on its observed feature support; RANDOM on a shared Gaussian sketch
     projector_type: ProjectorType = ProjectorType.IDENTITY
     projected_dim: int | None = None  # RANDOM only
+    #: per-entity Pearson feature selection: an entity with c samples keeps
+    #: its ceil(ratio*c) best features (reference
+    #: numFeaturesToSamplesRatioUpperBound, LocalDataSet.scala:221-280)
+    features_to_samples_ratio: float | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -194,6 +198,7 @@ class GameEstimator:
                     active_data_lower_bound=cfg.active_data_lower_bound,
                     projector_type=cfg.projector_type,
                     projected_dim=cfg.projected_dim,
+                    features_to_samples_ratio=cfg.features_to_samples_ratio,
                 )
                 coordinates[cid] = RandomEffectCoordinate(
                     coordinate_id=cid,

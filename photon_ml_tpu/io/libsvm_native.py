@@ -138,6 +138,8 @@ def parse_libsvm(
 ) -> LibSVMData:
     """Parse one LibSVM file to CSR (native C++ when available)."""
     path = str(path)
+    if os.path.isdir(path):
+        raise IsADirectoryError(f"expected a LibSVM file, got directory: {path}")
     if not force_python and libsvm_native_available():
         return _parse_native(path, zero_based)
     return _parse_python(path, zero_based)

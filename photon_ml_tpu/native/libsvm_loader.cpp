@@ -115,15 +115,22 @@ void set_err(char* err, uint64_t err_cap, const std::string& msg) {
 extern "C" {
 
 void* lsvm_parse(const char* path, int zero_based, char* err,
-                 uint64_t err_cap) {
+                 uint64_t err_cap) try {
+  // No exception may cross this extern "C" boundary: bad_alloc /
+  // length_error (directory paths make ftell report LONG_MAX) must become
+  // error returns, not std::terminate of the host interpreter.
   FILE* f = fopen(path, "rb");
   if (f == nullptr) {
     set_err(err, err_cap, std::string("cannot open ") + path);
     return nullptr;
   }
-  fseek(f, 0, SEEK_END);
-  long fsize = ftell(f);
-  fseek(f, 0, SEEK_SET);
+  long fsize = -1;
+  if (fseek(f, 0, SEEK_END) == 0) fsize = ftell(f);
+  if (fsize < 0 || fseek(f, 0, SEEK_SET) != 0) {
+    fclose(f);
+    set_err(err, err_cap, std::string("cannot stat ") + path);
+    return nullptr;
+  }
   std::string buf;
   buf.resize(static_cast<size_t>(fsize));
   size_t got = fsize > 0 ? fread(&buf[0], 1, buf.size(), f) : 0;
@@ -140,6 +147,12 @@ void* lsvm_parse(const char* path, int zero_based, char* err,
     return nullptr;
   }
   return parsed;
+} catch (const std::exception& e) {
+  set_err(err, err_cap, std::string("parse error: ") + e.what());
+  return nullptr;
+} catch (...) {
+  set_err(err, err_cap, "parse error: unknown exception");
+  return nullptr;
 }
 
 int64_t lsvm_num_rows(void* h) {

@@ -298,3 +298,109 @@ def test_train_glm_elastic_net_sparsity(rng):
     )
     w = np.asarray(models[5.0].coefficients.means)
     assert np.sum(np.abs(w) > 1e-10) < 10  # some coefficients driven to zero
+
+
+class TestPearsonFeatureSelection:
+    """Per-entity Pearson selection (reference LocalDataSet.scala:221-280,
+    numFeaturesToSamplesRatioUpperBound)."""
+
+    def test_mask_picks_correlated_columns(self, rng):
+        from photon_ml_tpu.data.game_data import _pearson_keep_mask
+
+        n, d = 60, 6
+        x = rng.normal(size=(n, d))
+        y = 3.0 * x[:, 1] - 2.0 * x[:, 4] + 0.01 * rng.normal(size=n)
+        mask = _pearson_keep_mask(x, y, 2)
+        assert mask.sum() == 2
+        assert mask[1] and mask[4]
+
+    def test_zero_variance_column_always_kept(self, rng):
+        from photon_ml_tpu.data.game_data import _pearson_keep_mask
+
+        n, d = 40, 5
+        x = rng.normal(size=(n, d))
+        x[:, 2] = 1.0  # intercept-like
+        y = x[:, 0] + 0.01 * rng.normal(size=n)
+        mask = _pearson_keep_mask(x, y, 2)
+        assert mask[2], "zero-variance (intercept) column must be retained"
+
+    def test_ratio_zeroes_dropped_columns_in_buckets(self, rng):
+        n, d = 120, 8
+        x = rng.normal(size=(n, d))
+        ents = np.array([f"e{i % 4}" for i in range(n)])
+        y = x[:, 0] + 0.05 * rng.normal(size=n)
+        ds = build_game_dataset(
+            labels=y, feature_shards={"s": x}, entity_keys={"re": ents},
+            dtype=np.float64,
+        )
+        # each entity has 30 samples; ratio 0.1 -> keep ceil(3) features
+        red = build_random_effect_dataset(
+            ds, "re", "s", features_to_samples_ratio=0.1
+        )
+        for b in red.buckets:
+            f = np.asarray(b.features)
+            nonzero_cols = (np.abs(f) > 0).any(axis=1).sum(axis=1)
+            assert np.all(nonzero_cols <= 3)
+        # without selection every column is populated
+        full = build_random_effect_dataset(ds, "re", "s")
+        f = np.asarray(full.buckets[0].features)
+        assert (np.abs(f) > 0).any(axis=1).all()
+
+    def test_ratio_rejected_with_random_projection(self, rng):
+        from photon_ml_tpu.projector.projectors import ProjectorType
+
+        x = rng.normal(size=(40, 6))
+        ds = build_game_dataset(
+            labels=rng.normal(size=40),
+            feature_shards={"s": x},
+            entity_keys={"re": np.array(["a"] * 40)},
+            dtype=np.float64,
+        )
+        with pytest.raises(ValueError, match="RANDOM"):
+            build_random_effect_dataset(
+                ds, "re", "s",
+                projector_type=ProjectorType.RANDOM, projected_dim=3,
+                features_to_samples_ratio=0.5,
+            )
+
+    def test_cli_key_parses(self):
+        from photon_ml_tpu.cli.configs import parse_coordinate_config
+
+        cfg = parse_coordinate_config(
+            "name=ru,feature.shard=s,random.effect.type=re,"
+            "features.to.samples.ratio=0.25"
+        )
+        assert cfg.features_to_samples_ratio == 0.25
+        assert cfg.estimator_config(0.0).features_to_samples_ratio == 0.25
+
+    def test_sparse_entity_block_keeps_active_columns(self, rng):
+        from photon_ml_tpu.data.game_data import _pearson_keep_mask
+
+        # only cols 10-14 are active; inactive zero columns must rank LAST
+        n, d = 30, 20
+        x = np.zeros((n, d))
+        x[:, 10:15] = rng.normal(size=(n, 5))
+        y = x[:, 12] + 0.01 * rng.normal(size=n)
+        mask = _pearson_keep_mask(x, y, 3)
+        assert mask.sum() == 3
+        assert not mask[:10].any() and not mask[15:].any()
+        assert mask[12]
+
+    def test_constant_labels_prefer_active_columns(self, rng):
+        from photon_ml_tpu.data.game_data import _pearson_keep_mask
+
+        n, d = 20, 6
+        x = np.zeros((n, d))
+        x[:, 3] = rng.normal(size=n)
+        x[:, 5] = 1.0  # intercept
+        y = np.ones(n)  # constant labels: no correlation signal
+        mask = _pearson_keep_mask(x, y, 2)
+        assert mask[3] and mask[5]
+
+    def test_ratio_on_fixed_effect_spec_rejected(self):
+        from photon_ml_tpu.cli.configs import parse_coordinate_config
+
+        with pytest.raises(ValueError, match="random-effect"):
+            parse_coordinate_config(
+                "name=fe,feature.shard=g,features.to.samples.ratio=0.1"
+            )

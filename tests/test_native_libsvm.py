@@ -170,3 +170,11 @@ def test_read_merged_fast_path_with_existing_index_map(svm_file):
         np.asarray(first.dataset.feature_shards["g"]),
         np.asarray(again.dataset.feature_shards["g"]),
     )
+
+
+def test_directory_path_raises_cleanly(tmp_path):
+    """A directory path must raise, not std::terminate the interpreter."""
+    with pytest.raises((IsADirectoryError, ValueError)):
+        parse_libsvm(tmp_path)
+    with pytest.raises((IsADirectoryError, ValueError)):
+        parse_libsvm(tmp_path, force_python=True)
