@@ -1,14 +1,28 @@
-"""Benchmark: jitted L-BFGS logistic regression throughput on one chip.
+"""Benchmark: vmapped λ-grid logistic-regression training on one chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Workload: the reference's hot loop (SURVEY.md §3.4) — L-BFGS iterations over
-a dense [n, d] logistic-regression batch, the TPU analogue of
-DistributedGLMLossFunction.calculate -> ValueAndGradientAggregator
-.treeAggregate. ``vs_baseline`` is the measured speedup over the same solve
-run by scipy's Fortran L-BFGS-B on the host CPU — a stand-in for the
-reference's single-executor Breeze/JVM path (the reference repo itself
-publishes no benchmark numbers, see BASELINE.md).
+Workload: the reference's hot loop (SURVEY.md §3.4) folded over a
+32-point regularization grid — the λ-grid expansion of GameTrainingDriver
+(:612-621) that the Spark reference trains sequentially, one L-BFGS run per
+λ. Here the whole grid trains *simultaneously* (photon_ml_tpu
+train_glm_grid): vmapped L-BFGS lanes share every read of the [n, d]
+feature block, so per-lane margins become one X @ W matmul on the MXU, and
+measured wall-clock is nearly flat in the number of lanes (extra λs are
+almost free). ``vs_baseline`` is the measured speedup over scipy's Fortran
+L-BFGS-B solving the same grid sequentially on the host CPU (stand-in for
+the reference's single-executor Breeze/JVM path; the reference publishes no
+benchmark numbers, see BASELINE.md).
+
+Measurement notes (tunneled/remote TPU backends):
+- The whole grid is ONE jit call, timed end-to-end (min of 3 reps) with a
+  host read as the synchronization point — block_until_ready alone does not
+  synchronize on all remote platforms, and per-call tunnel latency (~80 ms
+  here) is honestly included in the reported wall-clock.
+- Each rep perturbs the warm starts from a fresh PRNG seed so no two
+  executions are identical (some backends cache repeat executions).
+- The CPU baseline runs on an n/8 subsample and is scaled linearly (per-λ
+  cost is linear in n at fixed d and iteration count).
 """
 
 from __future__ import annotations
@@ -17,6 +31,9 @@ import json
 import time
 
 import numpy as np
+
+N, D, MAX_ITER, GRID = 1 << 18, 512, 30, 32
+CPU_SUBSAMPLE = 1 << 15
 
 
 def _make_data(n: int, d: int, seed: int = 0):
@@ -28,9 +45,12 @@ def _make_data(n: int, d: int, seed: int = 0):
     return x, y
 
 
-def bench_tpu(x, y, max_iter: int) -> tuple[float, int]:
-    import functools
+def _grid(k: int) -> np.ndarray:
+    return np.logspace(-2, 2, k)
 
+
+def bench_tpu(x, y) -> tuple[float, int]:
+    """Returns (grid_wall_clock_sec, total_lane_iters) for one 32-λ grid."""
     import jax
     import jax.numpy as jnp
 
@@ -39,65 +59,83 @@ def bench_tpu(x, y, max_iter: int) -> tuple[float, int]:
     from photon_ml_tpu.ops.objective import GLMObjective
     from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
 
-    # Batch enters as a jit ARGUMENT (device-resident), never a closure
-    # constant — closing over it would bake the [n, d] block into the HLO as
-    # a literal, ballooning compile time.
+    n, d = x.shape
     batch = LabeledPointBatch.create(jax.device_put(x), jax.device_put(y))
-    objective = GLMObjective(LogisticLoss(), l2_weight=1.0)
+    objective = GLMObjective(LogisticLoss(), l2_weight=0.0)
 
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def run(max_iter, batch, w0):
-        return minimize_lbfgs(
-            objective.bind(batch).value_and_grad, w0,
-            max_iter=max_iter, tolerance=0.0,
-        )
+    # The same vmapped-lane program train_glm_grid compiles, inlined so the
+    # bench can read per-lane iteration counts and sync on a scalar.
+    @jax.jit
+    def run_grid(b, l2v, seed):
+        bound = objective.bind(b)
 
-    w0 = jnp.zeros((x.shape[1],), dtype=jnp.float32)
-    result = jax.block_until_ready(run(max_iter, batch, w0))  # compile + warm up
-    t0 = time.perf_counter()
-    result = jax.block_until_ready(run(max_iter, batch, w0))
-    elapsed = time.perf_counter() - t0
-    return elapsed, int(result.iterations)
+        def solve_one(l2, key):
+            def vg(w):
+                v, g = bound.value_and_grad(w)
+                return v + 0.5 * l2 * jnp.vdot(w, w), g + l2 * w
+
+            w0 = 1e-4 * jax.random.normal(key, (d,), jnp.float32)
+            return minimize_lbfgs(vg, w0, max_iter=MAX_ITER, tolerance=0.0)
+
+        keys = jax.random.split(jax.random.PRNGKey(seed), l2v.shape[0])
+        rs = jax.vmap(solve_one)(l2v, keys)
+        return rs.iterations.sum(), rs.value.sum()
+
+    l2v = jnp.asarray(_grid(GRID), jnp.float32)
+    float(run_grid(batch, l2v, 0)[1])  # compile + sync
+    best = None
+    for rep in range(3):
+        t0 = time.perf_counter()
+        iters, checksum = run_grid(batch, l2v, rep + 1)
+        iters = int(iters)
+        float(checksum)  # host read: hard sync
+        elapsed = time.perf_counter() - t0
+        if best is None or elapsed < best[0]:
+            best = (elapsed, iters)
+    return best
 
 
-def bench_cpu_scipy(x, y, max_iter: int) -> tuple[float, int]:
+def bench_cpu_scipy(x, y) -> float:
+    """Wall-clock for scipy L-BFGS-B over the same λ grid, sequential,
+    scaled from the subsample to full N."""
     from scipy.optimize import minimize
 
     x64, y64 = x.astype(np.float64), y.astype(np.float64)
 
-    def f(w):
-        m = x64 @ w
-        # logistic loss + grad, numerically stable
-        val = np.sum(np.logaddexp(0.0, m) - y64 * m) + 0.5 * np.dot(w, w)
-        p = 1.0 / (1.0 + np.exp(-m))
-        g = x64.T @ (p - y64) + w
-        return val, g
+    def run_one(lam: float) -> None:
+        def f(w):
+            m = x64 @ w
+            val = np.sum(np.logaddexp(0.0, m) - y64 * m) + 0.5 * lam * np.dot(w, w)
+            p = 1.0 / (1.0 + np.exp(-m))
+            g = x64.T @ (p - y64) + lam * w
+            return val, g
 
-    w0 = np.zeros(x.shape[1])
+        minimize(f, np.zeros(x.shape[1]), jac=True, method="L-BFGS-B",
+                 options={"maxiter": MAX_ITER, "ftol": 0.0, "gtol": 0.0})
+
     t0 = time.perf_counter()
-    res = minimize(f, w0, jac=True, method="L-BFGS-B",
-                   options={"maxiter": max_iter, "ftol": 0.0, "gtol": 0.0})
+    for lam in _grid(GRID):
+        run_one(lam)
     elapsed = time.perf_counter() - t0
-    return elapsed, int(res.nit)
+    return elapsed * (N / len(x64))
 
 
 def main():
-    n, d, max_iter = 1 << 18, 512, 30
-    x, y = _make_data(n, d)
+    x, y = _make_data(N, D)
 
-    tpu_time, tpu_iters = bench_tpu(x, y, max_iter)
-    tpu_rate = n * max(tpu_iters, 1) / tpu_time
+    tpu_time, lane_iters = bench_tpu(x, y)
+    cpu_time = bench_cpu_scipy(x[:CPU_SUBSAMPLE], y[:CPU_SUBSAMPLE])
 
-    # CPU baseline on a subsample (same per-example cost; keeps bench fast)
-    n_cpu = min(n, 1 << 15)
-    cpu_time, cpu_iters = bench_cpu_scipy(x[:n_cpu], y[:n_cpu], max_iter)
-    cpu_rate = n_cpu * max(cpu_iters, 1) / cpu_time
-
+    rate = N * lane_iters / tpu_time
     print(json.dumps({
-        "metric": "glm_lbfgs_examples_per_sec",
-        "value": round(tpu_rate, 1),
-        "unit": "examples/sec (n=262144, d=512, 30 L-BFGS iters, logistic)",
-        "vs_baseline": round(tpu_rate / cpu_rate, 2),
+        "metric": "glm_lambda_grid_example_iters_per_sec",
+        "value": round(rate, 1),
+        "unit": (
+            f"examples x L-BFGS-iters/sec over a {GRID}-lane vmapped "
+            f"lambda grid (n={N}, d={D}, logistic, {lane_iters} lane-iters "
+            f"in {tpu_time:.3f}s incl. dispatch latency)"
+        ),
+        "vs_baseline": round(cpu_time / tpu_time, 2),
     }))
 
 

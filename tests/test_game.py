@@ -404,3 +404,91 @@ class TestPearsonFeatureSelection:
             parse_coordinate_config(
                 "name=fe,feature.shard=g,features.to.samples.ratio=0.1"
             )
+
+
+class TestTrainGlmGrid:
+    """Vmapped λ-grid trainer: every lane must match the sequential path's
+    solution for the same λ (cold starts converge to the same optimum on a
+    convex problem)."""
+
+    def test_grid_matches_sequential_l2(self, rng):
+        from tests.conftest import make_classification
+        from photon_ml_tpu.estimators import train_glm, train_glm_grid
+
+        x, y, _ = make_classification(rng, n=300, d=8)
+        batch = LabeledPointBatch.create(x, y)
+        lams = [0.1, 1.0, 10.0]
+        grid = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION,
+                              regularization_weights=lams)
+        seq = train_glm(batch, TaskType.LOGISTIC_REGRESSION,
+                        regularization_weights=lams)
+        for lam in lams:
+            np.testing.assert_allclose(
+                np.asarray(grid[lam].coefficients.means),
+                np.asarray(seq[lam].coefficients.means),
+                atol=2e-4,
+            )
+
+    def test_grid_elastic_net_sparsity(self, rng):
+        from tests.conftest import make_classification
+        from photon_ml_tpu.estimators import train_glm_grid
+
+        x, y, _ = make_classification(rng, n=120, d=10)
+        batch = LabeledPointBatch.create(x, y)
+        grid = train_glm_grid(
+            batch, TaskType.LOGISTIC_REGRESSION,
+            regularization_weights=[0.01, 5.0], elastic_net_alpha=0.9,
+        )
+        w_small = np.asarray(grid[0.01].coefficients.means)
+        w_big = np.asarray(grid[5.0].coefficients.means)
+        assert np.sum(np.abs(w_big) > 1e-10) < np.sum(np.abs(w_small) > 1e-10)
+
+    def test_grid_variance_and_tron_rejected(self, rng):
+        from tests.conftest import make_regression
+        from photon_ml_tpu.estimators import train_glm_grid
+        from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+
+        x, y, _ = make_regression(rng, n=100, d=5)
+        batch = LabeledPointBatch.create(x, y)
+        grid = train_glm_grid(
+            batch, TaskType.LINEAR_REGRESSION,
+            regularization_weights=[1.0], compute_variance=True,
+        )
+        assert grid[1.0].coefficients.variances is not None
+        with pytest.raises(ValueError, match="TRON"):
+            train_glm_grid(
+                batch, TaskType.LINEAR_REGRESSION,
+                optimizer=OptimizerConfig(optimizer_type=OptimizerType.TRON),
+                regularization_weights=[1.0],
+            )
+
+    def test_grid_owlqn_respects_config_l1_and_history(self, rng):
+        from tests.conftest import make_classification
+        from photon_ml_tpu.estimators import train_glm, train_glm_grid
+        from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+
+        x, y, _ = make_classification(rng, n=150, d=8)
+        batch = LabeledPointBatch.create(x, y)
+        # explicit OWLQN with its own l1_weight, no elastic alpha: the grid
+        # must honor config.l1_weight like the sequential solve() does
+        opt = OptimizerConfig(
+            optimizer_type=OptimizerType.OWLQN, l1_weight=2.0, history=5
+        )
+        grid = train_glm_grid(
+            batch, TaskType.LOGISTIC_REGRESSION,
+            optimizer=opt, regularization_weights=[0.0],
+        )
+        seq = train_glm(
+            batch, TaskType.LOGISTIC_REGRESSION,
+            optimizer=opt, regularization_weights=[0.0],
+        )
+        w_grid = np.asarray(grid[0.0].coefficients.means)
+        w_seq = np.asarray(seq[0.0].coefficients.means)
+        np.testing.assert_allclose(w_grid, w_seq, atol=2e-3)
+        # and the L1 penalty actually shrank the solution vs pure L2
+        no_l1 = train_glm_grid(
+            batch, TaskType.LOGISTIC_REGRESSION, regularization_weights=[0.0]
+        )
+        assert np.linalg.norm(w_grid) < 0.9 * np.linalg.norm(
+            np.asarray(no_l1[0.0].coefficients.means)
+        )
