@@ -96,13 +96,16 @@ def bench_tpu(x, y) -> tuple[float, int]:
 
 
 def bench_cpu_scipy(x, y) -> float:
-    """Wall-clock for scipy L-BFGS-B over the same λ grid, sequential,
-    scaled from the subsample to full N."""
+    """scipy L-BFGS-B example-iters/sec over the same λ grid, sequential.
+    Iteration-normalized so vs_baseline compares per-unit-work throughput —
+    the two solvers terminate after different iteration counts (the TPU
+    lanes stop when line search stalls at the optimum; scipy honors
+    maxiter), and raw wall-clock would conflate that with hardware speed."""
     from scipy.optimize import minimize
 
     x64, y64 = x.astype(np.float64), y.astype(np.float64)
 
-    def run_one(lam: float) -> None:
+    def run_one(lam: float) -> int:
         def f(w):
             m = x64 @ w
             val = np.sum(np.logaddexp(0.0, m) - y64 * m) + 0.5 * lam * np.dot(w, w)
@@ -110,21 +113,21 @@ def bench_cpu_scipy(x, y) -> float:
             g = x64.T @ (p - y64) + lam * w
             return val, g
 
-        minimize(f, np.zeros(x.shape[1]), jac=True, method="L-BFGS-B",
-                 options={"maxiter": MAX_ITER, "ftol": 0.0, "gtol": 0.0})
+        res = minimize(f, np.zeros(x.shape[1]), jac=True, method="L-BFGS-B",
+                       options={"maxiter": MAX_ITER, "ftol": 0.0, "gtol": 0.0})
+        return max(int(res.nit), 1)
 
     t0 = time.perf_counter()
-    for lam in _grid(GRID):
-        run_one(lam)
+    total_iters = sum(run_one(lam) for lam in _grid(GRID))
     elapsed = time.perf_counter() - t0
-    return elapsed * (N / len(x64))
+    return len(x64) * total_iters / elapsed
 
 
 def main():
     x, y = _make_data(N, D)
 
     tpu_time, lane_iters = bench_tpu(x, y)
-    cpu_time = bench_cpu_scipy(x[:CPU_SUBSAMPLE], y[:CPU_SUBSAMPLE])
+    cpu_rate = bench_cpu_scipy(x[:CPU_SUBSAMPLE], y[:CPU_SUBSAMPLE])
 
     rate = N * lane_iters / tpu_time
     print(json.dumps({
@@ -133,9 +136,10 @@ def main():
         "unit": (
             f"examples x L-BFGS-iters/sec over a {GRID}-lane vmapped "
             f"lambda grid (n={N}, d={D}, logistic, {lane_iters} lane-iters "
-            f"in {tpu_time:.3f}s incl. dispatch latency)"
+            f"in {tpu_time:.3f}s incl. dispatch latency; vs_baseline is "
+            "iteration-normalized against scipy L-BFGS-B on the same grid)"
         ),
-        "vs_baseline": round(cpu_time / tpu_time, 2),
+        "vs_baseline": round(rate / cpu_rate, 2),
     }))
 
 

@@ -173,8 +173,7 @@ def build_mf_dataset(
     )
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _jitted_mf_side_solve(
+def solve_mf_side_bucket(
     objective: GLMObjective,
     opt: OptimizerConfig,
     labels: Array,        # [e, cap]
@@ -187,7 +186,11 @@ def _jitted_mf_side_solve(
     table: Array,           # [E_this, k] this side's factor table
 ) -> Array:
     """One alternating half-step over one bucket: gather the fixed side's
-    factors as features, vmap-solve every entity, scatter back."""
+    factors as features, vmap-solve every entity, scatter back.
+
+    Pure/traceable: reused by the single-chip jit wrapper below and by the
+    mesh-sharded fused GAME step (parallel/distributed.py), where the
+    entity axis shards over the mesh's "data" axis."""
     safe_rows = jnp.maximum(sample_rows, 0)
     oidx = other_idx_full[safe_rows]                       # [e, cap]
     feats = other_factors[jnp.maximum(oidx, 0)]            # [e, cap, k]
@@ -198,6 +201,25 @@ def _jitted_mf_side_solve(
         objective, opt, feats, labels, weights, offsets, table[entity_rows]
     )
     return table.at[entity_rows].set(solved)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _jitted_mf_side_solve(
+    objective: GLMObjective,
+    opt: OptimizerConfig,
+    labels: Array,
+    weights: Array,
+    entity_rows: Array,
+    sample_rows: Array,
+    other_idx_full: Array,
+    other_factors: Array,
+    full_offsets: Array,
+    table: Array,
+) -> Array:
+    return solve_mf_side_bucket(
+        objective, opt, labels, weights, entity_rows, sample_rows,
+        other_idx_full, other_factors, full_offsets, table,
+    )
 
 
 @dataclasses.dataclass

@@ -14,6 +14,7 @@ loop over sorted regularization weights with warm start.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 from typing import Mapping, Sequence
 
@@ -317,11 +318,6 @@ def train_glm_grid(
     (elastic net included); TRON's trust-region loop is per-lane scalar
     control flow and stays on the sequential path.
     """
-    import functools
-
-    from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
-    from photon_ml_tpu.optim.owlqn import minimize_owlqn
-
     optimizer = optimizer or OptimizerConfig()
     if optimizer.optimizer_type not in (
         OptimizerType.LBFGS, OptimizerType.OWLQN
@@ -348,42 +344,14 @@ def train_glm_grid(
     else:
         l1s = jnp.full((len(lams),), optimizer.l1_weight, dtype)
 
-    @functools.partial(jax.jit, static_argnums=(0, 1))
-    def run_grid(max_iter, tolerance, b, l2v, l1v):
-        bound = objective.bind(b)
-
-        def solve_one(l2, l1):
-            def vg(w):
-                v, g = bound.value_and_grad(w)
-                return v + 0.5 * l2 * jnp.vdot(w, w), g + l2 * w
-
-            w0 = jnp.zeros((b.dim,), dtype)
-            if use_owlqn:
-                return minimize_owlqn(
-                    vg, w0, l1_weight=l1,
-                    max_iter=max_iter, tolerance=tolerance,
-                    history=optimizer.history,
-                )
-            return minimize_lbfgs(
-                vg, w0, max_iter=max_iter, tolerance=tolerance,
-                history=optimizer.history,
-            )
-
-        return jax.vmap(solve_one)(l2v, l1v)
-
-    results = run_grid(
-        optimizer.max_iterations, optimizer.tolerance, batch, l2s, l1s
+    results = _jitted_grid_solve(
+        objective, use_owlqn, optimizer.history,
+        optimizer.max_iterations, optimizer.tolerance, batch, l2s, l1s,
     )
     norm = objective.normalization
     diags = None
     if compute_variance:
-        # one shared read of the feature block for all lanes, like the solve
-        @jax.jit
-        def grid_diagonals(b, coeffs, l2v):
-            per_lane = lambda w, l2: objective.hessian_diagonal(w, b) + l2
-            return jax.vmap(per_lane)(coeffs, l2v)
-
-        diags = grid_diagonals(batch, results.coefficients, l2s)
+        diags = _jitted_grid_diagonals(objective, batch, results.coefficients, l2s)
     models: dict[float, GeneralizedLinearModel] = {}
     for i, lam in enumerate(lams):
         w = results.coefficients[i]
@@ -397,6 +365,43 @@ def train_glm_grid(
             Coefficients(means=means, variances=variances), task
         )
     return models
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _jitted_grid_solve(objective, use_owlqn, history, max_iter, tolerance,
+                       batch, l2v, l1v):
+    """Module-level jit: one compiled vmapped-grid program per
+    (objective, optimizer statics) pair, reused across train_glm_grid calls
+    of the same shapes."""
+    from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+    from photon_ml_tpu.optim.owlqn import minimize_owlqn
+
+    bound = objective.bind(batch)
+    dtype = l2v.dtype
+
+    def solve_one(l2, l1):
+        def vg(w):
+            v, g = bound.value_and_grad(w)
+            return v + 0.5 * l2 * jnp.vdot(w, w), g + l2 * w
+
+        w0 = jnp.zeros((batch.dim,), dtype)
+        if use_owlqn:
+            return minimize_owlqn(
+                vg, w0, l1_weight=l1,
+                max_iter=max_iter, tolerance=tolerance, history=history,
+            )
+        return minimize_lbfgs(
+            vg, w0, max_iter=max_iter, tolerance=tolerance, history=history,
+        )
+
+    return jax.vmap(solve_one)(l2v, l1v)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _jitted_grid_diagonals(objective, batch, coeffs, l2v):
+    """All lanes' Hessian diagonals in one shared read of the feature block."""
+    per_lane = lambda w, l2: objective.hessian_diagonal(w, batch) + l2
+    return jax.vmap(per_lane)(coeffs, l2v)
 
 
 def train_glm(

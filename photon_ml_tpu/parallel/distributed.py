@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.algorithm.coordinates import solve_entity_bucket
+from photon_ml_tpu.algorithm.mf_coordinate import solve_mf_side_bucket
+from photon_ml_tpu.models.matrix_factorization import score_matrix_factorization
 from photon_ml_tpu.data.batch import LabeledPointBatch
 from photon_ml_tpu.data.game_data import GameDataset, RandomEffectDataset
 from photon_ml_tpu.models.game import score_random_effect
@@ -58,10 +60,14 @@ class GameTrainState:
         (only) axis over "model" for giant coordinates, replicate otherwise.
     re_tables: RE type -> [num_entities, d_re] coefficient table; the entity
         axis shards over "data".
+    mf_rows / mf_cols: MF coordinate name -> [num_entities, k] latent-factor
+        tables (row / col side); entity axes shard over "data".
     """
 
     fe_coefficients: Array
     re_tables: dict[str, Array]
+    mf_rows: dict[str, Array] = flax.struct.field(default_factory=dict)
+    mf_cols: dict[str, Array] = flax.struct.field(default_factory=dict)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,16 +87,36 @@ class FixedEffectStepSpec:
     l2_weight: float = 0.0
 
 
+@dataclasses.dataclass(frozen=True)
+class MatrixFactorizationStepSpec:
+    """Static description of one MF coordinate inside the fused step (the
+    model family the reference declares but never implemented —
+    algorithm/mf_coordinate.py)."""
+
+    name: str
+    row_effect_type: str
+    col_effect_type: str
+    num_latent_factors: int
+    optimizer: OptimizerConfig
+    l2_weight: float = 0.0
+    num_alternations: int = 1
+    seed: int = 0
+
+
 def _data_pytree(dataset: GameDataset, re_specs: Sequence[RandomEffectStepSpec],
-                 fe_shard: str) -> dict:
+                 fe_shard: str,
+                 mf_specs: Sequence[MatrixFactorizationStepSpec] = ()) -> dict:
     shards = {fe_shard} | {s.feature_shard_id for s in re_specs}
+    id_types = {s.re_type for s in re_specs}
+    for m in mf_specs:
+        id_types |= {m.row_effect_type, m.col_effect_type}
     return {
         "labels": jnp.asarray(dataset.labels),
         "offsets": jnp.asarray(dataset.offsets),
         "weights": jnp.asarray(dataset.weights),
         "features": {k: jnp.asarray(dataset.feature_shards[k]) for k in shards},
         "entity_idx": {
-            s.re_type: jnp.asarray(dataset.entity_idx[s.re_type]) for s in re_specs
+            t: jnp.asarray(dataset.entity_idx[t]) for t in sorted(id_types)
         },
     }
 
@@ -137,11 +163,21 @@ class GameTrainProgram:
         fe: FixedEffectStepSpec,
         re_specs: Sequence[RandomEffectStepSpec] = (),
         *,
+        mf_specs: Sequence[MatrixFactorizationStepSpec] = (),
         normalization: NormalizationContext | None = None,
     ):
         self.task = task
         self.fe = fe
         self.re_specs = tuple(re_specs)
+        self.mf_specs = tuple(mf_specs)
+        # coordinate names share one residual namespace (sum_scores skip keys)
+        names = [s.re_type for s in self.re_specs] + [m.name for m in self.mf_specs]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(
+                f"coordinate names must be unique across RE types and MF "
+                f"names (duplicates: {sorted(dupes)})"
+            )
         loss = loss_for_task(task)
         self._loss = loss
         self.normalization = normalization
@@ -150,6 +186,10 @@ class GameTrainProgram:
         self._re_objectives = {
             s.re_type: GLMObjective(loss, l2_weight=s.l2_weight)
             for s in self.re_specs
+        }
+        self._mf_objectives = {
+            m.name: GLMObjective(loss, l2_weight=m.l2_weight)
+            for m in self.mf_specs
         }
         self._step = jax.jit(self._step_impl)
 
@@ -165,7 +205,10 @@ class GameTrainProgram:
 
     def init_state(self, dataset: GameDataset,
                    re_datasets: Mapping[str, RandomEffectDataset],
+                   mf_datasets: Mapping[str, "MFDataset"] | None = None,
                    dtype=None) -> GameTrainState:
+        from photon_ml_tpu.models.matrix_factorization import init_factors
+
         fe_dim = dataset.feature_shards[self.fe.feature_shard_id].shape[1]
         dtype = dtype or dataset.feature_shards[self.fe.feature_shard_id].dtype
         tables = {
@@ -175,16 +218,53 @@ class GameTrainProgram:
             )
             for s in self.re_specs
         }
+        mf_rows: dict[str, Array] = {}
+        mf_cols: dict[str, Array] = {}
+        for m in self.mf_specs:
+            mf = (mf_datasets or {})[m.name]
+            row, col = init_factors(
+                mf.num_row_entities, mf.num_col_entities,
+                m.num_latent_factors, seed=m.seed, dtype=dtype,
+            )
+            # zero the factors of vocab entities with no samples (they are
+            # never solved; random init would leak noise into their scores)
+            row_mask, col_mask = mf.trained_masks()
+            mf_rows[m.name] = jnp.where(jnp.asarray(row_mask)[:, None], row, 0.0)
+            mf_cols[m.name] = jnp.where(jnp.asarray(col_mask)[:, None], col, 0.0)
         return GameTrainState(
-            fe_coefficients=jnp.zeros((fe_dim,), dtype=dtype), re_tables=tables
+            fe_coefficients=jnp.zeros((fe_dim,), dtype=dtype),
+            re_tables=tables,
+            mf_rows=mf_rows,
+            mf_cols=mf_cols,
         )
 
     def prepare_inputs(self, dataset: GameDataset,
-                       re_datasets: Mapping[str, RandomEffectDataset]):
-        data = _data_pytree(dataset, self.re_specs, self.fe.feature_shard_id)
+                       re_datasets: Mapping[str, RandomEffectDataset],
+                       mf_datasets: Mapping[str, "MFDataset"] | None = None):
+        data = _data_pytree(
+            dataset, self.re_specs, self.fe.feature_shard_id, self.mf_specs
+        )
         buckets = _buckets_pytree(
             {s.re_type: re_datasets[s.re_type] for s in self.re_specs}
         )
+        buckets["__mf__"] = {
+            m.name: {
+                side: [
+                    {
+                        "labels": b.labels,
+                        "weights": b.weights,
+                        "sample_rows": b.sample_rows,
+                        "entity_rows": b.entity_rows,
+                    }
+                    for b in side_buckets
+                ]
+                for side, side_buckets in (
+                    ("row", (mf_datasets or {})[m.name].row_buckets),
+                    ("col", (mf_datasets or {})[m.name].col_buckets),
+                )
+            }
+            for m in self.mf_specs
+        }
         return data, buckets
 
     def shard_inputs(self, mesh: Mesh, data, buckets, state,
@@ -209,27 +289,71 @@ class GameTrainProgram:
         ent3 = NamedSharding(mesh, P("data", None, None))
         ent2 = NamedSharding(mesh, P("data", None))
         ent1 = NamedSharding(mesh, P("data"))
-        buckets = {
-            k: [
-                {
-                    "features": jax.device_put(b["features"], ent3),
-                    "labels": jax.device_put(b["labels"], ent2),
-                    "weights": jax.device_put(b["weights"], ent2),
-                    "sample_rows": jax.device_put(b["sample_rows"], ent2),
-                    "entity_rows": jax.device_put(b["entity_rows"], ent1),
-                }
-                for b in bs
-            ]
+        data_axis = int(mesh.shape["data"])
+
+        def put_bucket(b: dict) -> dict:
+            # Pad the entity axis to a multiple of the mesh "data" axis.
+            # Padding lanes carry weight 0 and an out-of-range entity row:
+            # JAX clamps out-of-bounds gathers (warm-start reads are junk but
+            # harmless) and DROPS out-of-bounds scatter updates, so padded
+            # lanes never write into the coefficient tables.
+            e = int(b["entity_rows"].shape[0])
+            pad = (-e) % data_axis
+            if pad:
+                b = dict(b)
+                b["labels"] = jnp.pad(b["labels"], ((0, pad), (0, 0)))
+                b["weights"] = jnp.pad(b["weights"], ((0, pad), (0, 0)))
+                b["sample_rows"] = jnp.pad(
+                    b["sample_rows"], ((0, pad), (0, 0)), constant_values=-1
+                )
+                b["entity_rows"] = jnp.pad(
+                    b["entity_rows"], (0, pad),
+                    constant_values=jnp.iinfo(jnp.int32).max,
+                )
+                if "features" in b:
+                    b["features"] = jnp.pad(
+                        b["features"], ((0, pad), (0, 0), (0, 0))
+                    )
+            out = {
+                "labels": jax.device_put(b["labels"], ent2),
+                "weights": jax.device_put(b["weights"], ent2),
+                "sample_rows": jax.device_put(b["sample_rows"], ent2),
+                "entity_rows": jax.device_put(b["entity_rows"], ent1),
+            }
+            if "features" in b:
+                out["features"] = jax.device_put(b["features"], ent3)
+            return out
+
+        sharded_buckets: dict = {
+            k: [put_bucket(b) for b in bs]
             for k, bs in buckets.items()
+            if k != "__mf__"
         }
+        if "__mf__" in buckets:
+            sharded_buckets["__mf__"] = {
+                name: {
+                    side: [put_bucket(b) for b in side_buckets]
+                    for side, side_buckets in sides.items()
+                }
+                for name, sides in buckets["__mf__"].items()
+            }
+        def put_table(v):
+            # entity axis padded to a mesh multiple; padded rows are never
+            # read (entity indices stay < E) nor written (scatter targets
+            # are real rows), and are sliced off again on exit
+            pad = (-int(v.shape[0])) % data_axis
+            if pad:
+                v = jnp.pad(v, ((0, pad), (0, 0)))
+            return jax.device_put(v, ent2)
+
         fe_sharding = NamedSharding(mesh, P("model")) if fe_feature_sharded else rep
         state = GameTrainState(
             fe_coefficients=jax.device_put(state.fe_coefficients, fe_sharding),
-            re_tables={
-                k: jax.device_put(v, ent2) for k, v in state.re_tables.items()
-            },
+            re_tables={k: put_table(v) for k, v in state.re_tables.items()},
+            mf_rows={k: put_table(v) for k, v in state.mf_rows.items()},
+            mf_cols={k: put_table(v) for k, v in state.mf_cols.items()},
         )
-        return data, buckets, state
+        return data, sharded_buckets, state
 
     # -- the fused step ------------------------------------------------------
 
@@ -251,10 +375,22 @@ class GameTrainProgram:
             )
             for s in self.re_specs
         }
+        mf_scores = {
+            m.name: score_matrix_factorization(
+                state.mf_rows[m.name],
+                state.mf_cols[m.name],
+                data["entity_idx"][m.row_effect_type],
+                data["entity_idx"][m.col_effect_type],
+            )
+            for m in self.mf_specs
+        }
 
         def sum_scores(skip=None):
             total = jnp.zeros_like(base_offsets)
             for k, v in re_scores.items():
+                if k != skip:
+                    total = total + v
+            for k, v in mf_scores.items():
                 if k != skip:
                     total = total + v
             return total
@@ -301,11 +437,43 @@ class GameTrainProgram:
                 table, feats[spec.feature_shard_id], data["entity_idx"][k]
             )
 
+        # ---- matrix-factorization coordinates (alternating vmapped solves)
+        mf_rows = dict(state.mf_rows)
+        mf_cols = dict(state.mf_cols)
+        for m in self.mf_specs:
+            full_offsets = base_offsets + fe_score + sum_scores(skip=m.name)
+            row_idx = data["entity_idx"][m.row_effect_type]
+            col_idx = data["entity_idx"][m.col_effect_type]
+            objective = self._mf_objectives[m.name]
+            rows, cols = mf_rows[m.name], mf_cols[m.name]
+            mf_buckets = buckets["__mf__"][m.name]
+            for _ in range(m.num_alternations):
+                for b in mf_buckets["row"]:
+                    rows = solve_mf_side_bucket(
+                        objective, m.optimizer, b["labels"], b["weights"],
+                        b["entity_rows"], b["sample_rows"], col_idx, cols,
+                        full_offsets, rows,
+                    )
+                for b in mf_buckets["col"]:
+                    cols = solve_mf_side_bucket(
+                        objective, m.optimizer, b["labels"], b["weights"],
+                        b["entity_rows"], b["sample_rows"], row_idx, rows,
+                        full_offsets, cols,
+                    )
+            mf_rows[m.name], mf_cols[m.name] = rows, cols
+            mf_scores[m.name] = score_matrix_factorization(
+                rows, cols, row_idx, col_idx
+            )
+
         total_margin = base_offsets + fe_score + sum_scores()
         losses = self._loss.loss(total_margin, labels)
         wsum = jnp.maximum(jnp.sum(weights), 1.0)
         train_loss = jnp.sum(weights * losses) / wsum
-        return GameTrainState(fe_coefficients=fe_w, re_tables=tables), train_loss
+        new_state = GameTrainState(
+            fe_coefficients=fe_w, re_tables=tables,
+            mf_rows=mf_rows, mf_cols=mf_cols,
+        )
+        return new_state, train_loss
 
 
 def train_distributed(
@@ -313,6 +481,7 @@ def train_distributed(
     dataset: GameDataset,
     re_datasets: Mapping[str, RandomEffectDataset],
     *,
+    mf_datasets: Mapping[str, "MFDataset"] | None = None,
     mesh: Mesh | None = None,
     num_iterations: int = 1,
     fe_feature_sharded: bool = False,
@@ -340,20 +509,61 @@ def train_distributed(
     if checkpointer is not None and resume and state is None:
         ckpt = checkpointer.restore()
         if ckpt is not None:
+            def by_prefix(prefix):
+                return {
+                    k[len(prefix):]: jnp.asarray(v)
+                    for k, v in ckpt.arrays.items()
+                    if k.startswith(prefix)
+                }
             state = GameTrainState(
                 fe_coefficients=jnp.asarray(ckpt.arrays["fe_coefficients"]),
-                re_tables={
-                    k[len("re_tables/"):]: jnp.asarray(v)
-                    for k, v in ckpt.arrays.items()
-                    if k.startswith("re_tables/")
-                },
+                re_tables=by_prefix("re_tables/"),
+                mf_rows=by_prefix("mf_rows/"),
+                mf_cols=by_prefix("mf_cols/"),
             )
+            expected = {
+                "re_tables": {s.re_type for s in program.re_specs},
+                "mf_rows": {m.name for m in program.mf_specs},
+                "mf_cols": {m.name for m in program.mf_specs},
+            }
+            found = {
+                "re_tables": set(state.re_tables),
+                "mf_rows": set(state.mf_rows),
+                "mf_cols": set(state.mf_cols),
+            }
+            if expected != found:
+                raise ValueError(
+                    f"checkpoint at {checkpointer.directory} is incompatible "
+                    f"with the program's coordinate specs: checkpoint has "
+                    f"{found}, program expects {expected}. Pass resume=False "
+                    "or use a fresh checkpoint directory."
+                )
             start_sweep = min(int(ckpt.step), num_iterations)
             prior_losses = [float(x) for x in ckpt.meta.get("losses", [])][:start_sweep]
 
-    data, buckets = program.prepare_inputs(dataset, re_datasets)
+    data, buckets = program.prepare_inputs(dataset, re_datasets, mf_datasets)
     if state is None:
-        state = program.init_state(dataset, re_datasets)
+        state = program.init_state(dataset, re_datasets, mf_datasets)
+
+    # true entity counts, to slice off any mesh-padding rows on the way out
+    table_sizes = {
+        "re_tables": {s.re_type: re_datasets[s.re_type].num_entities
+                      for s in program.re_specs},
+        "mf_rows": {m.name: (mf_datasets or {})[m.name].num_row_entities
+                    for m in program.mf_specs},
+        "mf_cols": {m.name: (mf_datasets or {})[m.name].num_col_entities
+                    for m in program.mf_specs},
+    }
+
+    def unpadded(state_: GameTrainState) -> GameTrainState:
+        def trim(tables, sizes):
+            return {k: v[: sizes[k]] for k, v in tables.items()}
+        return GameTrainState(
+            fe_coefficients=state_.fe_coefficients,
+            re_tables=trim(state_.re_tables, table_sizes["re_tables"]),
+            mf_rows=trim(state_.mf_rows, table_sizes["mf_rows"]),
+            mf_cols=trim(state_.mf_cols, table_sizes["mf_cols"]),
+        )
     if mesh is not None:
         data, buckets, state = program.shard_inputs(
             mesh, data, buckets, state, fe_feature_sharded=fe_feature_sharded
@@ -365,8 +575,14 @@ def train_distributed(
         if checkpointer is not None and (
             (sweep + 1) % max(1, checkpoint_every) == 0 or sweep + 1 == num_iterations
         ):
-            arrays = {"fe_coefficients": jax.device_get(state.fe_coefficients)}
-            for k, v in state.re_tables.items():
-                arrays[f"re_tables/{k}"] = jax.device_get(v)
+            clean = unpadded(state)
+            arrays = {"fe_coefficients": jax.device_get(clean.fe_coefficients)}
+            for prefix, tables in (
+                ("re_tables/", clean.re_tables),
+                ("mf_rows/", clean.mf_rows),
+                ("mf_cols/", clean.mf_cols),
+            ):
+                for k, v in tables.items():
+                    arrays[prefix + k] = jax.device_get(v)
             checkpointer.save(sweep + 1, arrays, {"losses": losses})
-    return state, losses
+    return unpadded(state), losses

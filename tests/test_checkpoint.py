@@ -242,3 +242,55 @@ def test_distributed_checkpoint_resume(rng, tmp_path):
     assert len(losses_resumed) == 3
     np.testing.assert_allclose(losses_resumed, losses_full, rtol=1e-6)
     assert ck.latest_step() == 3
+
+
+def test_distributed_checkpoint_resume_with_mf(rng, tmp_path):
+    from photon_ml_tpu.algorithm.mf_coordinate import build_mf_dataset
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig as OC
+    from photon_ml_tpu.parallel.distributed import (
+        FixedEffectStepSpec,
+        GameTrainProgram,
+        MatrixFactorizationStepSpec,
+        train_distributed,
+    )
+
+    n = 48
+    x = rng.normal(size=(n, 4))
+    ui = rng.integers(0, 6, size=n)
+    vi = rng.integers(0, 5, size=n)
+    y = x @ rng.normal(size=4) + 0.5 * rng.normal(size=n)
+    dataset = build_game_dataset(
+        labels=y,
+        feature_shards={"global": x},
+        entity_keys={
+            "u": np.array([f"u{i}" for i in ui]),
+            "v": np.array([f"v{i}" for i in vi]),
+        },
+        dtype=np.float64,
+    )
+    mf_datasets = {"mf": build_mf_dataset(dataset, "u", "v", bucket_sizes=(n,))}
+    opt = OC(optimizer_type=OptimizerType.LBFGS, max_iterations=4)
+    program = GameTrainProgram(
+        TaskType.LINEAR_REGRESSION,
+        FixedEffectStepSpec("global", opt, l2_weight=0.5),
+        mf_specs=(
+            MatrixFactorizationStepSpec(
+                "mf", "u", "v", num_latent_factors=2, optimizer=opt,
+                l2_weight=0.5,
+            ),
+        ),
+    )
+    _, losses_full = train_distributed(
+        program, dataset, {}, mf_datasets=mf_datasets, num_iterations=3
+    )
+    ck = TrainingCheckpointer(tmp_path / "mf-dist")
+    train_distributed(
+        program, dataset, {}, mf_datasets=mf_datasets, num_iterations=2,
+        checkpointer=ck,
+    )
+    state, losses_resumed = train_distributed(
+        program, dataset, {}, mf_datasets=mf_datasets, num_iterations=3,
+        checkpointer=ck,
+    )
+    np.testing.assert_allclose(losses_resumed, losses_full, rtol=1e-6)
+    assert set(state.mf_rows) == {"mf"} and set(state.mf_cols) == {"mf"}

@@ -101,3 +101,65 @@ def test_graft_entry_contract():
     assert np.isfinite(np.asarray(out)).all()
 
     ge.dryrun_multichip(len(jax.devices()))
+
+
+def test_fused_step_with_mf_sharded_matches_single_device(rng):
+    """The fused step including an MF coordinate must be sharding-invariant
+    and reduce the loss on low-rank-structured data."""
+    from photon_ml_tpu.algorithm.mf_coordinate import build_mf_dataset
+    from photon_ml_tpu.parallel.distributed import MatrixFactorizationStepSpec
+
+    # entity counts deliberately NOT divisible by the data axis (4): the
+    # mesh-padding path (OOB-sentinel rows, table padding, unpadded trim)
+    # must be exercised, not just the pad==0 shortcut
+    n, d_fe, k = 64, 8, 2
+    u = rng.normal(size=(11, k)); v = rng.normal(size=(7, k))
+    ui = rng.integers(0, 11, size=n); vi = rng.integers(0, 7, size=n)
+    x_fe = rng.normal(size=(n, d_fe)).astype(np.float64)
+    y = x_fe @ rng.normal(size=d_fe) + np.einsum("nk,nk->n", u[ui], v[vi])
+    dataset = build_game_dataset(
+        labels=y,
+        feature_shards={"global": x_fe},
+        entity_keys={
+            "user": np.array([f"u{i}" for i in ui]),
+            "item": np.array([f"i{i}" for i in vi]),
+        },
+        dtype=np.float64,
+    )
+    mf_datasets = {"mf": build_mf_dataset(dataset, "user", "item", bucket_sizes=(n,))}
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=8)
+    program = GameTrainProgram(
+        TaskType.LINEAR_REGRESSION,
+        FixedEffectStepSpec(feature_shard_id="global", optimizer=opt, l2_weight=0.01),
+        mf_specs=(
+            MatrixFactorizationStepSpec(
+                "mf", "user", "item", num_latent_factors=k,
+                optimizer=opt, l2_weight=0.01, num_alternations=2,
+            ),
+        ),
+    )
+    state1, losses1 = train_distributed(
+        program, dataset, {}, mf_datasets=mf_datasets, num_iterations=3
+    )
+    assert np.isfinite(losses1).all()
+    assert losses1[-1] < 0.5 * losses1[0], losses1
+
+    mesh = make_mesh(data=4, model=2)
+    state8, losses8 = train_distributed(
+        program, dataset, {}, mf_datasets=mf_datasets, mesh=mesh,
+        num_iterations=3, fe_feature_sharded=True,
+    )
+    # returned tables must be trimmed back to the true entity counts
+    assert np.asarray(state8.mf_rows["mf"]).shape == (11, k)
+    assert np.asarray(state8.mf_cols["mf"]).shape == (7, k)
+    # tolerances absorb cross-device reduction-order float noise, amplified
+    # through L-BFGS line searches
+    np.testing.assert_allclose(losses1, losses8, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(state1.mf_rows["mf"]), np.asarray(state8.mf_rows["mf"]),
+        rtol=0.05, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(state1.mf_cols["mf"]), np.asarray(state8.mf_cols["mf"]),
+        rtol=0.05, atol=1e-4,
+    )
