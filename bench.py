@@ -9,10 +9,12 @@ Workload: the reference's hot loop (SURVEY.md §3.4) folded over a
 train_glm_grid): vmapped L-BFGS lanes share every read of the [n, d]
 feature block, so per-lane margins become one X @ W matmul on the MXU, and
 measured wall-clock is nearly flat in the number of lanes (extra λs are
-almost free). ``vs_baseline`` is the measured speedup over scipy's Fortran
-L-BFGS-B solving the same grid sequentially on the host CPU (stand-in for
-the reference's single-executor Breeze/JVM path; the reference publishes no
-benchmark numbers, see BASELINE.md).
+almost free). ``vs_baseline`` is the ratio of example-iteration throughput
+(examples x L-BFGS iterations per second) against scipy's Fortran L-BFGS-B
+solving the same grid sequentially on the host CPU — iteration-normalized
+because the two solvers terminate after different iteration counts
+(stand-in for the reference's single-executor Breeze/JVM path; the
+reference publishes no benchmark numbers, see BASELINE.md).
 
 Measurement notes (tunneled/remote TPU backends):
 - The whole grid is ONE jit call, timed end-to-end (min of 3 reps) with a
@@ -21,8 +23,9 @@ Measurement notes (tunneled/remote TPU backends):
   here) is honestly included in the reported wall-clock.
 - Each rep perturbs the warm starts from a fresh PRNG seed so no two
   executions are identical (some backends cache repeat executions).
-- The CPU baseline runs on an n/8 subsample and is scaled linearly (per-λ
-  cost is linear in n at fixed d and iteration count).
+- The CPU baseline runs on an n/8 subsample; both sides are expressed as
+  example-iterations/sec, which is size-invariant (per-iteration cost is
+  linear in n at fixed d).
 """
 
 from __future__ import annotations

@@ -25,7 +25,7 @@ from photon_ml_tpu.data.validators import DataValidationType, validate_arrays
 from photon_ml_tpu.diagnostics.metrics import METRIC_DIRECTIONS, evaluate_model
 from photon_ml_tpu.diagnostics.report_builder import build_diagnostic_report
 from photon_ml_tpu.diagnostics.reporting import render_html, render_text
-from photon_ml_tpu.estimators import train_glm
+from photon_ml_tpu.estimators import train_glm, train_glm_grid
 from photon_ml_tpu.io.data_reader import FeatureShardConfiguration, read_merged
 from photon_ml_tpu.io.model_io import write_glm_text
 from photon_ml_tpu.ops.normalization import NormalizationType, build_normalization
@@ -72,6 +72,10 @@ class GLMDriverParams:
     enable_diagnostics: bool = False
     num_bootstraps: int = 0
     compute_variance: bool = False
+    #: train the whole λ grid simultaneously as vmapped solver lanes
+    #: (train_glm_grid) instead of the sequential warm-start fold; LBFGS/
+    #: OWLQN only — see estimators.train_glm_grid
+    grid_parallel: bool = False
     input_format: str = "avro"
 
 
@@ -138,7 +142,8 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
         )
 
         def fit(b: LabeledPointBatch, lams) -> dict:
-            return train_glm(
+            trainer = train_glm_grid if params.grid_parallel else train_glm
+            return trainer(
                 b,
                 params.task_type,
                 optimizer=opt,
@@ -260,6 +265,9 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
     p.add_argument("--enable-diagnostics", action="store_true")
     p.add_argument("--num-bootstraps", type=int, default=0)
     p.add_argument("--compute-variance", action="store_true")
+    p.add_argument("--grid-parallel", action="store_true",
+                   help="train all regularization weights simultaneously as "
+                        "vmapped solver lanes (LBFGS/OWLQN only)")
     p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
     args = p.parse_args(argv)
     return run(
@@ -280,6 +288,7 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
             enable_diagnostics=args.enable_diagnostics,
             num_bootstraps=args.num_bootstraps,
             compute_variance=args.compute_variance,
+            grid_parallel=args.grid_parallel,
             input_format=args.input_format,
         )
     )

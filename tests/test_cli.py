@@ -364,3 +364,46 @@ class TestEndToEnd:
         assert counts["features"] == 6
         lines = (tmp_path / "bags" / "features" / "part-00000.tsv").read_text().splitlines()
         assert lines[0].split("\t")[0] == "f0"
+
+
+def test_glm_driver_grid_parallel_matches_sequential(tmp_path):
+    """--grid-parallel must select the same best λ and near-identical
+    validation metrics as the sequential warm-start path."""
+    import numpy as np
+    from photon_ml_tpu.cli import glm_driver
+
+    rng = np.random.default_rng(4)
+    n, d = 500, 10
+    w = rng.normal(size=d)
+    base = tmp_path / "data"
+    for split, nn in (("train", n), ("val", 200)):
+        lines = []
+        for _ in range(nn):
+            x = rng.normal(size=d)
+            y = 1 if rng.random() < 1 / (1 + np.exp(-(x @ w))) else -1
+            lines.append(
+                f"{'+1' if y > 0 else '-1'} "
+                + " ".join(f"{j+1}:{x[j]:.6f}" for j in range(d))
+            )
+        (base / split).mkdir(parents=True, exist_ok=True)
+        (base / split / "data.libsvm").write_text("\n".join(lines))
+
+    def run(flag, out):
+        return glm_driver.main([
+            "--input-data-path", str(base / "train" / "data.libsvm"),
+            "--validation-data-path", str(base / "val" / "data.libsvm"),
+            "--output-dir", str(tmp_path / out),
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--regularization-weights", "0.1,1,10",
+            "--input-format", "libsvm",
+            "--max-iterations", "60",
+            *(["--grid-parallel"] if flag else []),
+        ])
+
+    seq = run(False, "seq")
+    par = run(True, "par")
+    assert par.best_lambda == seq.best_lambda
+    for lam in (0.1, 1.0, 10.0):
+        assert par.validation_metrics[lam]["AUC"] == pytest.approx(
+            seq.validation_metrics[lam]["AUC"], abs=1e-3
+        )
