@@ -79,6 +79,12 @@ def test_sharded_matches_single_device(rng):
         program, dataset, re_datasets, mesh=mesh, num_iterations=2,
         fe_feature_sharded=True,
     )
+    # the giant-FE story (SURVEY §7): with fe_feature_sharded the coefficient
+    # vector must STAY sharded over "model" through the whole step — a
+    # replicated result would mean XLA gathered it (and the L-BFGS history
+    # with it), breaking the >HBM-sized coordinate design
+    fe_spec = state8.fe_coefficients.sharding.spec
+    assert tuple(fe_spec) == ("model",), fe_spec
     np.testing.assert_allclose(losses1, losses8, rtol=1e-9)
     np.testing.assert_allclose(
         np.asarray(state1.fe_coefficients),

@@ -178,3 +178,30 @@ def test_directory_path_raises_cleanly(tmp_path):
         parse_libsvm(tmp_path)
     with pytest.raises((IsADirectoryError, ValueError)):
         parse_libsvm(tmp_path, force_python=True)
+
+
+def test_libsvm_to_avro_converter_round_trip(tmp_path, svm_file):
+    """The converter (reference dev-scripts/libsvm_text_to_trainingexample_
+    avro.py parity) must produce Avro the drivers read identically to
+    direct LibSVM ingestion."""
+    from photon_ml_tpu.cli.libsvm_to_avro import convert, main
+
+    out = tmp_path / "converted" / "part-00000.avro"
+    n = convert(svm_file, out)
+    assert n == 4 and out.exists()
+
+    shard_cfgs = {
+        "g": FeatureShardConfiguration(feature_bags=("features",), has_intercept=True)
+    }
+    from_avro = read_merged(out.parent, shard_cfgs, fmt="avro", dtype=np.float64)
+    from_svm = read_merged(svm_file, shard_cfgs, fmt="libsvm", dtype=np.float64)
+    np.testing.assert_array_equal(
+        np.asarray(from_avro.dataset.labels), np.asarray(from_svm.dataset.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(from_avro.dataset.feature_shards["g"]),
+        np.asarray(from_svm.dataset.feature_shards["g"]),
+    )
+    # CLI entry point works too
+    n2 = main(["--input", str(svm_file), "--output", str(tmp_path / "x.avro")])
+    assert n2 == 4
