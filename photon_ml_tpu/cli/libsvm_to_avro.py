@@ -35,18 +35,11 @@ def convert(
     out_dir = os.path.dirname(str(output_path))
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
-    count = 0
-
-    def counted():
-        nonlocal count
-        for record in read_libsvm(input_path, zero_based=zero_based):
-            count += 1
-            yield record
-
-    avro_io.write_container(
-        output_path, schemas.TRAINING_EXAMPLE_AVRO, counted()
+    return avro_io.write_container(
+        output_path,
+        schemas.TRAINING_EXAMPLE_AVRO,
+        read_libsvm(input_path, zero_based=zero_based),
     )
-    return count
 
 
 def main(argv: Sequence[str] | None = None) -> int:

@@ -170,13 +170,19 @@ class GameTrainProgram:
         self.fe = fe
         self.re_specs = tuple(re_specs)
         self.mf_specs = tuple(mf_specs)
-        # coordinate names share one residual namespace (sum_scores skip keys)
-        names = [s.re_type for s in self.re_specs] + [m.name for m in self.mf_specs]
+        # coordinate names share one namespace: residual skip keys and the
+        # GameModel coordinate ids of state_to_game_model (where the FE
+        # coordinate is named after its feature shard)
+        names = (
+            [fe.feature_shard_id]
+            + [s.re_type for s in self.re_specs]
+            + [m.name for m in self.mf_specs]
+        )
         dupes = {n for n in names if names.count(n) > 1}
         if dupes:
             raise ValueError(
-                f"coordinate names must be unique across RE types and MF "
-                f"names (duplicates: {sorted(dupes)})"
+                f"coordinate names must be unique across the FE feature "
+                f"shard, RE types, and MF names (duplicates: {sorted(dupes)})"
             )
         loss = loss_for_task(task)
         self._loss = loss
@@ -474,6 +480,62 @@ class GameTrainProgram:
             mf_rows=mf_rows, mf_cols=mf_cols,
         )
         return new_state, train_loss
+
+
+def state_to_game_model(
+    program: GameTrainProgram,
+    state: GameTrainState,
+    dataset: GameDataset,
+    *,
+    intercept_index: int | None = None,
+):
+    """Convert a fused-step ``GameTrainState`` into a ``GameModel`` so
+    multi-chip-trained models flow into the standard persistence/scoring
+    stack (io/model_io.save_game_model, transformers.GameTransformer).
+
+    Coordinate ids: the FE coordinate is named after its feature shard; RE
+    coordinates after their RE type; MF coordinates after their spec name.
+    The FE vector is converted back to original feature space (warm starts
+    live in normalized space inside the step).
+    """
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.models.glm import GeneralizedLinearModel
+    from photon_ml_tpu.models.matrix_factorization import (
+        MatrixFactorizationModel,
+    )
+
+    models: dict[str, object] = {}
+    fe_means = program.fe_coefficients_model_space(state, intercept_index)
+    models[program.fe.feature_shard_id] = FixedEffectModel(
+        glm=GeneralizedLinearModel(
+            Coefficients(means=fe_means), program.task
+        ),
+        feature_shard_id=program.fe.feature_shard_id,
+    )
+    for spec in program.re_specs:
+        models[spec.re_type] = RandomEffectModel(
+            coefficients=state.re_tables[spec.re_type],
+            entity_keys=dataset.entity_vocabs[spec.re_type],
+            random_effect_type=spec.re_type,
+            feature_shard_id=spec.feature_shard_id,
+            task=program.task,
+        )
+    for m in program.mf_specs:
+        models[m.name] = MatrixFactorizationModel(
+            row_factors=state.mf_rows[m.name],
+            col_factors=state.mf_cols[m.name],
+            row_effect_type=m.row_effect_type,
+            col_effect_type=m.col_effect_type,
+            row_keys=dataset.entity_vocabs[m.row_effect_type],
+            col_keys=dataset.entity_vocabs[m.col_effect_type],
+            task=program.task,
+        )
+    return GameModel(models=models)
 
 
 def train_distributed(

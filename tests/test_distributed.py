@@ -169,3 +169,62 @@ def test_fused_step_with_mf_sharded_matches_single_device(rng):
         np.asarray(state1.mf_cols["mf"]), np.asarray(state8.mf_cols["mf"]),
         rtol=0.05, atol=1e-4,
     )
+
+
+def test_state_to_game_model_round_trip(rng, tmp_path):
+    """Fused-step state -> GameModel -> Avro -> load -> scoring must agree
+    with the in-step margins (multi-chip training feeds the standard
+    persistence/scoring stack)."""
+    from photon_ml_tpu.algorithm.mf_coordinate import build_mf_dataset
+    from photon_ml_tpu.io.index_map import IndexMap, feature_key
+    from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+    from photon_ml_tpu.parallel.distributed import (
+        MatrixFactorizationStepSpec,
+        state_to_game_model,
+    )
+
+    dataset, re_datasets = _toy_game_data(rng)
+    mf_datasets = {"mf": build_mf_dataset(dataset, "user", "item", bucket_sizes=(64,))}
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=5)
+    program = GameTrainProgram(
+        TaskType.LOGISTIC_REGRESSION,
+        FixedEffectStepSpec("global", opt, l2_weight=0.1),
+        (RandomEffectStepSpec("user", "per_entity", opt, l2_weight=1.0),),
+        mf_specs=(
+            MatrixFactorizationStepSpec("mf", "user", "item", 2, opt, l2_weight=1.0),
+        ),
+    )
+    state, _ = train_distributed(
+        program, dataset, re_datasets, mf_datasets=mf_datasets, num_iterations=2
+    )
+    model = state_to_game_model(program, state, dataset)
+    direct_scores = np.asarray(model.score_dataset(dataset))
+    assert np.isfinite(direct_scores).all()
+
+    # Avro round trip in the reference layout
+    imaps = {
+        shard: IndexMap.from_keys(
+            {feature_key(f"c{j}", "") for j in range(arr.shape[1])},
+            add_intercept=False,
+        )
+        for shard, arr in dataset.feature_shards.items()
+    }
+    save_game_model(tmp_path / "model", model, imaps, sparsity_threshold=0.0)
+    loaded = load_game_model(tmp_path / "model", imaps, dtype=np.float64)
+    assert set(loaded.models) == {"global", "user", "mf"}
+    # MF factors survive exactly; GLM coefficients survive through name/term
+    np.testing.assert_allclose(
+        np.asarray(loaded.get("mf").row_factors),
+        np.asarray(model.get("mf").row_factors),
+        rtol=1e-12,
+    )
+
+
+def test_program_rejects_fe_shard_name_collision(rng):
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=2)
+    with pytest.raises(ValueError, match="unique"):
+        GameTrainProgram(
+            TaskType.LINEAR_REGRESSION,
+            FixedEffectStepSpec("user", opt),
+            (RandomEffectStepSpec("user", "userFeatures", opt),),
+        )
