@@ -76,6 +76,9 @@ class GLMDriverParams:
     #: (train_glm_grid) instead of the sequential warm-start fold; LBFGS/
     #: OWLQN only — see estimators.train_glm_grid
     grid_parallel: bool = False
+    #: JSON constraint list (reference Params.constraintString): maps with
+    #: name/term (+ optional lowerBound/upperBound), "*" wildcards allowed
+    coefficient_box_constraints: str | None = None
     input_format: str = "avro"
 
 
@@ -101,6 +104,17 @@ def _read_batch(path: str, fmt: str, shard_cfg, index_maps=None):
 
 
 def run(params: GLMDriverParams) -> GLMDriverResult:
+    if (
+        params.coefficient_box_constraints
+        and params.normalization != NormalizationType.NONE
+    ):
+        # bounds are stated in original feature space; the solvers work in
+        # normalized space (reference Params.scala:219). Checked before any
+        # data is read.
+        raise ValueError(
+            "coefficient box constraints cannot combine with feature "
+            "normalization"
+        )
     os.makedirs(params.output_dir, exist_ok=True)
     stage = DriverStage.INIT
     shard_cfg = {"features": FeatureShardConfiguration(feature_bags=("features",))}
@@ -141,6 +155,14 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
             tolerance=params.tolerance,
         )
 
+        lower_bounds = upper_bounds = None
+        if params.coefficient_box_constraints:
+            from photon_ml_tpu.io.constraints import build_bound_arrays
+
+            lower_bounds, upper_bounds = build_bound_arrays(
+                params.coefficient_box_constraints, index_maps["features"]
+            )
+
         def fit(b: LabeledPointBatch, lams) -> dict:
             trainer = train_glm_grid if params.grid_parallel else train_glm
             return trainer(
@@ -152,6 +174,8 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
                 normalization=norm,
                 intercept_index=intercept_index,
                 compute_variance=params.compute_variance,
+                lower_bounds=lower_bounds,
+                upper_bounds=upper_bounds,
             )
 
         with Timed("glm train"):
@@ -268,6 +292,10 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
     p.add_argument("--grid-parallel", action="store_true",
                    help="train all regularization weights simultaneously as "
                         "vmapped solver lanes (LBFGS/OWLQN only)")
+    p.add_argument("--coefficient-box-constraints",
+                   help='JSON constraint list, e.g. \'[{"name": "f0", '
+                        '"term": "", "lowerBound": 0}]\'; "*" wildcards '
+                        "match all features / all terms of a name")
     p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
     args = p.parse_args(argv)
     return run(
@@ -289,6 +317,7 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
             num_bootstraps=args.num_bootstraps,
             compute_variance=args.compute_variance,
             grid_parallel=args.grid_parallel,
+            coefficient_box_constraints=args.coefficient_box_constraints,
             input_format=args.input_format,
         )
     )

@@ -298,6 +298,8 @@ def train_glm_grid(
     normalization: NormalizationContext | None = None,
     intercept_index: int | None = None,
     compute_variance: bool = False,
+    lower_bounds=None,
+    upper_bounds=None,
 ) -> dict[float, GeneralizedLinearModel]:
     """Train the whole regularization grid *simultaneously* with vmapped
     solver lanes.
@@ -330,6 +332,11 @@ def train_glm_grid(
         elastic_net_alpha > 0.0
         or optimizer.optimizer_type == OptimizerType.OWLQN
     )
+    has_bounds = lower_bounds is not None or upper_bounds is not None
+    if use_owlqn and has_bounds:
+        raise ValueError(
+            "box constraints cannot combine with OWL-QN / elastic-net lanes"
+        )
     loss = loss_for_task(task)
     objective = GLMObjective(loss, l2_weight=0.0, normalization=normalization)
     dtype = batch.features.dtype
@@ -344,9 +351,16 @@ def train_glm_grid(
     else:
         l1s = jnp.full((len(lams),), optimizer.l1_weight, dtype)
 
+    bounds = (
+        jnp.asarray(lower_bounds, dtype) if lower_bounds is not None
+        else jnp.full((batch.dim,), -jnp.inf, dtype),
+        jnp.asarray(upper_bounds, dtype) if upper_bounds is not None
+        else jnp.full((batch.dim,), jnp.inf, dtype),
+    ) if has_bounds else None
     results = _jitted_grid_solve(
         objective, use_owlqn, optimizer.history,
         optimizer.max_iterations, optimizer.tolerance, batch, l2s, l1s,
+        bounds,
     )
     norm = objective.normalization
     diags = None
@@ -369,10 +383,11 @@ def train_glm_grid(
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _jitted_grid_solve(objective, use_owlqn, history, max_iter, tolerance,
-                       batch, l2v, l1v):
+                       batch, l2v, l1v, bounds=None):
     """Module-level jit: one compiled vmapped-grid program per
     (objective, optimizer statics) pair, reused across train_glm_grid calls
-    of the same shapes."""
+    of the same shapes. ``bounds``: optional (lower[d], upper[d]) box shared
+    by every lane."""
     from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
     from photon_ml_tpu.optim.owlqn import minimize_owlqn
 
@@ -392,6 +407,8 @@ def _jitted_grid_solve(objective, use_owlqn, history, max_iter, tolerance,
             )
         return minimize_lbfgs(
             vg, w0, max_iter=max_iter, tolerance=tolerance, history=history,
+            lower_bounds=None if bounds is None else bounds[0],
+            upper_bounds=None if bounds is None else bounds[1],
         )
 
     return jax.vmap(solve_one)(l2v, l1v)
@@ -414,6 +431,8 @@ def train_glm(
     normalization: NormalizationContext | None = None,
     intercept_index: int | None = None,
     compute_variance: bool = False,
+    lower_bounds=None,
+    upper_bounds=None,
 ) -> dict[float, GeneralizedLinearModel]:
     """Single-GLM regularization path with warm starts.
 
@@ -424,6 +443,17 @@ def train_glm(
     normalized space internally).
     """
     optimizer = optimizer or OptimizerConfig()
+    has_bounds = lower_bounds is not None or upper_bounds is not None
+    if has_bounds and (
+        elastic_net_alpha > 0.0
+        or optimizer.optimizer_type
+        not in (OptimizerType.LBFGS, OptimizerType.LBFGSB)
+    ):
+        # fail before any lambda trains; solve() enforces the same rule
+        raise ValueError(
+            "box constraints require the LBFGS family without L1 "
+            "(elastic_net_alpha must be 0)"
+        )
     loss = loss_for_task(task)
     models: dict[float, GeneralizedLinearModel] = {}
     w = jnp.zeros((batch.dim,), dtype=batch.features.dtype)
@@ -436,7 +466,11 @@ def train_glm(
             opt = dataclasses.replace(
                 optimizer.with_l1(l1), optimizer_type=OptimizerType.OWLQN
             )
-        result = solve(opt, objective.bind(batch), w)
+        result = solve(
+            opt, objective.bind(batch), w,
+            lower_bounds=None if lower_bounds is None else jnp.asarray(lower_bounds, batch.features.dtype),
+            upper_bounds=None if upper_bounds is None else jnp.asarray(upper_bounds, batch.features.dtype),
+        )
         w = result.coefficients
         norm = objective.normalization
         means = norm.to_model_space(w, intercept_index)

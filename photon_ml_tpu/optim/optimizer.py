@@ -63,13 +63,24 @@ def solve(
 ) -> SolverResult:
     """Run the configured solver on a bound objective. Pure; jit/vmap-safe."""
     t = config.optimizer_type
+    if (lower_bounds is not None or upper_bounds is not None) and t not in (
+        OptimizerType.LBFGS, OptimizerType.LBFGSB
+    ):
+        raise ValueError(
+            f"box constraints are only supported by the LBFGS family, not "
+            f"{t.name} (the reference projects in LBFGS, LBFGS.scala:70-76)"
+        )
     if t == OptimizerType.LBFGS:
+        # a constraint map makes plain LBFGS project onto the box after each
+        # step, exactly like the reference (LBFGS.scala:70-76)
         return minimize_lbfgs(
             objective.value_and_grad,
             w0,
             max_iter=config.max_iterations,
             history=config.history,
             tolerance=config.tolerance,
+            lower_bounds=lower_bounds,
+            upper_bounds=upper_bounds,
         )
     if t == OptimizerType.LBFGSB:
         if lower_bounds is None and upper_bounds is None:

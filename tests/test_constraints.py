@@ -1,0 +1,172 @@
+"""Coefficient box-constraint tests (reference GLMSuite constraint string,
+io/deprecated/ConstraintMapKeys.scala + createConstraintFeatureMap)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.estimators import train_glm, train_glm_grid
+from photon_ml_tpu.io.constraints import build_bound_arrays, parse_constraint_maps
+from photon_ml_tpu.io.index_map import IndexMap, feature_key
+from photon_ml_tpu.types import TaskType
+
+
+@pytest.fixture
+def imap():
+    keys = {feature_key(n, t) for n, t in
+            [("age", ""), ("height", "cm"), ("height", "in"), ("weight", "")]}
+    return IndexMap.from_keys(keys, add_intercept=True)
+
+
+class TestConstraintParsing:
+    def test_explicit_bounds(self, imap):
+        s = json.dumps([
+            {"name": "age", "term": "", "lowerBound": 0.0, "upperBound": 2.0},
+            {"name": "weight", "term": "", "lowerBound": -1.0},
+        ])
+        lower, upper = build_bound_arrays(s, imap)
+        j_age = imap.get_index(feature_key("age", ""))
+        j_w = imap.get_index(feature_key("weight", ""))
+        assert lower[j_age] == 0.0 and upper[j_age] == 2.0
+        assert lower[j_w] == -1.0 and np.isposinf(upper[j_w])
+        # unconstrained features stay unbounded
+        j_h = imap.get_index(feature_key("height", "cm"))
+        assert np.isneginf(lower[j_h]) and np.isposinf(upper[j_h])
+
+    def test_term_wildcard(self, imap):
+        s = json.dumps([{"name": "height", "term": "*", "upperBound": 5.0}])
+        lower, upper = build_bound_arrays(s, imap)
+        for term in ("cm", "in"):
+            j = imap.get_index(feature_key("height", term))
+            assert upper[j] == 5.0
+        assert np.isposinf(upper[imap.get_index(feature_key("age", ""))])
+
+    def test_full_wildcard_skips_intercept(self, imap):
+        from photon_ml_tpu.io.index_map import INTERCEPT_KEY
+
+        s = json.dumps([{"name": "*", "term": "*", "lowerBound": -3.0,
+                         "upperBound": 3.0}])
+        lower, upper = build_bound_arrays(s, imap)
+        ji = imap.get_index(INTERCEPT_KEY)
+        assert np.isneginf(lower[ji]) and np.isposinf(upper[ji])
+        mask = np.ones(imap.size, dtype=bool)
+        mask[ji] = False
+        assert (lower[mask] == -3.0).all() and (upper[mask] == 3.0).all()
+
+    def test_invalid_specs_rejected(self, imap):
+        with pytest.raises(ValueError, match="finite"):
+            parse_constraint_maps(json.dumps([{"name": "a", "term": ""}]))
+        with pytest.raises(ValueError, match="lower bound"):
+            parse_constraint_maps(json.dumps(
+                [{"name": "a", "term": "", "lowerBound": 2, "upperBound": 1}]
+            ))
+        with pytest.raises(ValueError, match="wildcard term"):
+            build_bound_arrays(
+                json.dumps([{"name": "*", "term": "x", "lowerBound": 0}]), imap
+            )
+        with pytest.raises(ValueError, match="only constraint"):
+            build_bound_arrays(json.dumps([
+                {"name": "*", "term": "*", "lowerBound": 0},
+                {"name": "age", "term": "", "upperBound": 1},
+            ]), imap)
+        with pytest.raises(ValueError, match="conflicting"):
+            build_bound_arrays(json.dumps([
+                {"name": "height", "term": "*", "upperBound": 1},
+                {"name": "height", "term": "cm", "lowerBound": 0},
+            ]), imap)
+
+
+class TestConstrainedTraining:
+    def _batch(self, rng, n=300, d=6):
+        w = rng.normal(size=d)
+        x = rng.normal(size=(n, d))
+        y = x @ w + 0.1 * rng.normal(size=n)
+        return LabeledPointBatch.create(x, y), w
+
+    def test_bounds_respected_sequential_and_grid(self, rng):
+        batch, w_true = self._batch(rng)
+        lower = np.full(6, -0.1)
+        upper = np.full(6, 0.1)
+        for trainer in (train_glm, train_glm_grid):
+            models = trainer(
+                batch, TaskType.LINEAR_REGRESSION,
+                regularization_weights=[0.01],
+                lower_bounds=lower, upper_bounds=upper,
+            )
+            w = np.asarray(models[0.01].coefficients.means)
+            assert (w >= lower - 1e-9).all() and (w <= upper + 1e-9).all()
+            # some coefficients must sit ON the box (|w_true| > 0.1 almost surely)
+            assert np.any(np.isclose(np.abs(w), 0.1, atol=1e-6))
+
+    def test_bounds_with_l1_rejected(self, rng):
+        batch, _ = self._batch(rng)
+        for trainer in (train_glm, train_glm_grid):
+            with pytest.raises(ValueError, match="constraints"):
+                trainer(
+                    batch, TaskType.LINEAR_REGRESSION,
+                    regularization_weights=[1.0], elastic_net_alpha=0.5,
+                    lower_bounds=np.zeros(6), upper_bounds=np.ones(6),
+                )
+
+
+def test_glm_driver_constraints_end_to_end(tmp_path):
+    from photon_ml_tpu.cli import glm_driver
+    from photon_ml_tpu.io.model_io import read_scores  # noqa: F401
+
+    rng = np.random.default_rng(0)
+    n, d = 200, 4
+    lines = []
+    for _ in range(n):
+        x = rng.normal(size=d)
+        y = x @ np.array([2.0, -2.0, 0.5, 0.0]) + 0.05 * rng.normal()
+        lines.append(f"{y:.5f} " + " ".join(f"{j+1}:{x[j]:.5f}" for j in range(d)))
+    (tmp_path / "train").mkdir()
+    (tmp_path / "train" / "d.libsvm").write_text("\n".join(lines))
+
+    glm_driver.main([
+        "--input-data-path", str(tmp_path / "train" / "d.libsvm"),
+        "--output-dir", str(tmp_path / "out"),
+        "--task-type", "LINEAR_REGRESSION",
+        "--regularization-weights", "0.01",
+        "--input-format", "libsvm",
+        "--coefficient-box-constraints",
+        '[{"name": "*", "term": "*", "lowerBound": -1, "upperBound": 1}]',
+    ])
+    # the learned coefficients in the text dump must respect the box
+    text = (tmp_path / "out" / "models-text" / "0.01.txt").read_text()
+    for line in text.strip().splitlines():
+        name, term, value = line.split("\t")
+        if name != "(INTERCEPT)":
+            assert -1.0 - 1e-6 <= float(value) <= 1.0 + 1e-6
+
+    # constraints + normalization must be rejected
+    with pytest.raises(ValueError, match="normalization"):
+        glm_driver.main([
+            "--input-data-path", str(tmp_path / "train" / "d.libsvm"),
+            "--output-dir", str(tmp_path / "out2"),
+            "--task-type", "LINEAR_REGRESSION",
+            "--input-format", "libsvm",
+            "--normalization", "STANDARDIZATION",
+            "--coefficient-box-constraints",
+            '[{"name": "1", "term": "", "lowerBound": 0}]',
+        ])
+
+
+def test_bounds_rejected_for_non_lbfgs_solvers(rng):
+    """solve() and train_glm fail loudly when bounds meet OWLQN/TRON."""
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+
+    w = rng.normal(size=4)
+    x = rng.normal(size=(100, 4))
+    y = x @ w
+    batch = LabeledPointBatch.create(x, y)
+    for opt_type in (OptimizerType.OWLQN, OptimizerType.TRON):
+        with pytest.raises(ValueError, match="LBFGS family|constraints"):
+            train_glm(
+                batch, TaskType.LINEAR_REGRESSION,
+                optimizer=OptimizerConfig(optimizer_type=opt_type),
+                regularization_weights=[1.0],
+                lower_bounds=np.zeros(4), upper_bounds=np.ones(4),
+            )
