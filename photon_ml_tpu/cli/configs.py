@@ -31,10 +31,14 @@ from photon_ml_tpu.projector.projectors import ProjectorType
 
 
 class ModelOutputMode(enum.Enum):
-    """Reference: io/ModelOutputMode.scala."""
+    """Reference: io/ModelOutputMode.scala — NONE (logs only), BEST (best
+    model only), EXPLICIT (best + the explicit λ-grid models), TUNED (best +
+    hyperparameter-tuning models), ALL (everything)."""
 
     NONE = "NONE"
     BEST = "BEST"
+    EXPLICIT = "EXPLICIT"
+    TUNED = "TUNED"
     ALL = "ALL"
 
 

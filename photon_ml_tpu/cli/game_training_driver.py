@@ -304,7 +304,7 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
         elif best_index < 0 or first_evaluator.better_than(metric, best_metric):
             best_index, best_metric = i, metric
 
-        if params.model_output_mode == ModelOutputMode.ALL:
+        if params.model_output_mode in (ModelOutputMode.ALL, ModelOutputMode.EXPLICIT):
             save_game_model(
                 os.path.join(out, "models", str(i)),
                 result.best_model,
@@ -322,12 +322,18 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
         ],
     }
 
+    # Save the grid best immediately (a later tuning failure must not cost
+    # the already-trained model); if a tuned candidate wins the
+    # best-over-all selection below it overwrites this directory
+    # (reference GameTrainingDriver.selectModels:672-691).
+    best_result = results[best_index][1]
+    best_reg_weights = grid[best_index]
     if params.model_output_mode != ModelOutputMode.NONE:
         save_game_model(
             os.path.join(out, "best"),
-            results[best_index][1].best_model,
+            best_result.best_model,
             train.index_maps,
-            optimization_configurations={"regWeights": grid[best_index]},
+            optimization_configurations={"regWeights": best_reg_weights},
         )
 
     if params.hyperparameter_tuning != HyperparameterTuningMode.NONE:
@@ -351,10 +357,45 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
                     for rw, r in results
                     if not np.isnan(r.best_metric)
                 ],
+                # only TUNED/ALL need every candidate's model; the winner is
+                # tracked O(1) either way (TuningResult.best_result)
+                keep_models=params.model_output_mode
+                in (ModelOutputMode.ALL, ModelOutputMode.TUNED),
             )
         save_tuned_config(tuned, os.path.join(out, "tuned-hyperparameters.json"))
         summary["tuned_reg_weights"] = tuned.best_reg_weights
         summary["tuned_metric"] = tuned.best_value
+        if params.model_output_mode in (ModelOutputMode.ALL, ModelOutputMode.TUNED):
+            for j, (reg, r) in enumerate(tuned.tuned_results):
+                save_game_model(
+                    os.path.join(out, "models-tuned", str(j)),
+                    r.best_model,
+                    train.index_maps,
+                    optimization_configurations={"regWeights": reg},
+                )
+        # best over explicit + tuned (first evaluator decides)
+        if first_evaluator is not None and tuned.best_result is not None:
+            reg, r = tuned.best_result
+            if not np.isnan(r.best_metric) and first_evaluator.better_than(
+                r.best_metric, best_metric
+            ):
+                best_metric, best_result, best_reg_weights = (
+                    r.best_metric, r, reg
+                )
+                summary["best_metric"] = best_metric
+                summary["best_reg_weights"] = best_reg_weights
+                # the grid index no longer identifies the winner
+                summary["best_configuration_index"] = None
+                summary["best_is_tuned"] = True
+                if params.model_output_mode != ModelOutputMode.NONE:
+                    save_game_model(
+                        os.path.join(out, "best"),
+                        best_result.best_model,
+                        train.index_maps,
+                        optimization_configurations={
+                            "regWeights": best_reg_weights
+                        },
+                    )
 
     summary["timings"] = timing_summary()
     with open(os.path.join(out, "training-summary.json"), "w") as f:

@@ -40,6 +40,12 @@ class TuningResult:
     best_reg_weights: dict[str, float]
     best_value: float
     search: SearchResult
+    #: (reg weights, fit result) per evaluated candidate — populated only
+    #: with keep_models=True (ModelOutputMode.TUNED/ALL)
+    tuned_results: list = dataclasses.field(default_factory=list)
+    #: (reg weights, fit result) of the best tuning candidate — always
+    #: tracked (O(1) memory) so best-over-all selection never needs the list
+    best_result: tuple | None = None
 
 
 @dataclasses.dataclass
@@ -84,6 +90,7 @@ class GameHyperparameterTuner:
         *,
         num_iterations: int = 10,
         prior_observations: Sequence[tuple[Mapping[str, float], float]] = (),
+        keep_models: bool = False,
     ) -> TuningResult:
         from photon_ml_tpu.evaluation.evaluators import parse_evaluator
 
@@ -91,13 +98,20 @@ class GameHyperparameterTuner:
             raise ValueError("hyperparameter tuning needs validation_evaluators")
         evaluator = parse_evaluator(self.estimator.validation_evaluators[0])
         sign = -1.0 if evaluator.larger_is_better else 1.0
+        tuned_results: list = []
+        best_seen: list = [None, np.inf]  # (reg, result), signed value
 
         def evaluate(candidate: np.ndarray) -> float:
             values = self.rescaling.to_hyperparameters(candidate)
             reg = dict(zip(self._coord_ids, values.tolist()))
             est = self._apply(reg)
             result = est.fit(dataset, validation_dataset=validation_dataset)
-            return sign * float(result.best_metric)
+            if keep_models:
+                tuned_results.append((reg, result))
+            value = sign * float(result.best_metric)
+            if not np.isnan(value) and value < best_seen[1]:
+                best_seen[0], best_seen[1] = (reg, result), value
+            return value
 
         if self.mode == HyperparameterTuningMode.BAYESIAN:
             search: RandomSearch = GaussianProcessSearch(self.rescaling.dim, self.seed)
@@ -116,6 +130,8 @@ class GameHyperparameterTuner:
             best_reg_weights=dict(zip(self._coord_ids, best_values.tolist())),
             best_value=sign * result.best_value,
             search=result,
+            tuned_results=tuned_results,
+            best_result=best_seen[0],
         )
 
 

@@ -281,6 +281,36 @@ class TestGameTrainingDriverInteg:
         coef = np.asarray(m.get("fe").glm.coefficients.means)
         assert float(np.abs(coef).max()) < 0.15
 
+    def test_model_output_mode_explicit_and_tuned(self, music_data, tmp_path):
+        """Reference ModelOutputMode semantics: EXPLICIT saves best + the
+        λ-grid models; TUNED saves best + the tuning-trained models; best is
+        selected over explicit AND tuned candidates (selectModels:672-691)."""
+        out_e = tmp_path / "explicit"
+        _train(music_data, out_e, [
+            "--coordinate-configurations",
+            "name=fe,feature.shard=global,reg.weights=0.1|1,max.iter=25",
+            "--model-output-mode", "EXPLICIT",
+        ])
+        assert (out_e / "best" / "model-metadata.json").exists()
+        assert (out_e / "models" / "0").is_dir() and (out_e / "models" / "1").is_dir()
+        assert not (out_e / "models-tuned").exists()
+
+        out_t = tmp_path / "tuned"
+        s = _train(music_data, out_t, [
+            "--coordinate-configurations",
+            "name=fe,feature.shard=global,reg.weights=0.1|1,max.iter=25",
+            "--model-output-mode", "TUNED",
+            "--hyperparameter-tuning", "RANDOM",
+            "--hyperparameter-tuning-iter", "2",
+        ])
+        assert (out_t / "best" / "model-metadata.json").exists()
+        assert not (out_t / "models").exists()  # explicit grid not saved
+        tuned_dirs = list((out_t / "models-tuned").iterdir())
+        assert len(tuned_dirs) == 2
+        # best over explicit + tuned candidates
+        assert np.isfinite(s["best_metric"])
+        assert s["best_metric"] <= s["tuned_metric"] + 1e-9
+
     def test_checkpoint_dir_and_profile_dir(self, music_data, tmp_path):
         """--checkpoint-dir writes per-config checkpoints; a rerun with the
         same args resumes (same final metric); --profile-dir captures a
