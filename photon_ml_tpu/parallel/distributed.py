@@ -34,6 +34,7 @@ from typing import Mapping, Sequence
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.algorithm.coordinates import solve_entity_bucket
@@ -536,6 +537,75 @@ def state_to_game_model(
             task=program.task,
         )
     return GameModel(models=models)
+
+
+def game_model_to_state(
+    program: GameTrainProgram,
+    model,
+    dataset: GameDataset,
+    *,
+    intercept_index: int | None = None,
+) -> GameTrainState:
+    """Inverse of :func:`state_to_game_model`: warm-start the fused step from
+    a (possibly loaded-from-Avro) GameModel.
+
+    Coefficient tables are re-aligned to the dataset's entity vocabs by key,
+    so a model trained/saved against one dataset warm-starts training on
+    another whose vocab ordering differs; entities absent from the model
+    start at zero. The FE vector is converted into normalized space (the
+    step's warm-start convention).
+    """
+    fe_model = model.get(program.fe.feature_shard_id)
+    norm = program._fe_objective.normalization
+    fe_w = norm.from_model_space(
+        jnp.asarray(fe_model.glm.coefficients.means), intercept_index
+    )
+
+    def align(table, model_keys, vocab, coordinate: str) -> Array:
+        table = np.asarray(table)
+        row_of = {k: i for i, k in enumerate(np.asarray(model_keys).tolist())}
+        pairs = [
+            (i, row_of[key])
+            for i, key in enumerate(np.asarray(vocab).tolist())
+            if key in row_of
+        ]
+        if not pairs and len(row_of) and len(vocab):
+            # a warm start that matches nothing is almost certainly the wrong
+            # model/vocab pairing — degrade loudly, not to a silent cold start
+            raise ValueError(
+                f"warm-start model for coordinate '{coordinate}' shares no "
+                f"entity keys with the dataset vocab ({len(row_of)} model "
+                f"keys vs {len(vocab)} vocab keys) — wrong model directory "
+                "or entity namespace?"
+            )
+        out = np.zeros((len(vocab), table.shape[1]), dtype=table.dtype)
+        if pairs:
+            vi, mi = (np.asarray(p, dtype=np.intp) for p in zip(*pairs))
+            out[vi] = table[mi]
+        return jnp.asarray(out)
+
+    re_tables = {}
+    for spec in program.re_specs:
+        m = model.get(spec.re_type)
+        re_tables[spec.re_type] = align(
+            m.coefficients, m.entity_keys,
+            dataset.entity_vocabs[spec.re_type], spec.re_type,
+        )
+    mf_rows, mf_cols = {}, {}
+    for spec in program.mf_specs:
+        m = model.get(spec.name)
+        mf_rows[spec.name] = align(
+            m.row_factors, m.row_keys,
+            dataset.entity_vocabs[spec.row_effect_type], spec.name,
+        )
+        mf_cols[spec.name] = align(
+            m.col_factors, m.col_keys,
+            dataset.entity_vocabs[spec.col_effect_type], spec.name,
+        )
+    return GameTrainState(
+        fe_coefficients=fe_w, re_tables=re_tables,
+        mf_rows=mf_rows, mf_cols=mf_cols,
+    )
 
 
 def train_distributed(

@@ -228,3 +228,56 @@ def test_program_rejects_fe_shard_name_collision(rng):
             FixedEffectStepSpec("user", opt),
             (RandomEffectStepSpec("user", "userFeatures", opt),),
         )
+
+
+def test_game_model_to_state_warm_start(rng, tmp_path):
+    """Save a fused-trained model, reload it, warm-start continued training
+    on a dataset whose vocab ORDER differs — the first continued sweep must
+    start from the saved solution (loss immediately at the converged level)."""
+    from photon_ml_tpu.io.index_map import IndexMap, feature_key
+    from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+    from photon_ml_tpu.parallel.distributed import (
+        game_model_to_state,
+        state_to_game_model,
+    )
+
+    dataset, re_datasets = _toy_game_data(rng)
+    program = _program(max_iter=8)
+    state, losses = train_distributed(program, dataset, re_datasets, num_iterations=3)
+    model = state_to_game_model(program, state, dataset)
+
+    imaps = {
+        shard: IndexMap.from_keys(
+            {feature_key(f"c{j}", "") for j in range(arr.shape[1])},
+            add_intercept=False,
+        )
+        for shard, arr in dataset.feature_shards.items()
+    }
+    save_game_model(tmp_path / "m", model, imaps, sparsity_threshold=0.0)
+    loaded = load_game_model(tmp_path / "m", imaps, dtype=np.float64)
+
+    # same samples, but entity vocabs supplied in a shuffled order
+    shuffled_vocabs = {
+        t: np.array(sorted(v, key=lambda s: s[::-1]))
+        for t, v in dataset.entity_vocabs.items()
+    }
+    ds2 = build_game_dataset(
+        labels=np.asarray(dataset.labels),
+        feature_shards={k: np.asarray(v) for k, v in dataset.feature_shards.items()},
+        entity_keys={
+            t: np.asarray(dataset.entity_vocabs[t])[np.asarray(dataset.entity_idx[t])]
+            for t in dataset.entity_vocabs
+        },
+        entity_vocabs=shuffled_vocabs,
+        dtype=np.float64,
+    )
+    re2 = {
+        t: build_random_effect_dataset(ds2, t, "per_entity", bucket_sizes=(64,))
+        for t in ("user", "item")
+    }
+    warm = game_model_to_state(program, loaded, ds2)
+    _, losses2 = train_distributed(
+        program, ds2, re2, state=warm, num_iterations=1
+    )
+    # warm start must land at (or below) the converged loss, not the cold one
+    assert losses2[0] <= losses[-1] + 1e-6, (losses, losses2)
