@@ -18,6 +18,7 @@ by this module is directory-compatible with one saved by the reference.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Iterable, Mapping
 
@@ -26,12 +27,14 @@ import numpy as np
 
 from photon_ml_tpu.io import avro as avro_io
 from photon_ml_tpu.io import photon_schemas as schemas
-from photon_ml_tpu.io.index_map import IndexMap, split_feature_key
+from photon_ml_tpu.io.index_map import IndexMap, feature_key, split_feature_key
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
 from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.types import TaskType
+
+logger = logging.getLogger(__name__)
 
 FIXED_EFFECT = "fixed-effect"
 RANDOM_EFFECT = "random-effect"
@@ -96,6 +99,14 @@ def _glm_to_record(
     return record
 
 
+def _has_part_files(directory: str) -> bool:
+    """True if the directory holds at least one .avro part file (Spark may
+    leave empty dirs with only _SUCCESS markers for untrained coordinates)."""
+    return os.path.isdir(directory) and any(
+        f.endswith(".avro") for f in os.listdir(directory)
+    )
+
+
 def _write_chunked(
     directory: str, schema: dict, records: Iterable[dict], per_file: int
 ) -> None:
@@ -120,8 +131,6 @@ def _write_chunked(
 def _record_to_coefficients(record: dict, index_map: IndexMap, dtype) -> Coefficients:
     d = index_map.size
     means = np.zeros((d,), dtype=dtype)
-    from photon_ml_tpu.io.index_map import feature_key
-
     for ntv in record["means"]:
         j = index_map.get_index(feature_key(ntv["name"], ntv.get("term", "")))
         if j >= 0:
@@ -285,7 +294,24 @@ def load_game_model(
                     f"missing feature shard definition '{shard_id}' for coordinate '{name}'"
                 )
             index_map = index_maps[shard_id]
-            records = list(avro_io.read_directory(os.path.join(base, COEFFICIENTS)))
+            coeff_dir = os.path.join(base, COEFFICIENTS)
+            if not _has_part_files(coeff_dir):
+                # a random-effect coordinate with no trained entities (seen
+                # in reference fixtures): empty table, still scorable (every
+                # entity is "unseen" and scores 0)
+                logger.warning(
+                    "random-effect coordinate '%s' has no coefficients "
+                    "directory; loading as an empty (0-entity) model", name,
+                )
+                models[name] = RandomEffectModel(
+                    coefficients=jnp.zeros((0, index_map.size), dtype=dtype),
+                    entity_keys=np.asarray([], dtype=str),
+                    random_effect_type=re_type,
+                    feature_shard_id=shard_id,
+                    task=task,
+                )
+                continue
+            records = list(avro_io.read_directory(coeff_dir))
             keys = sorted(r["modelId"] for r in records)
             row = {k: i for i, k in enumerate(keys)}
             table = np.zeros((len(keys), index_map.size), dtype=dtype)
@@ -337,6 +363,45 @@ def load_game_model(
     if not models:
         raise ValueError(f"No models could be loaded from given path: {models_dir}")
     return GameModel(models=models)
+
+
+def index_maps_from_model(
+    models_dir: str | os.PathLike,
+) -> dict[str, IndexMap]:
+    """Reconstruct per-shard index maps from a saved model's own coefficient
+    records (name/term keys).
+
+    The reference persists its index maps as PalDB stores, which only the
+    JVM can read; the model files themselves carry every feature key, so a
+    reference-written model directory becomes loadable without its stores.
+    Column order follows IndexMap.from_keys (sorted), which both loaders
+    use consistently.
+    """
+    models_dir = str(models_dir)
+    keys_per_shard: dict[str, set[str]] = {}
+
+    def scan(base: str, shard_line: int) -> None:
+        if not os.path.isdir(base):
+            return
+        for name in sorted(os.listdir(base)):
+            sub = os.path.join(base, name)
+            with open(os.path.join(sub, ID_INFO)) as f:
+                shard_id = f.read().strip().splitlines()[shard_line]
+            keys = keys_per_shard.setdefault(shard_id, set())
+            coeff_dir = os.path.join(sub, COEFFICIENTS)
+            if not _has_part_files(coeff_dir):
+                continue  # empty coordinate (seen in reference fixtures)
+            for record in avro_io.read_directory(coeff_dir):
+                for field in ("means", "variances"):
+                    for ntv in record.get(field) or ():
+                        keys.add(feature_key(ntv["name"], ntv.get("term") or ""))
+
+    scan(os.path.join(models_dir, FIXED_EFFECT), 0)
+    scan(os.path.join(models_dir, RANDOM_EFFECT), 1)
+    return {
+        shard: IndexMap.from_keys(keys, add_intercept=False)
+        for shard, keys in keys_per_shard.items()
+    }
 
 
 def write_glm_text(

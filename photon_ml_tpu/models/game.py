@@ -91,6 +91,11 @@ def score_random_effect(table: Array, features: Array, entity_idx: Array) -> Arr
 
     The gather + einsum that replaces RandomEffectModel.scala's scoring join.
     """
+    if table.shape[0] == 0:
+        # 0-entity model (e.g. an untrained coordinate loaded from disk):
+        # every sample is "unseen" — and a gather from an empty table is a
+        # compile error, not a no-op
+        return jnp.zeros(entity_idx.shape, dtype=features.dtype)
     safe_idx = jnp.maximum(entity_idx, 0)
     rows = table[safe_idx]
     scores = jnp.einsum("nd,nd->n", features, rows)

@@ -77,6 +77,10 @@ def score_matrix_factorization(
     Samples whose row OR col entity is unseen (idx < 0) score 0 — the same
     missing-entity semantics as RandomEffectModel scoring.
     """
+    if row_factors.shape[0] == 0 or col_factors.shape[0] == 0:
+        # empty factor table: every sample is "unseen" (gathers from empty
+        # tables are compile errors)
+        return jnp.zeros(row_idx.shape, dtype=row_factors.dtype)
     both = (row_idx >= 0) & (col_idx >= 0)
     rows = row_factors[jnp.maximum(row_idx, 0)]
     cols = col_factors[jnp.maximum(col_idx, 0)]
