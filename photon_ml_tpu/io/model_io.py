@@ -101,9 +101,11 @@ def _glm_to_record(
 
 def _has_part_files(directory: str) -> bool:
     """True if the directory holds at least one .avro part file (Spark may
-    leave empty dirs with only _SUCCESS markers for untrained coordinates)."""
+    leave empty dirs with only _SUCCESS markers for untrained coordinates).
+    Same filter as avro.read_directory, so emptiness test and reader agree."""
     return os.path.isdir(directory) and any(
-        f.endswith(".avro") for f in os.listdir(directory)
+        f.endswith(".avro") and not f.startswith(("_", "."))
+        for f in os.listdir(directory)
     )
 
 
@@ -131,15 +133,17 @@ def _write_chunked(
 def _record_to_coefficients(record: dict, index_map: IndexMap, dtype) -> Coefficients:
     d = index_map.size
     means = np.zeros((d,), dtype=dtype)
+    # `or ""`: a null term must map to the empty term, matching
+    # index_maps_from_model's key harvesting
     for ntv in record["means"]:
-        j = index_map.get_index(feature_key(ntv["name"], ntv.get("term", "")))
+        j = index_map.get_index(feature_key(ntv["name"], ntv.get("term") or ""))
         if j >= 0:
             means[j] = ntv["value"]
     variances = None
     if record.get("variances"):
         variances = np.zeros((d,), dtype=dtype)
         for ntv in record["variances"]:
-            j = index_map.get_index(feature_key(ntv["name"], ntv.get("term", "")))
+            j = index_map.get_index(feature_key(ntv["name"], ntv.get("term") or ""))
             if j >= 0:
                 variances[j] = ntv["value"]
     return Coefficients(
@@ -241,12 +245,19 @@ def save_game_model(
 
 def load_game_model(
     models_dir: str | os.PathLike,
-    index_maps: Mapping[str, IndexMap],
+    index_maps: Mapping[str, IndexMap] | None = None,
     *,
     coordinates_to_load: set[str] | None = None,
     dtype=np.float32,
 ) -> GameModel:
-    """Load a GAME model saved in the reference layout."""
+    """Load a GAME model saved in the reference layout.
+
+    ``index_maps=None`` reconstructs per-shard index maps from the model's
+    own coefficient records in the same pass (each part file is decoded
+    exactly once; the keys come from the cached records rather than a
+    second read) — the way to load a reference-written model whose index
+    stores are JVM-only PalDB.
+    """
     models_dir = str(models_dir)
     meta_path = os.path.join(models_dir, METADATA_FILE)
     task = TaskType.NONE
@@ -254,6 +265,43 @@ def load_game_model(
         with open(meta_path) as f:
             meta = json.load(f)
         task = TaskType(meta.get("modelType", "NONE"))
+
+    record_cache: dict[str, list[dict]] = {}
+
+    def read_records(coeff_dir: str) -> list[dict]:
+        if coeff_dir not in record_cache:
+            record_cache[coeff_dir] = list(avro_io.read_directory(coeff_dir))
+        return record_cache[coeff_dir]
+
+    if index_maps is None:
+        # single pass: decode every coordinate's records once (cached for
+        # the table-filling loops below) and harvest per-shard feature keys
+        keys_per_shard: dict[str, set[str]] = {}
+
+        def harvest(base_dir: str, shard_line: int) -> None:
+            if not os.path.isdir(base_dir):
+                return
+            for name in sorted(os.listdir(base_dir)):
+                sub = os.path.join(base_dir, name)
+                with open(os.path.join(sub, ID_INFO)) as f:
+                    shard_id = f.read().strip().splitlines()[shard_line]
+                keys = keys_per_shard.setdefault(shard_id, set())
+                coeff_dir = os.path.join(sub, COEFFICIENTS)
+                if not _has_part_files(coeff_dir):
+                    continue
+                for record in read_records(coeff_dir):
+                    for field in ("means", "variances"):
+                        for ntv in record.get(field) or ():
+                            keys.add(
+                                feature_key(ntv["name"], ntv.get("term") or "")
+                            )
+
+        harvest(os.path.join(models_dir, FIXED_EFFECT), 0)
+        harvest(os.path.join(models_dir, RANDOM_EFFECT), 1)
+        index_maps = {
+            shard: IndexMap.from_keys(keys, add_intercept=False)
+            for shard, keys in keys_per_shard.items()
+        }
 
     models: dict[str, object] = {}
 
@@ -270,7 +318,7 @@ def load_game_model(
                     f"missing feature shard definition '{shard_id}' for coordinate '{name}'"
                 )
             index_map = index_maps[shard_id]
-            records = list(avro_io.read_directory(os.path.join(base, COEFFICIENTS)))
+            records = read_records(os.path.join(base, COEFFICIENTS))
             if len(records) != 1:
                 raise ValueError(f"expected 1 fixed-effect record for '{name}', got {len(records)}")
             record = records[0]
@@ -311,7 +359,7 @@ def load_game_model(
                     task=task,
                 )
                 continue
-            records = list(avro_io.read_directory(coeff_dir))
+            records = read_records(coeff_dir)
             keys = sorted(r["modelId"] for r in records)
             row = {k: i for i, k in enumerate(keys)}
             table = np.zeros((len(keys), index_map.size), dtype=dtype)
