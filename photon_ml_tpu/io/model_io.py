@@ -276,32 +276,7 @@ def load_game_model(
     if index_maps is None:
         # single pass: decode every coordinate's records once (cached for
         # the table-filling loops below) and harvest per-shard feature keys
-        keys_per_shard: dict[str, set[str]] = {}
-
-        def harvest(base_dir: str, shard_line: int) -> None:
-            if not os.path.isdir(base_dir):
-                return
-            for name in sorted(os.listdir(base_dir)):
-                sub = os.path.join(base_dir, name)
-                with open(os.path.join(sub, ID_INFO)) as f:
-                    shard_id = f.read().strip().splitlines()[shard_line]
-                keys = keys_per_shard.setdefault(shard_id, set())
-                coeff_dir = os.path.join(sub, COEFFICIENTS)
-                if not _has_part_files(coeff_dir):
-                    continue
-                for record in read_records(coeff_dir):
-                    for field in ("means", "variances"):
-                        for ntv in record.get(field) or ():
-                            keys.add(
-                                feature_key(ntv["name"], ntv.get("term") or "")
-                            )
-
-        harvest(os.path.join(models_dir, FIXED_EFFECT), 0)
-        harvest(os.path.join(models_dir, RANDOM_EFFECT), 1)
-        index_maps = {
-            shard: IndexMap.from_keys(keys, add_intercept=False)
-            for shard, keys in keys_per_shard.items()
-        }
+        index_maps = _harvest_index_maps(models_dir, read_records)
 
     models: dict[str, object] = {}
 
@@ -413,19 +388,9 @@ def load_game_model(
     return GameModel(models=models)
 
 
-def index_maps_from_model(
-    models_dir: str | os.PathLike,
-) -> dict[str, IndexMap]:
-    """Reconstruct per-shard index maps from a saved model's own coefficient
-    records (name/term keys).
-
-    The reference persists its index maps as PalDB stores, which only the
-    JVM can read; the model files themselves carry every feature key, so a
-    reference-written model directory becomes loadable without its stores.
-    Column order follows IndexMap.from_keys (sorted), which both loaders
-    use consistently.
-    """
-    models_dir = str(models_dir)
+def _harvest_index_maps(models_dir: str, read_records) -> dict[str, IndexMap]:
+    """Per-shard index maps from a model's own coefficient records
+    (``read_records(coeff_dir) -> list[dict]`` supplies/caches decoding)."""
     keys_per_shard: dict[str, set[str]] = {}
 
     def scan(base: str, shard_line: int) -> None:
@@ -439,7 +404,7 @@ def index_maps_from_model(
             coeff_dir = os.path.join(sub, COEFFICIENTS)
             if not _has_part_files(coeff_dir):
                 continue  # empty coordinate (seen in reference fixtures)
-            for record in avro_io.read_directory(coeff_dir):
+            for record in read_records(coeff_dir):
                 for field in ("means", "variances"):
                     for ntv in record.get(field) or ():
                         keys.add(feature_key(ntv["name"], ntv.get("term") or ""))
@@ -450,6 +415,24 @@ def index_maps_from_model(
         shard: IndexMap.from_keys(keys, add_intercept=False)
         for shard, keys in keys_per_shard.items()
     }
+
+
+def index_maps_from_model(
+    models_dir: str | os.PathLike,
+) -> dict[str, IndexMap]:
+    """Reconstruct per-shard index maps from a saved model's own coefficient
+    records (name/term keys).
+
+    The reference persists its index maps as PalDB stores, which only the
+    JVM can read; the model files themselves carry every feature key, so a
+    reference-written model directory becomes loadable without its stores.
+    Column order follows IndexMap.from_keys (sorted), which both loaders
+    use consistently. (``load_game_model(dir)`` with no maps does this in
+    the same decode pass as the load itself.)
+    """
+    return _harvest_index_maps(
+        str(models_dir), lambda d: avro_io.read_directory(d)
+    )
 
 
 def write_glm_text(
