@@ -58,20 +58,34 @@ def run(
             if fname.endswith(".keys"):
                 shard = fname[: -len(".keys")]
                 index_maps[shard] = IndexMap.load(index_maps_dir, shard)
-    if feature_shards is None:
-        from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
+    if index_maps:
+        if feature_shards is None:
+            # shard name == bag name is OUR training driver's convention,
+            # only trustworthy for maps its stores produced
+            from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
 
-        if not index_maps:
+            feature_shards = {
+                shard: FeatureShardConfiguration(feature_bags=(shard, "features"))
+                for shard in index_maps
+            }
+        with Timed("load model"):
+            model = load_game_model(model_input_dir, index_maps)
+    else:
+        # no saved stores (e.g. a reference-written model whose index maps
+        # are JVM-only PalDB): one pass rebuilds maps from the model's own
+        # records while loading. Shard->bag mapping cannot be guessed for a
+        # foreign model, so explicit shard configs are required.
+        if feature_shards is None:
             raise ValueError(
-                "no feature shard configurations and no saved index maps found"
+                "no saved index-map stores next to this model: pass "
+                "--feature-shard-configurations mapping each model shard id "
+                "to the data's feature bags"
             )
-        feature_shards = {
-            shard: FeatureShardConfiguration(feature_bags=(shard, "features"))
-            for shard in index_maps
-        }
+        from photon_ml_tpu.io.model_io import load_game_model_and_index_maps
 
-    with Timed("load model"):
-        model = load_game_model(model_input_dir, index_maps)
+        logger.info("no index-map stores found; rebuilding from model records")
+        with Timed("load model"):
+            model, index_maps = load_game_model_and_index_maps(model_input_dir)
     entity_vocabs: dict[str, np.ndarray] = {}
     for m in model.models.values():
         if isinstance(m, RandomEffectModel):

@@ -258,6 +258,22 @@ def load_game_model(
     second read) — the way to load a reference-written model whose index
     stores are JVM-only PalDB.
     """
+    return load_game_model_and_index_maps(
+        models_dir, index_maps,
+        coordinates_to_load=coordinates_to_load, dtype=dtype,
+    )[0]
+
+
+def load_game_model_and_index_maps(
+    models_dir: str | os.PathLike,
+    index_maps: Mapping[str, IndexMap] | None = None,
+    *,
+    coordinates_to_load: set[str] | None = None,
+    dtype=np.float32,
+) -> tuple[GameModel, dict[str, IndexMap]]:
+    """Like :func:`load_game_model` but also returns the index maps in use —
+    callers that need the maps afterwards (e.g. to read scoring data in the
+    model's feature space) avoid a second decode pass."""
     models_dir = str(models_dir)
     meta_path = os.path.join(models_dir, METADATA_FILE)
     task = TaskType.NONE
@@ -385,7 +401,7 @@ def load_game_model(
 
     if not models:
         raise ValueError(f"No models could be loaded from given path: {models_dir}")
-    return GameModel(models=models)
+    return GameModel(models=models), dict(index_maps)
 
 
 def _harvest_index_maps(models_dir: str, read_records) -> dict[str, IndexMap]:

@@ -258,3 +258,45 @@ def test_load_reference_model_without_index_maps():
             np.testing.assert_allclose(
                 np.asarray(a.coefficients), np.asarray(b.coefficients)
             )
+
+
+def test_scoring_driver_on_reference_model(tmp_path):
+    """game_scoring_driver pointed straight at a reference-written model
+    (no index-map stores on our side): maps are rebuilt from the model's
+    records and the reference's Yahoo-Music sample scores end to end."""
+    from photon_ml_tpu.cli import game_scoring_driver
+
+    s = game_scoring_driver.main([
+        "--input-data-path",
+        f"{REF}/GameIntegTest/input/duplicateFeatures/yahoo-music-train.avro",
+        "--model-input-dir", f"{REF}/GameIntegTest/retrainModels/mixedEffects",
+        "--output-dir", str(tmp_path / "scores"),
+        "--feature-shard-configurations",
+        "name=shard1,feature.bags=features,intercept=false",
+        "--feature-shard-configurations",
+        "name=shard2,feature.bags=userFeatures,intercept=false",
+        "--feature-shard-configurations",
+        "name=shard3,feature.bags=songFeatures,intercept=false",
+    ])
+    assert s["num_scored"] == 6
+    from photon_ml_tpu.io.model_io import read_scores
+
+    recs = read_scores(tmp_path / "scores" / "scores")
+    assert len(recs) == 6
+    assert all(np.isfinite(r["predictionScore"]) for r in recs)
+
+
+def test_scoring_driver_requires_shard_configs_for_foreign_model(tmp_path):
+    """Without saved index-map stores the shard->bag mapping cannot be
+    guessed; the driver must demand explicit configs instead of silently
+    scoring from the wrong bags."""
+    from photon_ml_tpu.cli import game_scoring_driver
+
+    with pytest.raises(ValueError, match="feature-shard-configurations"):
+        game_scoring_driver.main([
+            "--input-data-path",
+            f"{REF}/GameIntegTest/input/duplicateFeatures/yahoo-music-train.avro",
+            "--model-input-dir",
+            f"{REF}/GameIntegTest/retrainModels/mixedEffects",
+            "--output-dir", str(tmp_path / "scores"),
+        ])
