@@ -300,3 +300,24 @@ def test_scoring_driver_requires_shard_configs_for_foreign_model(tmp_path):
             f"{REF}/GameIntegTest/retrainModels/mixedEffects",
             "--output-dir", str(tmp_path / "scores"),
         ])
+
+
+def test_training_driver_warm_starts_from_reference_model(tmp_path):
+    """Warm-start GAME training (fixed effect) from a reference-written
+    model directory — the upgrade path a migrating user runs first."""
+    from photon_ml_tpu.cli import game_training_driver
+
+    s = game_training_driver.main([
+        "--input-data-path",
+        f"{REF}/GameIntegTest/input/duplicateFeatures/yahoo-music-train.avro",
+        "--root-output-dir", str(tmp_path / "out"),
+        "--task-type", "LINEAR_REGRESSION",
+        "--feature-shard-configurations",
+        "name=shard1,feature.bags=features,intercept=false",
+        "--coordinate-configurations",
+        "name=global,feature.shard=shard1,reg.weights=10,max.iter=10",
+        "--model-input-dir",
+        f"{REF}/GameIntegTest/retrainModels/fixedEffectsOnly",
+    ])
+    assert s["num_configurations"] == 1
+    assert (tmp_path / "out" / "best" / "model-metadata.json").exists()
