@@ -34,7 +34,11 @@ from photon_ml_tpu.algorithm.coordinates import (
     _solve_bucket_entities,
     _solve_config,
 )
-from photon_ml_tpu.data.game_data import GameDataset, group_entities_into_buckets
+from photon_ml_tpu.data.game_data import (
+    GameDataset,
+    group_entities_into_buckets,
+    pack_bucket_lanes,
+)
 from photon_ml_tpu.models.matrix_factorization import (
     MatrixFactorizationModel,
     init_factors,
@@ -120,16 +124,13 @@ def _build_side_buckets(
         if not members:
             continue
         e = len(members)
+        be, rows_concat, lane, slot = pack_bucket_lanes(members)
         bl = np.zeros((e, cap), dtype=labels.dtype)
         bw = np.zeros((e, cap), dtype=weights.dtype)
-        be = np.zeros((e,), dtype=np.int32)
         bs = np.full((e, cap), -1, dtype=np.int32)
-        for i, (entity, sample_rows) in enumerate(members):
-            k = len(sample_rows)
-            bl[i, :k] = labels[sample_rows]
-            bw[i, :k] = weights[sample_rows] * (other_idx[sample_rows] >= 0)
-            be[i] = entity
-            bs[i, :k] = sample_rows
+        bl[lane, slot] = labels[rows_concat]
+        bw[lane, slot] = weights[rows_concat] * (other_idx[rows_concat] >= 0)
+        bs[lane, slot] = rows_concat
         buckets.append(
             MFSideBucket(
                 labels=jnp.asarray(bl),
@@ -150,11 +151,11 @@ def build_mf_dataset(
     active_data_upper_bound: int | None = None,
     seed: int = 0,
 ) -> MFDataset:
-    labels = np.asarray(dataset.labels)
-    weights = np.asarray(dataset.weights)
+    labels = dataset.host_array("labels")
+    weights = dataset.host_array("weights")
     unique_ids = np.asarray(dataset.unique_ids)
-    row_idx = np.asarray(dataset.entity_idx[row_effect_type])
-    col_idx = np.asarray(dataset.entity_idx[col_effect_type])
+    row_idx = dataset.host_array(f"entity_idx/{row_effect_type}")
+    col_idx = dataset.host_array(f"entity_idx/{col_effect_type}")
     return MFDataset(
         row_effect_type=row_effect_type,
         col_effect_type=col_effect_type,

@@ -63,6 +63,27 @@ class GameDataset:
     entity_idx: dict[str, Array]
     entity_vocabs: dict[str, np.ndarray]
     ids: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    #: host-side copies kept by build_game_dataset so bucketing never pulls
+    #: device arrays back through a (possibly remote) transfer path
+    host_cache: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+
+    def host_array(self, name: str) -> np.ndarray:
+        """Host copy of a namespaced array: 'labels'/'weights'/'offsets',
+        'shard/<shard_id>', or 'entity_idx/<re_type>'. Shard ids and RE types
+        are caller-chosen strings, hence the prefixes — a shard named
+        'labels' must not collide with the label vector."""
+        if name in self.host_cache:
+            return self.host_cache[name]
+        if name in ("labels", "weights", "offsets"):
+            value = np.asarray(getattr(self, name))
+        elif name.startswith("shard/"):
+            value = np.asarray(self.feature_shards[name[len("shard/"):]])
+        elif name.startswith("entity_idx/"):
+            value = np.asarray(self.entity_idx[name[len("entity_idx/"):]])
+        else:
+            raise KeyError(name)
+        self.host_cache[name] = value
+        return value
 
     @property
     def num_samples(self) -> int:
@@ -231,6 +252,29 @@ def _pearson_keep_mask(x: np.ndarray, y: np.ndarray, num_keep: int) -> np.ndarra
     return mask
 
 
+def pack_bucket_lanes(
+    members: list[tuple[int, np.ndarray]],
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized lane layout for one bucket's members.
+
+    Returns (entity_rows[e], rows_concat[m], lane[m], slot[m]): sample i of
+    entity lane l lands at [lane, slot] in the padded [e, cap] blocks — one
+    fancy assignment per array instead of a Python loop per entity. Shared
+    by random-effect and matrix-factorization bucket packing.
+    """
+    e = len(members)
+    entity_rows = np.fromiter(
+        (ent for ent, _ in members), dtype=np.int32, count=e
+    )
+    counts = np.fromiter((len(sr) for _, sr in members), dtype=np.intp, count=e)
+    rows_concat = np.concatenate([sr for _, sr in members])
+    lane = np.repeat(np.arange(e, dtype=np.intp), counts)
+    slot = np.arange(len(rows_concat), dtype=np.intp) - np.repeat(
+        np.concatenate(([0], np.cumsum(counts[:-1]))), counts
+    )
+    return entity_rows, rows_concat, lane, slot
+
+
 def build_random_effect_dataset(
     dataset: GameDataset,
     re_type: str,
@@ -264,10 +308,10 @@ def build_random_effect_dataset(
       dropped columns are zeroed in its block (and therefore excluded from
       INDEX_MAP active columns).
     """
-    entity_idx = np.asarray(dataset.entity_idx[re_type])
-    features = np.asarray(dataset.feature_shards[shard_id])
-    labels = np.asarray(dataset.labels)
-    weights = np.asarray(dataset.weights)
+    entity_idx = dataset.host_array(f"entity_idx/{re_type}")
+    features = dataset.host_array(f"shard/{shard_id}")
+    labels = dataset.host_array("labels")
+    weights = dataset.host_array("weights")
     unique_ids = np.asarray(dataset.unique_ids)
     dim = features.shape[1]
     num_entities = len(dataset.entity_vocabs[re_type])
@@ -308,36 +352,43 @@ def build_random_effect_dataset(
         return block
 
     index_projected = projector_type == ProjectorType.INDEX_MAP
+    fast_path = not index_projected and features_to_samples_ratio is None
     buckets: list[EntityBucket] = []
     for cap, members in per_bucket.items():
         if not members:
             continue
         e = len(members)
-        blocks = [entity_feature_block(sample_rows) for _, sample_rows in members]
-        entity_cols: list[np.ndarray] | None = None
-        if index_projected:
-            entity_cols = [entity_active_columns(b) for b in blocks]
-            bdim = max(len(c) for c in entity_cols)
-        else:
-            bdim = features.shape[1]
-        bf = np.zeros((e, cap, bdim), dtype=features.dtype)
+        be, rows_concat, lane, slot = pack_bucket_lanes(members)
         bl = np.zeros((e, cap), dtype=labels.dtype)
         bw = np.zeros((e, cap), dtype=weights.dtype)
-        be = np.zeros((e,), dtype=np.int32)
         bs = np.full((e, cap), -1, dtype=np.int32)
-        bc = np.full((e, bdim), dim, dtype=np.int32) if index_projected else None
-        for i, (entity, sample_rows) in enumerate(members):
-            k = len(sample_rows)
+        bl[lane, slot] = labels[rows_concat]
+        bw[lane, slot] = weights[rows_concat]
+        bs[lane, slot] = rows_concat
+
+        bc = None
+        if fast_path:
+            bdim = features.shape[1]
+            bf = np.zeros((e, cap, bdim), dtype=features.dtype)
+            bf[lane, slot] = features[rows_concat]
+        else:
+            # projected / Pearson-filtered paths need per-entity blocks
+            blocks = [entity_feature_block(sr) for _, sr in members]
             if index_projected:
-                cols = entity_cols[i]
-                bf[i, :k, : len(cols)] = blocks[i][:, cols]
-                bc[i, : len(cols)] = cols
+                entity_cols = [entity_active_columns(b) for b in blocks]
+                bdim = max(len(c) for c in entity_cols)
+                bc = np.full((e, bdim), dim, dtype=np.int32)
             else:
-                bf[i, :k] = blocks[i]
-            bl[i, :k] = labels[sample_rows]
-            bw[i, :k] = weights[sample_rows]
-            be[i] = entity
-            bs[i, :k] = sample_rows
+                bdim = features.shape[1]
+            bf = np.zeros((e, cap, bdim), dtype=features.dtype)
+            for i, (_, sample_rows) in enumerate(members):
+                k = len(sample_rows)
+                if index_projected:
+                    cols = entity_cols[i]
+                    bf[i, :k, : len(cols)] = blocks[i][:, cols]
+                    bc[i, : len(cols)] = cols
+                else:
+                    bf[i, :k] = blocks[i]
         buckets.append(
             EntityBucket(
                 features=jnp.asarray(bf),
@@ -387,6 +438,7 @@ def build_game_dataset(
     entity_keys = entity_keys or {}
     vocabs: dict[str, np.ndarray] = {}
     entity_idx: dict[str, Array] = {}
+    host_idx: dict[str, np.ndarray] = {}
     for re_type, keys in entity_keys.items():
         # Entity keys are canonically strings (they round-trip through Avro
         # model files as modelId strings, io/model_io.py); coerce here so an
@@ -400,14 +452,19 @@ def build_game_dataset(
         idx = np.array([lookup.get(k, -1) for k in keys.tolist()], dtype=np.int32)
         vocabs[re_type] = vocab
         entity_idx[re_type] = jnp.asarray(idx)
+        host_idx[re_type] = idx
 
+    host_shards = {k: np.asarray(v, dtype=dtype) for k, v in feature_shards.items()}
     return GameDataset(
         unique_ids=unique_ids,
         labels=jnp.asarray(labels),
         offsets=jnp.asarray(offsets),
         weights=jnp.asarray(weights),
-        feature_shards={k: jnp.asarray(np.asarray(v, dtype=dtype)) for k, v in feature_shards.items()},
+        feature_shards={k: jnp.asarray(v) for k, v in host_shards.items()},
         entity_idx=entity_idx,
         entity_vocabs=vocabs,
         ids=dict(ids or {}),
+        host_cache={"labels": labels, "offsets": offsets, "weights": weights,
+                    **{f"shard/{k}": v for k, v in host_shards.items()},
+                    **{f"entity_idx/{t}": v for t, v in host_idx.items()}},
     )
