@@ -52,6 +52,25 @@ class SolverResult:
     def converged(self) -> Array:
         return self.reason != ConvergenceReason.NOT_CONVERGED
 
+    def states_table(self) -> str:
+        """Printable per-iteration state table (reference
+        OptimizationStatesTracker.toString, OptimizationStatesTracker.scala:
+        82-101): iteration | objective value | gradient norm, ending with
+        the convergence reason."""
+        import numpy as np
+
+        values = np.asarray(self.value_history)
+        grads = np.asarray(self.grad_norm_history)
+        n = int(self.iterations)
+        lines = [f"{'iter':>6} {'value':>16} {'|gradient|':>16}"]
+        for i in range(min(n + 1, len(values))):
+            if np.isnan(values[i]):
+                break
+            lines.append(f"{i:>6} {values[i]:>16.8g} {grads[i]:>16.8g}")
+        reason = ConvergenceReason(int(self.reason)).name
+        lines.append(f"converged after {n} iterations: {reason}")
+        return "\n".join(lines)
+
 
 def check_convergence(
     *,

@@ -191,3 +191,27 @@ def test_history_tracking(rng):
     assert np.all(np.isnan(vh[iters + 1:]))
     # monotone decrease of accepted values
     assert np.all(np.diff(vh[: iters + 1]) <= 1e-12)
+
+
+def test_states_table_printable(rng):
+    """Reference OptimizationStatesTracker.toString parity: per-iteration
+    table with values, gradient norms, and the convergence reason."""
+    from tests.conftest import make_regression
+    from photon_ml_tpu.data.batch import LabeledPointBatch
+    from photon_ml_tpu.ops.losses import SquaredLoss
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+
+    x, y, _ = make_regression(rng, n=100, d=5)
+    batch = LabeledPointBatch.create(x, y)
+    obj = GLMObjective(SquaredLoss(), l2_weight=0.1)
+    result = minimize_lbfgs(obj.bind(batch).value_and_grad,
+                            jnp.zeros(5, x.dtype), max_iter=20)
+    table = result.states_table()
+    lines = table.splitlines()
+    assert "value" in lines[0] and "gradient" in lines[0]
+    assert len(lines) >= 3  # header + >=1 iteration + reason
+    assert "converged after" in lines[-1]
+    assert any(r in lines[-1] for r in
+               ("FUNCTION_VALUES_WITHIN_TOLERANCE", "GRADIENT_WITHIN_TOLERANCE",
+                "MAX_ITERATIONS", "LINE_SEARCH_FAILED"))
