@@ -43,6 +43,7 @@ from photon_ml_tpu.evaluation.evaluators import parse_evaluator
 from photon_ml_tpu.hyperparameter.game_glue import (
     GameHyperparameterTuner,
     HyperparameterTuningMode,
+    load_prior_observations,
     save_tuned_config,
 )
 from photon_ml_tpu.io.data_reader import read_merged
@@ -91,6 +92,9 @@ class GameTrainingParams:
     hyperparameter_tuning: HyperparameterTuningMode = HyperparameterTuningMode.NONE
     hyperparameter_tuning_iter: int = 10
     hyperparameter_tuning_range: tuple[float, float] = (1e-4, 1e4)
+    #: tuned-hyperparameters.json from a previous run, used as search priors
+    #: (reference HyperparameterSerialization)
+    hyperparameter_prior_json: str | None = None
     input_format: str = "avro"
     override_output: bool = False
     #: mid-training checkpoint/resume (io/checkpoint.py); one subdirectory
@@ -132,6 +136,15 @@ class GameTrainingParams:
                 parse_evaluator(spec)
             except ValueError as e:
                 problems.append(str(e))
+        if self.hyperparameter_prior_json:
+            # a typo'd priors path must fail now, not after the grid trains
+            try:
+                load_prior_observations(self.hyperparameter_prior_json)
+            except Exception as e:
+                problems.append(
+                    f"cannot read --hyperparameter-prior-json "
+                    f"{self.hyperparameter_prior_json!r}: {e}"
+                )
         if (
             self.hyperparameter_tuning != HyperparameterTuningMode.NONE
             and not self.evaluators
@@ -348,15 +361,18 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
                 reg_ranges=tunable,
                 mode=params.hyperparameter_tuning,
             )
+            priors = [
+                (rw, r.best_metric)
+                for rw, r in results
+                if not np.isnan(r.best_metric)
+            ]
+            if params.hyperparameter_prior_json:
+                priors += load_prior_observations(params.hyperparameter_prior_json)
             tuned = tuner.tune(
                 train.dataset,
                 validation.dataset,
                 num_iterations=params.hyperparameter_tuning_iter,
-                prior_observations=[
-                    (rw, r.best_metric)
-                    for rw, r in results
-                    if not np.isnan(r.best_metric)
-                ],
+                prior_observations=priors,
                 # only TUNED/ALL need every candidate's model; the winner is
                 # tracked O(1) either way (TuningResult.best_result)
                 keep_models=params.model_output_mode
@@ -453,6 +469,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--hyperparameter-tuning-iter", type=int, default=10)
     p.add_argument("--hyperparameter-tuning-range", default="1e-4,1e4",
                    help="low,high λ search range (log-scale)")
+    p.add_argument("--hyperparameter-prior-json",
+                   help="tuned-hyperparameters.json from a previous run, "
+                        "used to seed the search")
     p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
     p.add_argument("--override-output", action="store_true")
     p.add_argument("--checkpoint-dir",
@@ -502,6 +521,7 @@ def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
         hyperparameter_tuning_range=tuple(
             float(x) for x in args.hyperparameter_tuning_range.split(",")
         ),
+        hyperparameter_prior_json=args.hyperparameter_prior_json,
         input_format=args.input_format,
         override_output=args.override_output,
         checkpoint_dir=args.checkpoint_dir,

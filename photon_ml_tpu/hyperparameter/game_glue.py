@@ -46,6 +46,10 @@ class TuningResult:
     #: (reg weights, fit result) of the best tuning candidate — always
     #: tracked (O(1) memory) so best-over-all selection never needs the list
     best_result: tuple | None = None
+    #: (reg weights, raw metric) per evaluated candidate — lightweight,
+    #: always tracked; persisted so later runs can seed their search
+    #: (reference HyperparameterSerialization priors)
+    observations_reg: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -99,6 +103,7 @@ class GameHyperparameterTuner:
         evaluator = parse_evaluator(self.estimator.validation_evaluators[0])
         sign = -1.0 if evaluator.larger_is_better else 1.0
         tuned_results: list = []
+        observations_reg: list = []
         best_seen: list = [None, np.inf]  # (reg, result), signed value
 
         def evaluate(candidate: np.ndarray) -> float:
@@ -108,6 +113,8 @@ class GameHyperparameterTuner:
             result = est.fit(dataset, validation_dataset=validation_dataset)
             if keep_models:
                 tuned_results.append((reg, result))
+            if not np.isnan(result.best_metric):  # keep the file strict JSON
+                observations_reg.append((reg, float(result.best_metric)))
             value = sign * float(result.best_metric)
             if not np.isnan(value) and value < best_seen[1]:
                 best_seen[0], best_seen[1] = (reg, result), value
@@ -120,9 +127,25 @@ class GameHyperparameterTuner:
         else:
             raise ValueError("tuning mode NONE — nothing to do")
 
+        import logging
+
         for reg, value in prior_observations:
+            if np.isnan(value):
+                continue
+            missing = [cid for cid in self._coord_ids if cid not in reg]
+            if missing:
+                # e.g. priors from a run with different coordinate names —
+                # skip, don't crash after the grid already trained
+                logging.getLogger(__name__).warning(
+                    "skipping prior observation missing coordinates %s "
+                    "(tunable: %s)", missing, self._coord_ids,
+                )
+                continue
             vec = np.array([reg[cid] for cid in self._coord_ids])
             search.observe_prior(self.rescaling.to_unit(vec), sign * value)
+            # seed priors chain into this run's saved observations so a
+            # sequence of seeded runs accumulates history
+            observations_reg.append((dict(reg), float(value)))
 
         result = search.find(evaluate, num_iterations)
         best_values = self.rescaling.to_hyperparameters(result.best_candidate)
@@ -132,6 +155,7 @@ class GameHyperparameterTuner:
             search=result,
             tuned_results=tuned_results,
             best_result=best_seen[0],
+            observations_reg=observations_reg,
         )
 
 
@@ -145,9 +169,27 @@ def save_tuned_config(result: TuningResult, path: str) -> None:
             {"candidate": o.candidate.tolist(), "value": o.value}
             for o in result.search.observations
         ],
+        # hyperparameter-space observations, loadable as priors by a later
+        # run (--hyperparameter-prior-json)
+        "prior_observations": [
+            {"reg_weights": reg, "metric": metric}
+            for reg, metric in result.observations_reg
+        ],
     }
     with open(path, "w") as f:
         json.dump(payload, f, indent=2)
+
+
+def load_prior_observations(path: str) -> list[tuple[dict, float]]:
+    """Read a previous run's tuned-hyperparameters.json into (reg weights,
+    metric) priors for ``GameHyperparameterTuner.tune``."""
+    with open(path) as f:
+        payload = json.load(f)
+    return [
+        (dict(o["reg_weights"]), float(o["metric"]))
+        for o in payload.get("prior_observations", [])
+        if not np.isnan(float(o["metric"]))
+    ]
 
 
 def load_tuned_config(path: str) -> dict:

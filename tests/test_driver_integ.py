@@ -443,3 +443,34 @@ class TestGameScoringDriverInteg:
         assert len(recs) == 400
         assert all(np.isfinite(r["predictionScore"]) for r in recs)
         assert all(r["label"] is not None for r in recs)
+
+    def test_hyperparameter_priors_seed_next_run(self, music_data, tmp_path):
+        """A later run seeded with --hyperparameter-prior-json must start
+        from the earlier run's observations (reference
+        HyperparameterSerialization priors): with 0 fresh tuning iterations
+        it still reports the prior best."""
+        import json
+
+        args = [
+            "--coordinate-configurations",
+            "name=fe,feature.shard=global,reg.weights=0.1|1,max.iter=25",
+            "--hyperparameter-tuning", "RANDOM",
+            "--hyperparameter-tuning-iter", "3",
+        ]
+        out1 = tmp_path / "r1"
+        s1 = _train(music_data, out1, args)
+        payload = json.loads((out1 / "tuned-hyperparameters.json").read_text())
+        # 2 grid configs seed the search as priors and chain into the file,
+        # plus 3 fresh tuning evaluations
+        assert len(payload["prior_observations"]) == 5
+        out2 = tmp_path / "r2"
+        s2 = _train(music_data, out2, [
+            "--coordinate-configurations",
+            "name=fe,feature.shard=global,reg.weights=0.1|1,max.iter=25",
+            "--hyperparameter-tuning", "RANDOM",
+            "--hyperparameter-tuning-iter", "1",
+            "--hyperparameter-prior-json",
+            str(out1 / "tuned-hyperparameters.json"),
+        ])
+        # best-over-priors: run 2's tuned metric can't be worse than run 1's
+        assert s2["tuned_metric"] <= s1["tuned_metric"] + 1e-9

@@ -225,3 +225,52 @@ class TestGameTuning:
         loaded = load_tuned_config(path)
         assert loaded["best_reg_weights"] == {"fe": 0.5}
         assert loaded["observations"][0]["value"] == 1.25
+
+
+def test_prior_observations_chain_and_validate(tmp_path, rng):
+    """Seed priors chain into the saved file (A->B->C keeps history); priors
+    with mismatched coordinate names are skipped, not crashed on."""
+    import json
+    import numpy as np
+    from photon_ml_tpu.data.game_data import build_game_dataset
+    from photon_ml_tpu.estimators import FixedEffectCoordinateConfig, GameEstimator
+    from photon_ml_tpu.algorithm.coordinates import CoordinateOptimizationConfig
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig
+    from photon_ml_tpu.hyperparameter.game_glue import (
+        GameHyperparameterTuner,
+        HyperparameterTuningMode,
+        load_prior_observations,
+        save_tuned_config,
+    )
+    from photon_ml_tpu.types import TaskType
+
+    n, d = 200, 4
+    w = rng.normal(size=d)
+    x = rng.normal(size=(n, d)); y = x @ w + 0.1 * rng.normal(size=n)
+    xv = rng.normal(size=(80, d)); yv = xv @ w + 0.1 * rng.normal(size=80)
+    ds = build_game_dataset(labels=y, feature_shards={"g": x}, dtype=np.float64)
+    vds = build_game_dataset(labels=yv, feature_shards={"g": xv}, dtype=np.float64)
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={"fe": FixedEffectCoordinateConfig(
+            "g", CoordinateOptimizationConfig(optimizer=OptimizerConfig(max_iterations=15)))},
+        validation_evaluators=("RMSE",),
+    )
+    tuner = GameHyperparameterTuner(
+        estimator=est, reg_ranges={"fe": (1e-3, 1e2)},
+        mode=HyperparameterTuningMode.RANDOM,
+    )
+    r1 = tuner.tune(ds, vds, num_iterations=2)
+    p1 = tmp_path / "t1.json"; save_tuned_config(r1, str(p1))
+    priors = load_prior_observations(str(p1))
+    assert len(priors) == 2
+    # seeded run chains priors into its own saved file
+    r2 = tuner.tune(ds, vds, num_iterations=1, prior_observations=priors)
+    p2 = tmp_path / "t2.json"; save_tuned_config(r2, str(p2))
+    assert len(load_prior_observations(str(p2))) == 3  # 2 chained + 1 fresh
+    # mismatched coordinate names are skipped with a warning, not a crash
+    r3 = tuner.tune(ds, vds, num_iterations=1,
+                    prior_observations=[({"bogus": 1.0}, 0.5)])
+    assert np.isfinite(r3.best_value)
+    # file is strict JSON even in edge cases
+    json.loads(p2.read_text())
