@@ -23,3 +23,38 @@ A ground-up rebuild of the capabilities of LinkedIn Photon-ML
 __version__ = "0.1.0"
 
 from photon_ml_tpu.types import TaskType  # noqa: F401
+
+#: Lazy top-level API: the common user-facing names resolve on first access
+#: without forcing every subsystem (and its jit compilations) at import time.
+_LAZY = {
+    "GameEstimator": ("photon_ml_tpu.estimators", "GameEstimator"),
+    "FixedEffectCoordinateConfig": ("photon_ml_tpu.estimators", "FixedEffectCoordinateConfig"),
+    "RandomEffectCoordinateConfig": ("photon_ml_tpu.estimators", "RandomEffectCoordinateConfig"),
+    "MatrixFactorizationCoordinateConfig": ("photon_ml_tpu.estimators", "MatrixFactorizationCoordinateConfig"),
+    "train_glm": ("photon_ml_tpu.estimators", "train_glm"),
+    "train_glm_grid": ("photon_ml_tpu.estimators", "train_glm_grid"),
+    "GameTransformer": ("photon_ml_tpu.transformers", "GameTransformer"),
+    "build_game_dataset": ("photon_ml_tpu.data.game_data", "build_game_dataset"),
+    "build_random_effect_dataset": ("photon_ml_tpu.data.game_data", "build_random_effect_dataset"),
+    "LabeledPointBatch": ("photon_ml_tpu.data.batch", "LabeledPointBatch"),
+    "CoordinateOptimizationConfig": ("photon_ml_tpu.algorithm.coordinates", "CoordinateOptimizationConfig"),
+    "OptimizerConfig": ("photon_ml_tpu.optim.optimizer", "OptimizerConfig"),
+    "OptimizerType": ("photon_ml_tpu.optim.optimizer", "OptimizerType"),
+    "NormalizationType": ("photon_ml_tpu.ops.normalization", "NormalizationType"),
+    "load_game_model": ("photon_ml_tpu.io.model_io", "load_game_model"),
+    "save_game_model": ("photon_ml_tpu.io.model_io", "save_game_model"),
+    "read_merged": ("photon_ml_tpu.io.data_reader", "read_merged"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'photon_ml_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))
