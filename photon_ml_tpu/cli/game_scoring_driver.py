@@ -113,8 +113,9 @@ def run(
 
     with Timed("save scores"):
         write_scores(
-            os.path.join(output_dir, "scores", "part-00000.avro"),
+            os.path.join(output_dir, "scores"),
             scored.scores,
+            records_per_file=1 << 20,
             model_id=model_id,
             uids=scored.unique_ids,
             labels=np.asarray(data.dataset.labels),

@@ -508,9 +508,14 @@ def write_scores(
     uids: np.ndarray | None = None,
     labels: np.ndarray | None = None,
     weights: np.ndarray | None = None,
+    records_per_file: int | None = None,
 ) -> None:
     """Scored-item output as ScoringResultAvro (reference
-    ScoreProcessingUtils.saveScoredItemsToHDFS)."""
+    ScoreProcessingUtils.saveScoredItemsToHDFS).
+
+    ``records_per_file``: when set, ``path`` is treated as a directory and
+    scores split into part-NNNNN.avro files (the reference's partitioned
+    score output)."""
     n = len(scores)
 
     def records():
@@ -524,6 +529,12 @@ def write_scores(
                 "metadataMap": None,
             }
 
+    if records_per_file is not None:
+        os.makedirs(str(path), exist_ok=True)
+        _write_chunked(
+            str(path), schemas.SCORING_RESULT_AVRO, records(), records_per_file
+        )
+        return
     os.makedirs(os.path.dirname(str(path)) or ".", exist_ok=True)
     avro_io.write_container(path, schemas.SCORING_RESULT_AVRO, records())
 

@@ -120,3 +120,17 @@ def test_text_and_stats_writers(tmp_path):
     records = list(read_container(tmp_path / "stats.avro"))
     assert len(records) == 3
     assert records[1]["metrics"]["mean"] == 1.0
+
+
+def test_write_scores_partitioned(tmp_path, rng):
+    from photon_ml_tpu.io.model_io import read_scores, write_scores
+
+    scores = rng.normal(size=25)
+    write_scores(tmp_path / "scores", scores, records_per_file=10)
+    parts = sorted(p.name for p in (tmp_path / "scores").iterdir())
+    assert parts == ["part-00000.avro", "part-00001.avro", "part-00002.avro"]
+    recs = read_scores(tmp_path / "scores")
+    assert len(recs) == 25
+    np.testing.assert_allclose(
+        sorted(r["predictionScore"] for r in recs), sorted(scores), rtol=1e-6
+    )
