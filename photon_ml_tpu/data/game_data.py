@@ -446,10 +446,21 @@ def build_game_dataset(
         keys = np.asarray(keys).astype(str)
         if entity_vocabs is not None and re_type in entity_vocabs:
             vocab = np.asarray(entity_vocabs[re_type]).astype(str)
+            if len(vocab) == 0:
+                idx = np.full(len(keys), -1, dtype=np.int32)
+            else:
+                # vectorized lookup: position in sorted vocab, -1 for misses
+                order = np.argsort(vocab, kind="stable")
+                sorted_vocab = vocab[order]
+                pos = np.minimum(
+                    np.searchsorted(sorted_vocab, keys), len(vocab) - 1
+                )
+                idx = np.where(
+                    sorted_vocab[pos] == keys, order[pos], -1
+                ).astype(np.int32)
         else:
-            vocab = np.unique(keys)
-        lookup = {k: i for i, k in enumerate(vocab.tolist())}
-        idx = np.array([lookup.get(k, -1) for k in keys.tolist()], dtype=np.int32)
+            vocab, inverse = np.unique(keys, return_inverse=True)
+            idx = inverse.astype(np.int32)
         vocabs[re_type] = vocab
         entity_idx[re_type] = jnp.asarray(idx)
         host_idx[re_type] = idx

@@ -531,9 +531,17 @@ def write_scores(
 
     if records_per_file is not None:
         os.makedirs(str(path), exist_ok=True)
-        _write_chunked(
-            str(path), schemas.SCORING_RESULT_AVRO, records(), records_per_file
-        )
+        if n == 0:
+            # always leave at least one (empty) readable part file
+            avro_io.write_container(
+                os.path.join(str(path), "part-00000.avro"),
+                schemas.SCORING_RESULT_AVRO,
+                (),
+            )
+        else:
+            _write_chunked(
+                str(path), schemas.SCORING_RESULT_AVRO, records(), records_per_file
+            )
         return
     os.makedirs(os.path.dirname(str(path)) or ".", exist_ok=True)
     avro_io.write_container(path, schemas.SCORING_RESULT_AVRO, records())

@@ -134,3 +134,10 @@ def test_write_scores_partitioned(tmp_path, rng):
     np.testing.assert_allclose(
         sorted(r["predictionScore"] for r in recs), sorted(scores), rtol=1e-6
     )
+
+
+def test_write_scores_partitioned_empty(tmp_path):
+    from photon_ml_tpu.io.model_io import read_scores, write_scores
+
+    write_scores(tmp_path / "scores", np.asarray([]), records_per_file=10)
+    assert read_scores(tmp_path / "scores") == []
