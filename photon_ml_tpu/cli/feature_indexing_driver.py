@@ -24,6 +24,8 @@ def run(
     output_dir: str,
     feature_shards: dict,
     input_format: str = "avro",
+    store_format: str = "plain",
+    num_partitions: int = 1,
 ) -> dict[str, int]:
     records = (
         read_avro_records(input_data_path)
@@ -33,7 +35,16 @@ def run(
     index_maps = build_index_maps(records, feature_shards)
     sizes = {}
     for shard_id, imap in index_maps.items():
-        imap.save(output_dir, shard_id)
+        if store_format == "offheap":
+            # partitioned native mmap stores (reference PalDB layout,
+            # index/FeatureIndexingDriver.scala:227-290)
+            from photon_ml_tpu.io.offheap_index_map import build_offheap_store
+
+            build_offheap_store(
+                output_dir, imap, num_partitions=num_partitions, name=shard_id
+            )
+        else:
+            imap.save(output_dir, shard_id)
         sizes[shard_id] = imap.size
         logger.info("shard '%s': %d features indexed", shard_id, imap.size)
     return sizes
@@ -46,6 +57,11 @@ def main(argv: Sequence[str] | None = None) -> dict[str, int]:
     p.add_argument("--output-dir", required=True)
     p.add_argument("--feature-shard-configurations", action="append", required=True)
     p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
+    p.add_argument("--index-store-format", default="plain",
+                   choices=["plain", "offheap"],
+                   help="offheap = partitioned native mmap stores "
+                        "(reference PalDB analogue)")
+    p.add_argument("--num-partitions", type=int, default=1)
     args = p.parse_args(argv)
     shards = dict(
         parse_feature_shard_config(s) for s in args.feature_shard_configurations
@@ -55,6 +71,8 @@ def main(argv: Sequence[str] | None = None) -> dict[str, int]:
         output_dir=args.output_dir,
         feature_shards=shards,
         input_format=args.input_format,
+        store_format=args.index_store_format,
+        num_partitions=args.num_partitions,
     )
 
 

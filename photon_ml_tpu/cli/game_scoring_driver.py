@@ -58,6 +58,16 @@ def run(
             if fname.endswith(".keys"):
                 shard = fname[: -len(".keys")]
                 index_maps[shard] = IndexMap.load(index_maps_dir, shard)
+            elif fname.endswith(".photonix.json"):
+                # partitioned native mmap stores (feature_indexing_driver
+                # --index-store-format offheap); OffHeapIndexMap is a
+                # drop-in Mapping for IndexMap
+                from photon_ml_tpu.io.offheap_index_map import OffHeapIndexMap
+
+                shard = fname[: -len(".photonix.json")]
+                index_maps.setdefault(
+                    shard, OffHeapIndexMap(index_maps_dir, shard)
+                )
     if index_maps:
         if feature_shards is None:
             # shard name == bag name is OUR training driver's convention,

@@ -113,7 +113,8 @@ def _write_chunked(
     directory: str, schema: dict, records: Iterable[dict], per_file: int
 ) -> None:
     """Write records into part-NNNNN.avro files of at most per_file records
-    (reference randomEffectModelFileLimit)."""
+    (reference randomEffectModelFileLimit). Always emits at least one
+    (possibly empty) part file so the directory stays readable."""
     it = iter(records)
     part = 0
     while True:
@@ -122,12 +123,14 @@ def _write_chunked(
             chunk.append(record)
             if len(chunk) >= per_file:
                 break
-        if not chunk:
+        if not chunk and part > 0:
             break
         avro_io.write_container(
             os.path.join(directory, f"part-{part:05d}.avro"), schema, chunk
         )
         part += 1
+        if len(chunk) < per_file:
+            break
 
 
 def _record_to_coefficients(record: dict, index_map: IndexMap, dtype) -> Coefficients:
@@ -531,17 +534,9 @@ def write_scores(
 
     if records_per_file is not None:
         os.makedirs(str(path), exist_ok=True)
-        if n == 0:
-            # always leave at least one (empty) readable part file
-            avro_io.write_container(
-                os.path.join(str(path), "part-00000.avro"),
-                schemas.SCORING_RESULT_AVRO,
-                (),
-            )
-        else:
-            _write_chunked(
-                str(path), schemas.SCORING_RESULT_AVRO, records(), records_per_file
-            )
+        _write_chunked(
+            str(path), schemas.SCORING_RESULT_AVRO, records(), records_per_file
+        )
         return
     os.makedirs(os.path.dirname(str(path)) or ".", exist_ok=True)
     avro_io.write_container(path, schemas.SCORING_RESULT_AVRO, records())

@@ -127,6 +127,8 @@ def build_offheap_store(
     partitioning); each store holds its keys sorted by global index, and the
     global index is recovered as offsets stored per partition.
     """
+    if num_partitions < 1:
+        raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
     os.makedirs(directory, exist_ok=True)
     ordered = sorted(index_map.items(), key=lambda kv: kv[1])
     if [i for _, i in ordered] != list(range(len(ordered))):

@@ -407,3 +407,58 @@ def test_glm_driver_grid_parallel_matches_sequential(tmp_path):
         assert par.validation_metrics[lam]["AUC"] == pytest.approx(
             seq.validation_metrics[lam]["AUC"], abs=1e-3
         )
+
+
+def test_feature_indexing_offheap_store(game_data, tmp_path):
+    """--index-store-format offheap writes partitioned native mmap stores
+    readable by OffHeapIndexMap (reference PalDB FeatureIndexingDriver)."""
+    from photon_ml_tpu.cli import feature_indexing_driver
+    from photon_ml_tpu.io.index_map import feature_key
+    from photon_ml_tpu.io.offheap_index_map import OffHeapIndexMap
+
+    sizes = feature_indexing_driver.main([
+        "--input-data-path", str(game_data / "train"),
+        "--output-dir", str(tmp_path / "index"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--index-store-format", "offheap",
+        "--num-partitions", "3",
+    ])
+    store = OffHeapIndexMap(tmp_path / "index", "global")
+    assert len(store) == sizes["global"] == 7
+    j = store.get_index(feature_key("f0", ""))
+    assert j >= 0 and store.get_feature_name(j) == feature_key("f0", "")
+    assert store.get_index("missing\x01") == -1
+
+
+def test_scoring_reads_offheap_index_stores(game_data, tmp_path):
+    """Train normally, re-index off-heap, then score using ONLY the native
+    stores (no .keys files) — the pipeline consumes what the indexing
+    driver writes."""
+    from photon_ml_tpu.cli import (
+        feature_indexing_driver,
+        game_scoring_driver,
+        game_training_driver,
+    )
+
+    out = tmp_path / "train"
+    game_training_driver.main([
+        "--input-data-path", str(game_data / "train"),
+        "--root-output-dir", str(out),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--coordinate-configurations", "name=fe,feature.shard=global,max.iter=25",
+        "--task-type", "LINEAR_REGRESSION",
+    ])
+    feature_indexing_driver.main([
+        "--input-data-path", str(game_data / "train"),
+        "--output-dir", str(tmp_path / "offheap-index"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--index-store-format", "offheap", "--num-partitions", "2",
+    ])
+    s = game_scoring_driver.main([
+        "--input-data-path", str(game_data / "val"),
+        "--model-input-dir", str(out / "best"),
+        "--output-dir", str(tmp_path / "scores"),
+        "--index-maps-dir", str(tmp_path / "offheap-index"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+    ])
+    assert s["num_scored"] == 300
