@@ -52,22 +52,8 @@ def run(
     if index_maps_dir is None:
         candidate = os.path.join(os.path.dirname(model_input_dir.rstrip("/")), "index-maps")
         index_maps_dir = candidate if os.path.isdir(candidate) else None
-    index_maps = {}
-    if index_maps_dir:
-        for fname in os.listdir(index_maps_dir):
-            if fname.endswith(".keys"):
-                shard = fname[: -len(".keys")]
-                index_maps[shard] = IndexMap.load(index_maps_dir, shard)
-            elif fname.endswith(".photonix.json"):
-                # partitioned native mmap stores (feature_indexing_driver
-                # --index-store-format offheap); OffHeapIndexMap is a
-                # drop-in Mapping for IndexMap
-                from photon_ml_tpu.io.offheap_index_map import OffHeapIndexMap
-
-                shard = fname[: -len(".photonix.json")]
-                index_maps.setdefault(
-                    shard, OffHeapIndexMap(index_maps_dir, shard)
-                )
+    # both formats: plain .keys and native off-heap .photonix stores
+    index_maps = IndexMap.load_directory(index_maps_dir) if index_maps_dir else {}
     if index_maps:
         if feature_shards is None:
             # shard name == bag name is OUR training driver's convention,

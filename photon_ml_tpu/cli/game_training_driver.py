@@ -47,6 +47,7 @@ from photon_ml_tpu.hyperparameter.game_glue import (
     save_tuned_config,
 )
 from photon_ml_tpu.io.data_reader import read_merged
+from photon_ml_tpu.io.index_map import IndexMap
 from photon_ml_tpu.io.model_io import load_game_model, save_game_model, write_feature_stats
 from photon_ml_tpu.ops.normalization import NormalizationType
 from photon_ml_tpu.types import TaskType
@@ -96,6 +97,9 @@ class GameTrainingParams:
     #: (reference HyperparameterSerialization)
     hyperparameter_prior_json: str | None = None
     input_format: str = "avro"
+    #: reuse index stores built by feature_indexing_driver (plain .keys or
+    #: native off-heap .photonix) instead of scanning the data
+    index_maps_dir: str | None = None
     override_output: bool = False
     #: mid-training checkpoint/resume (io/checkpoint.py); one subdirectory
     #: per λ-grid configuration. Empty = disabled.
@@ -136,6 +140,20 @@ class GameTrainingParams:
                 parse_evaluator(spec)
             except ValueError as e:
                 problems.append(str(e))
+        if self.index_maps_dir:
+            # typo'd stores dir must fail before the output dir is touched
+            try:
+                found = IndexMap.load_directory(self.index_maps_dir)
+                missing = set(self.feature_shards) - set(found)
+                if missing:
+                    problems.append(
+                        f"--index-maps-dir {self.index_maps_dir!r} has no "
+                        f"stores for shards {sorted(missing)}"
+                    )
+            except OSError as e:
+                problems.append(
+                    f"cannot read --index-maps-dir {self.index_maps_dir!r}: {e}"
+                )
         if self.hyperparameter_prior_json:
             # a typo'd priors path must fail now, not after the grid trains
             try:
@@ -203,10 +221,19 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
 
         return resolve_input_paths([path], parse_date_or_days_range(range_spec))
 
+    prebuilt_maps = None
+    if params.index_maps_dir:
+        # reference GameDriver.prepareFeatureMaps (GameDriver.scala:195-240):
+        # reuse stores built by the feature-indexing driver (plain .keys or
+        # native off-heap .photonix) instead of scanning the data.
+        # validate() already checked existence + shard coverage.
+        prebuilt_maps = IndexMap.load_directory(params.index_maps_dir)
+
     with Timed("read training data"):
         train = read_merged(
             resolve(params.input_data_path, params.input_date_range),
             params.feature_shards,
+            index_maps=prebuilt_maps,
             random_effect_id_columns=re_columns,
             evaluation_id_columns=eval_columns,
             fmt=params.input_format,
@@ -253,9 +280,12 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
         with Timed("load warm-start model"):
             initial_model = load_game_model(params.model_input_dir, train.index_maps)
 
-    # save index maps next to the models so scoring is self-contained
+    # save index maps next to the models so scoring is self-contained;
+    # plain maps (built here OR prebuilt .keys) are cheap to copy, while
+    # off-heap stores stay where they are (scoring takes --index-maps-dir)
     for shard_id, imap in train.index_maps.items():
-        imap.save(os.path.join(out, "index-maps"), shard_id)
+        if isinstance(imap, IndexMap):
+            imap.save(os.path.join(out, "index-maps"), shard_id)
 
     def make_estimator(reg_weights, checkpointer=None) -> GameEstimator:
         return GameEstimator(
@@ -473,6 +503,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="tuned-hyperparameters.json from a previous run, "
                         "used to seed the search")
     p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
+    p.add_argument("--index-maps-dir",
+                   help="reuse index stores built by the feature indexing "
+                        "driver (plain .keys or off-heap .photonix)")
     p.add_argument("--override-output", action="store_true")
     p.add_argument("--checkpoint-dir",
                    help="mid-training checkpoint/resume directory")
@@ -523,6 +556,7 @@ def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
         ),
         hyperparameter_prior_json=args.hyperparameter_prior_json,
         input_format=args.input_format,
+        index_maps_dir=args.index_maps_dir,
         override_output=args.override_output,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,

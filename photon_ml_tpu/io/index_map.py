@@ -99,6 +99,27 @@ class IndexMap(Mapping[str, int]):
                              add_intercept=add_intercept)
 
     # Persistence ------------------------------------------------------------
+    @staticmethod
+    def load_directory(directory: str | os.PathLike) -> dict[str, "IndexMap"]:
+        """Load every index map in a directory, both formats: plain
+        ``<shard>.keys`` files and partitioned native off-heap stores
+        (``<shard>.photonix.json``; reference PalDB stores). Returns
+        shard id -> Mapping (OffHeapIndexMap is a drop-in)."""
+        maps: dict[str, IndexMap] = {}
+        directory = str(directory)
+        for fname in sorted(os.listdir(directory)):
+            if fname.endswith(".keys"):
+                shard = fname[: -len(".keys")]
+                if shard not in maps:
+                    maps[shard] = IndexMap.load(directory, shard)
+            elif fname.endswith(".photonix.json"):
+                shard = fname[: -len(".photonix.json")]
+                if shard not in maps:
+                    from photon_ml_tpu.io.offheap_index_map import OffHeapIndexMap
+
+                    maps[shard] = OffHeapIndexMap(directory, shard)
+        return maps
+
     def save(self, directory: str | os.PathLike, name: str = "index") -> str:
         """Write ``<name>.keys`` (one key per line, index order) +
         ``<name>.meta.json``. Keys may contain the \\u0001 delimiter; lines

@@ -462,3 +462,39 @@ def test_scoring_reads_offheap_index_stores(game_data, tmp_path):
         "--feature-shard-configurations", "name=global,feature.bags=features",
     ])
     assert s["num_scored"] == 300
+
+
+def test_training_with_prebuilt_offheap_index_maps(game_data, tmp_path):
+    """Training consumes prebuilt native off-heap stores (--index-maps-dir),
+    the reference's PalDB prepareFeatureMaps path; results match the
+    scan-the-data path."""
+    from photon_ml_tpu.cli import feature_indexing_driver, game_training_driver
+
+    feature_indexing_driver.main([
+        "--input-data-path", str(game_data / "train"),
+        "--output-dir", str(tmp_path / "idx"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--index-store-format", "offheap", "--num-partitions", "2",
+    ])
+    common = [
+        "--input-data-path", str(game_data / "train"),
+        "--validation-data-path", str(game_data / "val"),
+        "--evaluators", "RMSE",
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--coordinate-configurations", "name=fe,feature.shard=global,max.iter=30",
+        "--task-type", "LINEAR_REGRESSION",
+    ]
+    s_pre = game_training_driver.main(
+        common + ["--root-output-dir", str(tmp_path / "o1"),
+                  "--index-maps-dir", str(tmp_path / "idx")]
+    )
+    s_scan = game_training_driver.main(
+        common + ["--root-output-dir", str(tmp_path / "o2")]
+    )
+    assert s_pre["best_metric"] == pytest.approx(s_scan["best_metric"], rel=1e-6)
+    # missing shard stores fail fast
+    with pytest.raises(ValueError, match="no stores"):
+        game_training_driver.main(
+            common + ["--root-output-dir", str(tmp_path / "o3"),
+                      "--index-maps-dir", str(tmp_path)]
+        )
