@@ -163,6 +163,50 @@ class CoordinateCliConfig:
         )
 
 
+def format_coordinate_config(cfg: CoordinateCliConfig) -> str:
+    """Render a config back to its CLI spec string (reference ScoptParameter
+    print-round-trip: parse(format(cfg)) == cfg). Only non-default fields
+    are emitted."""
+    parts = [f"name={cfg.name}"]
+    if cfg.feature_shard:
+        parts.append(f"feature.shard={cfg.feature_shard}")
+    if cfg.optimizer != OptimizerType.LBFGS:
+        parts.append(f"optimizer={cfg.optimizer.value}")
+    if cfg.max_iterations != 100:
+        parts.append(f"max.iter={cfg.max_iterations}")
+    if cfg.tolerance != 1e-7:
+        parts.append(f"tolerance={cfg.tolerance!r}")
+    if cfg.reg_weights != (0.0,):
+        parts.append(
+            "reg.weights=" + LIST_SEP.join(repr(w) for w in cfg.reg_weights)
+        )
+    if cfg.reg_alpha:
+        parts.append(f"reg.alpha={cfg.reg_alpha!r}")
+    if cfg.down_sampling_rate != 1.0:
+        parts.append(f"down.sampling.rate={cfg.down_sampling_rate!r}")
+    if cfg.compute_variance:
+        parts.append("variance=true")
+    if cfg.random_effect_type:
+        parts.append(f"random.effect.type={cfg.random_effect_type}")
+    if cfg.active_data_lower_bound is not None:
+        parts.append(f"active.data.lower.bound={cfg.active_data_lower_bound}")
+    if cfg.active_data_upper_bound is not None:
+        parts.append(f"active.data.upper.bound={cfg.active_data_upper_bound}")
+    if cfg.projector != ProjectorType.IDENTITY:
+        parts.append(f"projector={cfg.projector.value}")
+    if cfg.projected_dim is not None:
+        parts.append(f"projected.dim={cfg.projected_dim}")
+    if cfg.features_to_samples_ratio is not None:
+        parts.append(f"features.to.samples.ratio={cfg.features_to_samples_ratio!r}")
+    if cfg.mf_row_effect_type:
+        parts.append(f"mf.row.effect.type={cfg.mf_row_effect_type}")
+        parts.append(f"mf.col.effect.type={cfg.mf_col_effect_type}")
+        parts.append(f"mf.latent.factors={cfg.mf_latent_factors}")
+        if cfg.mf_alternations != 2:
+            parts.append(f"mf.alternations={cfg.mf_alternations}")
+    return ",".join(parts)
+
+
 def parse_coordinate_config(spec: str) -> CoordinateCliConfig:
     """Parse one --coordinate-configurations value, e.g.
     "name=per-user,random.effect.type=userId,feature.shard=user,

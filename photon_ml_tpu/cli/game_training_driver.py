@@ -33,6 +33,7 @@ from photon_ml_tpu.cli.configs import (
     estimator_coordinate_configs,
     evaluation_id_columns,
     expand_reg_weight_grid,
+    format_coordinate_config,
     parse_coordinate_config,
     parse_feature_shard_config,
 )
@@ -357,6 +358,12 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
 
     summary: dict = {
         "num_configurations": len(grid),
+        # effective configs in re-runnable CLI form (reference ScoptParameter
+        # print-round-trip)
+        "effective_coordinate_configurations": {
+            name: format_coordinate_config(cfg)
+            for name, cfg in params.coordinates.items()
+        },
         "best_configuration_index": best_index,
         "best_reg_weights": grid[best_index],
         "best_metric": best_metric,

@@ -498,3 +498,26 @@ def test_training_with_prebuilt_offheap_index_maps(game_data, tmp_path):
             common + ["--root-output-dir", str(tmp_path / "o3"),
                       "--index-maps-dir", str(tmp_path)]
         )
+
+
+def test_coordinate_config_print_round_trip():
+    """Reference ScoptParameter print-round-trip: parse(format(cfg)) == cfg
+    across every coordinate family."""
+    from photon_ml_tpu.cli.configs import (
+        format_coordinate_config,
+        parse_coordinate_config,
+    )
+
+    specs = [
+        "name=fe,feature.shard=g,optimizer=TRON,reg.weights=0.1|1|10,"
+        "max.iter=25,variance=true,reg.alpha=0.25",
+        "name=ru,feature.shard=u,random.effect.type=userId,"
+        "active.data.upper.bound=512,projector=INDEX_MAP,"
+        "features.to.samples.ratio=0.2,reg.weights=1",
+        "name=mf,mf.row.effect.type=u,mf.col.effect.type=i,"
+        "mf.latent.factors=8,mf.alternations=3,reg.weights=0.01",
+        "name=plain,feature.shard=g",
+    ]
+    for spec in specs:
+        cfg = parse_coordinate_config(spec)
+        assert parse_coordinate_config(format_coordinate_config(cfg)) == cfg
