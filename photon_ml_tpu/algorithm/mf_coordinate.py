@@ -110,10 +110,12 @@ def _build_side_buckets(
 ) -> list[MFSideBucket]:
     """Group samples by this side's entity (shared bucketing with
     build_random_effect_dataset; reservoir caps keyed on stable sample ids).
-    Samples whose other-side entity is unseen get weight 0 — they cannot
-    contribute a factor-feature."""
+    Samples whose other-side entity is unseen cannot contribute a
+    factor-feature, so they are excluded BEFORE grouping — otherwise they
+    would crowd usable samples out of the reservoir cap."""
+    effective_idx = np.where(other_idx >= 0, entity_idx, -1)
     per_bucket = group_entities_into_buckets(
-        entity_idx,
+        effective_idx,
         unique_ids,
         bucket_sizes=bucket_sizes,
         active_data_upper_bound=active_data_upper_bound,

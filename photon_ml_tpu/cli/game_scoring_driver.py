@@ -83,12 +83,26 @@ def run(
         with Timed("load model"):
             model, index_maps = load_game_model_and_index_maps(model_input_dir)
     entity_vocabs: dict[str, np.ndarray] = {}
+
+    def set_vocab(effect_type: str, keys: np.ndarray) -> None:
+        keys = np.asarray(keys)
+        existing = entity_vocabs.get(effect_type)
+        if existing is not None and not np.array_equal(existing, keys):
+            # two sub-models disagreeing on a shared entity space would
+            # silently misalign one model's table rows
+            raise ValueError(
+                f"sub-models disagree on entity keys for effect type "
+                f"'{effect_type}' ({len(existing)} vs {len(keys)} keys); "
+                "cannot build a consistent scoring vocab"
+            )
+        entity_vocabs[effect_type] = keys
+
     for m in model.models.values():
         if isinstance(m, RandomEffectModel):
-            entity_vocabs[m.random_effect_type] = np.asarray(m.entity_keys)
+            set_vocab(m.random_effect_type, m.entity_keys)
         elif isinstance(m, MatrixFactorizationModel):
-            entity_vocabs[m.row_effect_type] = np.asarray(m.row_keys)
-            entity_vocabs[m.col_effect_type] = np.asarray(m.col_keys)
+            set_vocab(m.row_effect_type, m.row_keys)
+            set_vocab(m.col_effect_type, m.col_keys)
     re_columns = tuple(sorted(entity_vocabs))
 
     with Timed("read scoring data"):

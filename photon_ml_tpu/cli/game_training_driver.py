@@ -142,9 +142,10 @@ class GameTrainingParams:
             except ValueError as e:
                 problems.append(str(e))
         if self.index_maps_dir:
-            # typo'd stores dir must fail before the output dir is touched
+            # typo'd stores dir must fail before the output dir is touched;
+            # filenames only — no store is opened/mmapped here
             try:
-                found = IndexMap.load_directory(self.index_maps_dir)
+                found = IndexMap.list_directory(self.index_maps_dir)
                 missing = set(self.feature_shards) - set(found)
                 if missing:
                     problems.append(

@@ -75,6 +75,12 @@ def read_libsvm(path: str | os.PathLike, *, zero_based: bool = False) -> Iterato
                     break  # trailing comment
                 idx_s, _, val_s = tok.partition(":")
                 idx = int(idx_s) - (0 if zero_based else 1)
+                if idx < 0:
+                    # match the CSR parsers: a 0 index in a 1-based file is
+                    # an error, not a phantom feature named "-1"
+                    raise ValueError(
+                        f"feature index out of range at line {i + 1}: {tok!r}"
+                    )
                 features.append({"name": str(idx), "term": "", "value": float(val_s)})
             yield {
                 "uid": str(i),

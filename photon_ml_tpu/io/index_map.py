@@ -100,6 +100,18 @@ class IndexMap(Mapping[str, int]):
 
     # Persistence ------------------------------------------------------------
     @staticmethod
+    def list_directory(directory: str | os.PathLike) -> set[str]:
+        """Shard names present in a stores directory, from filenames alone —
+        no store is opened (cheap existence/coverage validation)."""
+        shards: set[str] = set()
+        for fname in os.listdir(str(directory)):
+            if fname.endswith(".keys"):
+                shards.add(fname[: -len(".keys")])
+            elif fname.endswith(".photonix.json"):
+                shards.add(fname[: -len(".photonix.json")])
+        return shards
+
+    @staticmethod
     def load_directory(directory: str | os.PathLike) -> dict[str, "IndexMap"]:
         """Load every index map in a directory, both formats: plain
         ``<shard>.keys`` files and partitioned native off-heap stores

@@ -185,6 +185,11 @@ class GameTrainProgram:
                 f"coordinate names must be unique across the FE feature "
                 f"shard, RE types, and MF names (duplicates: {sorted(dupes)})"
             )
+        if "__mf__" in names:
+            raise ValueError(
+                "'__mf__' is reserved (internal bucket-group key); rename "
+                "the coordinate"
+            )
         loss = loss_for_task(task)
         self._loss = loss
         self.normalization = normalization

@@ -281,3 +281,13 @@ def test_game_model_to_state_warm_start(rng, tmp_path):
     )
     # warm start must land at (or below) the converged loss, not the cold one
     assert losses2[0] <= losses[-1] + 1e-6, (losses, losses2)
+
+
+def test_program_rejects_reserved_name(rng):
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=2)
+    with pytest.raises(ValueError, match="reserved"):
+        GameTrainProgram(
+            TaskType.LINEAR_REGRESSION,
+            FixedEffectStepSpec("g", opt),
+            (RandomEffectStepSpec("__mf__", "r", opt),),
+        )

@@ -77,16 +77,18 @@ def test_build_mf_dataset_buckets(rng):
     )
     mf = build_mf_dataset(ds, "user", "item")
     assert mf.num_row_entities == len(np.unique(rows))
-    # every sample slot whose item is unseen must carry zero weight
+    # samples whose item is unseen cannot contribute a factor-feature and
+    # are excluded from the row-side buckets entirely (they must not crowd
+    # usable samples out of reservoir caps)
     item_idx = np.asarray(ds.entity_idx["item"])
+    usable = int((item_idx >= 0).sum())
+    assert usable < 100  # the vocab knockout actually removed some
+    total = sum(int((np.asarray(b.sample_rows) >= 0).sum()) for b in mf.row_buckets)
+    assert total == usable
     for b in mf.row_buckets:
         sr = np.asarray(b.sample_rows)
         w = np.asarray(b.weights)
-        valid = sr >= 0
-        assert np.all(w[valid & (item_idx[np.maximum(sr, 0)] < 0)] == 0.0)
-    # total (row-side) training slots == samples with a valid user
-    total = sum(int((np.asarray(b.sample_rows) >= 0).sum()) for b in mf.row_buckets)
-    assert total == 100
+        assert np.all(w[sr >= 0] > 0)  # every bucketed slot is trainable
 
 
 def test_mf_coordinate_recovers_low_rank(rng):
@@ -304,3 +306,23 @@ def test_mf_model_avro_round_trip(tmp_path, rng):
         np.asarray(model.score_dataset(ds)),
         rtol=1e-6,
     )
+
+
+def test_mf_reservoir_cap_ignores_unusable_samples(rng):
+    """Samples whose other-side entity is unseen must not crowd usable
+    samples out of the reservoir cap."""
+    n_usable, n_dead = 6, 40
+    rows = np.array(["r0"] * (n_usable + n_dead))
+    cols = np.array(["c0"] * n_usable + ["GONE"] * n_dead)
+    y = rng.normal(size=n_usable + n_dead)
+    ds = build_game_dataset(
+        labels=y, feature_shards={},
+        entity_keys={"user": rows, "item": cols},
+        entity_vocabs={"item": np.array(["c0"])},
+        dtype=np.float64,
+    )
+    mf = build_mf_dataset(ds, "user", "item", bucket_sizes=(8,),
+                          active_data_upper_bound=8)
+    # all 6 usable samples must survive the cap with nonzero weight
+    kept = sum(float((np.asarray(b.weights) > 0).sum()) for b in mf.row_buckets)
+    assert kept == n_usable

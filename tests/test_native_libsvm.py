@@ -205,3 +205,12 @@ def test_libsvm_to_avro_converter_round_trip(tmp_path, svm_file):
     # CLI entry point works too
     n2 = main(["--input", str(svm_file), "--output", str(tmp_path / "x.avro")])
     assert n2 == 4
+
+
+def test_read_libsvm_rejects_invalid_index(tmp_path):
+    """The record path matches the CSR parsers: index 0 in a 1-based file is
+    an error, not a phantom '-1' feature."""
+    p = tmp_path / "bad.libsvm"
+    p.write_text("1 0:1.5 2:1\n")
+    with pytest.raises(ValueError, match="out of range"):
+        list(read_libsvm(p))
