@@ -474,3 +474,71 @@ class TestGameScoringDriverInteg:
         ])
         # best-over-priors: run 2's tuned metric can't be worse than run 1's
         assert s2["tuned_metric"] <= s1["tuned_metric"] + 1e-9
+
+
+class TestTaskOptimizerMatrix:
+    """BASELINE.md target configs: every task family through the GLM driver,
+    LBFGS vs TRON where valid (smoothed hinge has no Hessian -> LBFGS only,
+    like the reference)."""
+
+    @staticmethod
+    def _write_libsvm(tmp_path, task, n=400, d=6, seed=0):
+        rng = np.random.default_rng(seed)
+        w = rng.normal(size=d)
+        lines = []
+        for _ in range(n):
+            x = rng.normal(size=d)
+            eta = float(x @ w)
+            if task == "LOGISTIC_REGRESSION" or task == "SMOOTHED_HINGE_LOSS_LINEAR_SVM":
+                # 3x logit scale keeps label noise low enough for a clean
+                # AUC bar (the Bayes limit at scale 1 is ~0.75)
+                y = "+1" if rng.random() < 1 / (1 + np.exp(-3 * eta)) else "-1"
+            elif task == "POISSON_REGRESSION":
+                y = str(int(rng.poisson(np.exp(np.clip(0.3 * eta, -3, 3)))))
+            else:
+                y = f"{eta + 0.1 * rng.normal():.5f}"
+            lines.append(y + " " + " ".join(f"{j+1}:{x[j]:.5f}" for j in range(d)))
+        p = tmp_path / "d.libsvm"
+        p.write_text("\n".join(lines))
+        return p
+
+    @pytest.mark.parametrize("task,optimizer", [
+        ("LINEAR_REGRESSION", "LBFGS"),
+        ("LINEAR_REGRESSION", "TRON"),
+        ("LOGISTIC_REGRESSION", "TRON"),
+        ("POISSON_REGRESSION", "LBFGS"),
+        ("POISSON_REGRESSION", "TRON"),
+        ("SMOOTHED_HINGE_LOSS_LINEAR_SVM", "LBFGS"),
+    ])
+    def test_task_optimizer_combination(self, tmp_path, task, optimizer):
+        from photon_ml_tpu.cli import glm_driver
+
+        data = self._write_libsvm(tmp_path, task)
+        r = glm_driver.main([
+            "--input-data-path", str(data),
+            "--validation-data-path", str(data),
+            "--output-dir", str(tmp_path / "out"),
+            "--task-type", task,
+            "--optimizer", optimizer,
+            "--regularization-weights", "0.1",
+            "--input-format", "libsvm",
+            "--max-iterations", "30",
+        ])
+        metrics = r.validation_metrics[0.1]
+        assert all(np.isfinite(v) for v in metrics.values()), metrics
+        if task in ("LOGISTIC_REGRESSION", "SMOOTHED_HINGE_LOSS_LINEAR_SVM"):
+            assert metrics["AUC"] > 0.8, metrics
+
+    def test_svm_with_tron_rejected(self, tmp_path):
+        """Reference restricts smoothed hinge to the LBFGS family."""
+        from photon_ml_tpu.cli import glm_driver
+
+        data = self._write_libsvm(tmp_path, "SMOOTHED_HINGE_LOSS_LINEAR_SVM")
+        with pytest.raises(ValueError, match="twice-differentiable"):
+            glm_driver.main([
+                "--input-data-path", str(data),
+                "--output-dir", str(tmp_path / "out"),
+                "--task-type", "SMOOTHED_HINGE_LOSS_LINEAR_SVM",
+                "--optimizer", "TRON",
+                "--input-format", "libsvm",
+            ])
