@@ -208,6 +208,26 @@ class TestGameTrainingDriverInteg:
         ])
         assert s["best_metric"] < 2.1
 
+    @pytest.mark.parametrize("norm", [
+        "SCALE_WITH_STANDARD_DEVIATION", "SCALE_WITH_MAX_MAGNITUDE"
+    ])
+    def test_scaling_normalizations(self, music_data, tmp_path, norm):
+        """All normalization types through the driver (reference
+        NormalizationType.scala); scaling variants need no intercept."""
+        s = _train(music_data, tmp_path / "o", FE_ARGS + ["--normalization", norm])
+        assert s["best_metric"] < 2.1
+
+    def test_per_query_auc_and_precision(self, music_data, tmp_path):
+        """Per-query evaluator grammar end to end: RMSE:queryId and
+        PRECISION@2:queryId (reference MultiEvaluatorType names)."""
+        s = _train(music_data, tmp_path / "o", FE_ARGS + [
+            "--evaluators", "RMSE,RMSE:queryId,PRECISION@2:queryId",
+        ])
+        hist = s["metric_history"][0]["metrics"][-1]
+        assert "validate:RMSE:queryId" in hist
+        assert "validate:PRECISION@2:queryId" in hist
+        assert 0.0 <= hist["validate:PRECISION@2:queryId"] <= 1.0
+
     def test_standardization(self, music_data, tmp_path):
         s = _train(
             music_data, tmp_path / "o",
