@@ -35,6 +35,25 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def make_virtual_cpu_env(n_devices: int | None = None) -> dict:
+    """Subprocess env for a virtual CPU mesh: force the CPU backend, disarm
+    the container's axon sitecustomize (registers a TPU backend whenever
+    PALLAS_AXON_POOL_IPS is set, overriding JAX_PLATFORMS), and pin the
+    forced host device count (None = strip any inherited forcing, so the
+    child sees exactly one device)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    if n_devices is not None:
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -109,6 +109,36 @@ def test_graft_entry_contract():
     ge.dryrun_multichip(len(jax.devices()))
 
 
+def test_dryrun_multichip_self_provisions_from_one_device():
+    """Reproduce the driver's environment: ONE visible device, then ask for 8.
+
+    Round-1 gate failure (MULTICHIP_r01.json ok=false): dryrun_multichip(8)
+    did jax.devices()[:8] in a 1-chip environment and crashed reshaping the
+    mesh. The entry point must now self-provision a virtual 8-device CPU mesh
+    in a subprocess. This test runs the whole thing from a CLEAN subprocess
+    with device_count forced to 1 — no conftest help.
+    """
+    import os
+    import subprocess
+    import sys
+
+    from tests.conftest import make_virtual_cpu_env
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # n_devices=None strips inherited forcing: the outer process sees 1 device.
+    env = make_virtual_cpu_env(None)
+    code = (
+        "import jax; assert len(jax.devices()) == 1, jax.devices(); "
+        "import __graft_entry__ as g; g.dryrun_multichip(8); print('GATE_OK')"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=repo_root, env=env, capture_output=True, text=True, timeout=1200,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "GATE_OK" in proc.stdout
+
+
 def test_fused_step_with_mf_sharded_matches_single_device(rng):
     """The fused step including an MF coordinate must be sharding-invariant
     and reduce the loss on low-rank-structured data."""
