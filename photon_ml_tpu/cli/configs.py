@@ -163,28 +163,35 @@ class CoordinateCliConfig:
         )
 
 
+_CLI_DEFAULTS = {
+    f.name: f.default for f in dataclasses.fields(CoordinateCliConfig)
+}
+
+
 def format_coordinate_config(cfg: CoordinateCliConfig) -> str:
     """Render a config back to its CLI spec string (reference ScoptParameter
     print-round-trip: parse(format(cfg)) == cfg). Only non-default fields
-    are emitted."""
+    are emitted; defaults come from the dataclass itself so the round-trip
+    stays exact if CoordinateCliConfig's defaults ever change."""
+    d = _CLI_DEFAULTS
     parts = [f"name={cfg.name}"]
     if cfg.feature_shard:
         parts.append(f"feature.shard={cfg.feature_shard}")
-    if cfg.optimizer != OptimizerType.LBFGS:
+    if cfg.optimizer != d["optimizer"]:
         parts.append(f"optimizer={cfg.optimizer.value}")
-    if cfg.max_iterations != 100:
+    if cfg.max_iterations != d["max_iterations"]:
         parts.append(f"max.iter={cfg.max_iterations}")
-    if cfg.tolerance != 1e-7:
+    if cfg.tolerance != d["tolerance"]:
         parts.append(f"tolerance={cfg.tolerance!r}")
-    if cfg.reg_weights != (0.0,):
+    if cfg.reg_weights != d["reg_weights"]:
         parts.append(
             "reg.weights=" + LIST_SEP.join(repr(w) for w in cfg.reg_weights)
         )
-    if cfg.reg_alpha:
+    if cfg.reg_alpha != d["reg_alpha"]:
         parts.append(f"reg.alpha={cfg.reg_alpha!r}")
-    if cfg.down_sampling_rate != 1.0:
+    if cfg.down_sampling_rate != d["down_sampling_rate"]:
         parts.append(f"down.sampling.rate={cfg.down_sampling_rate!r}")
-    if cfg.compute_variance:
+    if cfg.compute_variance != d["compute_variance"]:
         parts.append("variance=true")
     if cfg.random_effect_type:
         parts.append(f"random.effect.type={cfg.random_effect_type}")
@@ -192,7 +199,7 @@ def format_coordinate_config(cfg: CoordinateCliConfig) -> str:
         parts.append(f"active.data.lower.bound={cfg.active_data_lower_bound}")
     if cfg.active_data_upper_bound is not None:
         parts.append(f"active.data.upper.bound={cfg.active_data_upper_bound}")
-    if cfg.projector != ProjectorType.IDENTITY:
+    if cfg.projector != d["projector"]:
         parts.append(f"projector={cfg.projector.value}")
     if cfg.projected_dim is not None:
         parts.append(f"projected.dim={cfg.projected_dim}")
@@ -202,7 +209,7 @@ def format_coordinate_config(cfg: CoordinateCliConfig) -> str:
         parts.append(f"mf.row.effect.type={cfg.mf_row_effect_type}")
         parts.append(f"mf.col.effect.type={cfg.mf_col_effect_type}")
         parts.append(f"mf.latent.factors={cfg.mf_latent_factors}")
-        if cfg.mf_alternations != 2:
+        if cfg.mf_alternations != d["mf_alternations"]:
             parts.append(f"mf.alternations={cfg.mf_alternations}")
     return ",".join(parts)
 

@@ -99,6 +99,31 @@ def _glm_to_record(
     return record
 
 
+def _coordinate_dirs(base: str) -> list[str]:
+    """Coordinate subdirectory names under a fixed-effect/random-effect/
+    matrix-factorization level, skipping stray files and Spark/OS markers
+    (_SUCCESS, .crc, .DS_Store) that a reference-written directory may hold."""
+    return sorted(
+        name
+        for name in os.listdir(base)
+        if os.path.isdir(os.path.join(base, name))
+        and not name.startswith(("_", "."))
+    )
+
+
+def _read_id_info(base: str, n_lines: int) -> list[str]:
+    """Read ``<base>/id-info`` and require at least ``n_lines`` lines,
+    raising an error that names the malformed coordinate directory."""
+    with open(os.path.join(base, ID_INFO)) as f:
+        lines = f.read().strip().splitlines()
+    if len(lines) < n_lines:
+        raise ValueError(
+            f"malformed id-info in '{base}': expected at least {n_lines} "
+            f"line(s), got {len(lines)}"
+        )
+    return lines
+
+
 def _has_part_files(directory: str) -> bool:
     """True if the directory holds at least one .avro part file (Spark may
     leave empty dirs with only _SUCCESS markers for untrained coordinates).
@@ -301,12 +326,11 @@ def load_game_model_and_index_maps(
 
     fe_dir = os.path.join(models_dir, FIXED_EFFECT)
     if os.path.isdir(fe_dir):
-        for name in sorted(os.listdir(fe_dir)):
+        for name in _coordinate_dirs(fe_dir):
             if coordinates_to_load is not None and name not in coordinates_to_load:
                 continue
             base = os.path.join(fe_dir, name)
-            with open(os.path.join(base, ID_INFO)) as f:
-                shard_id = f.read().strip().splitlines()[0]
+            shard_id = _read_id_info(base, 1)[0]
             if shard_id not in index_maps:
                 raise ValueError(
                     f"missing feature shard definition '{shard_id}' for coordinate '{name}'"
@@ -324,12 +348,11 @@ def load_game_model_and_index_maps(
 
     re_dir = os.path.join(models_dir, RANDOM_EFFECT)
     if os.path.isdir(re_dir):
-        for name in sorted(os.listdir(re_dir)):
+        for name in _coordinate_dirs(re_dir):
             if coordinates_to_load is not None and name not in coordinates_to_load:
                 continue
             base = os.path.join(re_dir, name)
-            with open(os.path.join(base, ID_INFO)) as f:
-                lines = f.read().strip().splitlines()
+            lines = _read_id_info(base, 2)
             re_type, shard_id = lines[0], lines[1]
             if shard_id not in index_maps:
                 raise ValueError(
@@ -372,12 +395,11 @@ def load_game_model_and_index_maps(
 
     mf_dir = os.path.join(models_dir, MATRIX_FACTORIZATION)
     if os.path.isdir(mf_dir):
-        for name in sorted(os.listdir(mf_dir)):
+        for name in _coordinate_dirs(mf_dir):
             if coordinates_to_load is not None and name not in coordinates_to_load:
                 continue
             base = os.path.join(mf_dir, name)
-            with open(os.path.join(base, ID_INFO)) as f:
-                lines = f.read().strip().splitlines()
+            lines = _read_id_info(base, 2)
             row_type, col_type = lines[0], lines[1]
 
             def read_factors(sub: str) -> tuple[np.ndarray, np.ndarray]:
@@ -415,10 +437,9 @@ def _harvest_index_maps(models_dir: str, read_records) -> dict[str, IndexMap]:
     def scan(base: str, shard_line: int) -> None:
         if not os.path.isdir(base):
             return
-        for name in sorted(os.listdir(base)):
+        for name in _coordinate_dirs(base):
             sub = os.path.join(base, name)
-            with open(os.path.join(sub, ID_INFO)) as f:
-                shard_id = f.read().strip().splitlines()[shard_line]
+            shard_id = _read_id_info(sub, shard_line + 1)[shard_line]
             keys = keys_per_shard.setdefault(shard_id, set())
             coeff_dir = os.path.join(sub, COEFFICIENTS)
             if not _has_part_files(coeff_dir):

@@ -599,6 +599,14 @@ def game_model_to_state(
     mf_rows, mf_cols = {}, {}
     for spec in program.mf_specs:
         m = model.get(spec.name)
+        model_k = np.asarray(m.row_factors).shape[1]
+        if model_k != spec.num_latent_factors:
+            raise ValueError(
+                f"warm-start MF model for coordinate '{spec.name}' has "
+                f"latent dimension {model_k} but the spec configures "
+                f"num_latent_factors={spec.num_latent_factors} — retrain or "
+                "match the spec to the saved model"
+            )
         mf_rows[spec.name] = align(
             m.row_factors, m.row_keys,
             dataset.entity_vocabs[spec.row_effect_type], spec.name,

@@ -313,6 +313,44 @@ def test_game_model_to_state_warm_start(rng, tmp_path):
     assert losses2[0] <= losses[-1] + 1e-6, (losses, losses2)
 
 
+def test_warm_start_rejects_mf_latent_dim_mismatch(rng):
+    """A saved MF model with a different k than the spec must fail loudly,
+    not silently train at the model's k."""
+    from photon_ml_tpu.algorithm.mf_coordinate import build_mf_dataset
+    from photon_ml_tpu.parallel.distributed import (
+        MatrixFactorizationStepSpec,
+        game_model_to_state,
+        state_to_game_model,
+    )
+
+    n = 32
+    users = np.array([f"u{i}" for i in rng.integers(0, 5, size=n)])
+    items = np.array([f"i{i}" for i in rng.integers(0, 4, size=n)])
+    x = rng.normal(size=(n, 4)).astype(np.float64)
+    y = rng.normal(size=n)
+    dataset = build_game_dataset(
+        labels=y, feature_shards={"global": x},
+        entity_keys={"user": users, "item": items}, dtype=np.float64,
+    )
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=2)
+
+    def program(k):
+        return GameTrainProgram(
+            TaskType.LINEAR_REGRESSION,
+            FixedEffectStepSpec("global", opt),
+            (),
+            mf_specs=(MatrixFactorizationStepSpec(
+                "mf", "user", "item", num_latent_factors=k, optimizer=opt),),
+        )
+
+    mf = {"mf": build_mf_dataset(dataset, "user", "item", bucket_sizes=(32,))}
+    state, _ = train_distributed(program(2), dataset, {}, mf_datasets=mf,
+                                 num_iterations=1)
+    model = state_to_game_model(program(2), state, dataset)
+    with pytest.raises(ValueError, match="latent dimension"):
+        game_model_to_state(program(3), model, dataset)
+
+
 def test_program_rejects_reserved_name(rng):
     opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=2)
     with pytest.raises(ValueError, match="reserved"):

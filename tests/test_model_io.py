@@ -74,6 +74,48 @@ def test_game_model_round_trip(tmp_path):
     )
 
 
+def test_load_ignores_stray_marker_files(tmp_path):
+    """Spark/OS markers (_SUCCESS, .crc, .DS_Store) and stray files at the
+    coordinate level must not break loading a reference-written model."""
+    imap = _index_map(4)
+    fe = FixedEffectModel(
+        glm=GeneralizedLinearModel(
+            Coefficients(means=jnp.asarray([1.0, -2.0, 0.5, 0.0])),
+            TaskType.LINEAR_REGRESSION,
+        ),
+        feature_shard_id="s",
+    )
+    out = tmp_path / "m"
+    save_game_model(out, GameModel(models={"fixed": fe}), {"s": imap},
+                    sparsity_threshold=0.0)
+    (out / "fixed-effect" / "_SUCCESS").touch()
+    (out / "fixed-effect" / ".part-0.crc").write_text("x")
+    (out / "fixed-effect" / "stray.txt").write_text("not a coordinate")
+
+    back = load_game_model(out, {"s": imap})  # explicit maps
+    assert set(back.models) == {"fixed"}
+    back2 = load_game_model(out)  # harvest path scans the same level
+    assert set(back2.models) == {"fixed"}
+
+
+def test_malformed_id_info_names_directory(tmp_path):
+    imap = _index_map(2)
+    fe = FixedEffectModel(
+        glm=GeneralizedLinearModel(
+            Coefficients(means=jnp.asarray([1.0, 2.0])), TaskType.LINEAR_REGRESSION
+        ),
+        feature_shard_id="s",
+    )
+    out = tmp_path / "m"
+    save_game_model(out, GameModel(models={"fixed": fe}), {"s": imap},
+                    sparsity_threshold=0.0)
+    (out / "fixed-effect" / "fixed" / "id-info").write_text("")
+    import pytest
+
+    with pytest.raises(ValueError, match="id-info"):
+        load_game_model(out, {"s": imap})
+
+
 def test_sparsity_threshold(tmp_path):
     imap = _index_map(3)
     fe = FixedEffectModel(
