@@ -81,11 +81,13 @@ class Coordinate:
 
 
 def _make_objective(task: TaskType, cfg: CoordinateOptimizationConfig,
-                    normalization: NormalizationContext | None) -> GLMObjective:
+                    normalization: NormalizationContext | None,
+                    use_pallas: bool | None = False) -> GLMObjective:
     return GLMObjective(
         loss_for_task(task),
         l2_weight=cfg.l2_weight,
         normalization=normalization,
+        use_pallas=use_pallas,
     )
 
 
@@ -144,6 +146,9 @@ class FixedEffectCoordinate(Coordinate):
             )
             self._update_count += 1
             batch = batch.replace(weights=jnp.asarray(new_w, dtype=batch.weights.dtype))
+        # use_pallas=False: measured on v5e (BASELINE.md), XLA already fuses
+        # the FE value+gradient into ONE pass over X at ~750 GB/s; the
+        # hand-written kernel streams at ~270 GB/s. Autodiff IS the fast path.
         objective = _make_objective(self.task, self.config, self.normalization)
         norm = objective.normalization
         w0 = norm.from_model_space(model.glm.coefficients.means, self.intercept_index)

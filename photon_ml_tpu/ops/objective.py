@@ -53,15 +53,19 @@ class GLMObjective:
         l2_weight: float = 0.0,
         normalization: NormalizationContext | None = None,
         axis_name: str | None = None,
-        use_pallas: bool = False,
+        use_pallas: bool | None = False,
     ):
         self.loss = loss
         self.l2_weight = float(l2_weight)
         self.normalization = normalization if normalization is not None else no_normalization()
         self.axis_name = axis_name
-        #: route value_and_gradient through the fused Pallas kernel
-        #: (ops/pallas_glm.py). Only taken on the plain un-normalized,
-        #: un-sharded objective; anything else falls back to autodiff.
+        #: route value_and_gradient through the hand-written Pallas kernel
+        #: (ops/pallas_glm.py). True forces it, False forces autodiff, None
+        #: means "auto" (currently = autodiff even on TPU: measured on v5e,
+        #: XLA fuses the autodiff value+gradient into one pass over X at
+        #: near-roofline HBM bandwidth and beats the kernel ~3x — see
+        #: pallas_glm.py docstring and BASELINE.md). Only valid on the
+        #: un-sharded (axis_name=None), un-vmapped solve path.
         self.use_pallas = use_pallas
 
     # Value-based identity so jit static-arg caching works across repeated
@@ -101,19 +105,21 @@ class GLMObjective:
 
     # -- derivatives ---------------------------------------------------------
 
+    def _pallas_enabled(self) -> bool:
+        if self.use_pallas is None:
+            # auto: XLA's own fusion measured faster than the kernel on v5e
+            return False
+        return self.use_pallas and self.axis_name is None
+
     def value_and_gradient(
         self, coefficients: Array, batch: LabeledPointBatch
     ) -> tuple[Array, Array]:
-        if (
-            self.use_pallas
-            and self.axis_name is None
-            and self.normalization.factors is None
-            and self.normalization.shifts is None
-        ):
+        if self._pallas_enabled():
             from photon_ml_tpu.ops.pallas_glm import fused_value_and_gradient
 
             return fused_value_and_gradient(
-                self.loss, coefficients, batch, l2_weight=self.l2_weight
+                self.loss, coefficients, batch,
+                l2_weight=self.l2_weight, normalization=self.normalization,
             )
         return jax.value_and_grad(self.value)(coefficients, batch)
 

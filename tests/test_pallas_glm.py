@@ -90,20 +90,38 @@ def test_objective_use_pallas_flag_in_solver():
     np.testing.assert_allclose(sols[0], sols[1], rtol=1e-3, atol=1e-3)
 
 
-def test_pallas_falls_back_with_normalization():
+@pytest.mark.parametrize("norm_type", ["SCALE_WITH_STANDARD_DEVIATION", "STANDARDIZATION"])
+def test_pallas_normalized_matches_autodiff(norm_type):
+    """The kernel supports the normalization algebra (effective coefficients
+    + margin shift + Σr chain rule) — same numbers as the autodiff path."""
     from photon_ml_tpu.ops.normalization import NormalizationType, build_normalization
 
-    batch = _batch(64, 8)
+    rng = np.random.default_rng(3)
+    batch = _batch(200, 12, binary=True)
     norm = build_normalization(
-        NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
-        mean=jnp.zeros(8),
-        variance=jnp.ones(8) * 4.0,
-        max_magnitude=jnp.ones(8),
+        NormalizationType[norm_type],
+        mean=jnp.asarray(rng.normal(size=12).astype(np.float32)),
+        variance=jnp.asarray(rng.uniform(0.5, 4.0, size=12).astype(np.float32)),
+        max_magnitude=jnp.ones(12),
+        intercept_index=0,
     )
-    objective = GLMObjective(SquaredLoss(), normalization=norm, use_pallas=True)
-    w = jnp.ones(8, jnp.float32)
-    # must not raise and must equal the autodiff value (fallback path)
+    objective = GLMObjective(LogisticLoss(), l2_weight=0.3,
+                             normalization=norm, use_pallas=True)
+    w = jnp.asarray(rng.normal(size=12).astype(np.float32)) * 0.4
     v, g = objective.value_and_gradient(w, batch)
     ref_v, ref_g = jax.value_and_grad(objective.value)(w, batch)
-    np.testing.assert_allclose(float(v), float(ref_v), rtol=1e-6)
-    np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g), rtol=1e-6)
+    np.testing.assert_allclose(float(v), float(ref_v), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g), rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_auto_mode_off_tpu_uses_autodiff():
+    """use_pallas=None is 'auto': off-TPU it must resolve to the autodiff
+    path (exact f64 numbers on the CPU test mesh)."""
+    batch = _batch(64, 8)
+    objective = GLMObjective(SquaredLoss(), l2_weight=0.1, use_pallas=None)
+    assert not objective._pallas_enabled()
+    w = jnp.asarray(np.random.default_rng(4).normal(size=8))
+    v, g = objective.value_and_gradient(w, batch)
+    ref_v, ref_g = jax.value_and_grad(objective.value)(w, batch)
+    np.testing.assert_allclose(float(v), float(ref_v), rtol=0, atol=0)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g), rtol=0, atol=0)
