@@ -169,7 +169,6 @@ def bench_game_sweep() -> dict:
     2 RE coordinates + rescoring — as marginal ms/sweep (sweep-count
     differencing cancels dispatch + input-layout fixed costs)."""
     import jax
-    import jax.numpy as jnp
 
     from photon_ml_tpu.data.game_data import (
         build_game_dataset,
@@ -179,8 +178,8 @@ def bench_game_sweep() -> dict:
     from photon_ml_tpu.parallel.distributed import (
         FixedEffectStepSpec,
         GameTrainProgram,
+        GameTrainState,
         RandomEffectStepSpec,
-        train_distributed,
     )
     from photon_ml_tpu.types import TaskType
 
@@ -214,19 +213,40 @@ def bench_game_sweep() -> dict:
         ),
     )
 
-    def timed(k, seed):
-        t0 = time.perf_counter()
-        state, losses = train_distributed(
-            program, dataset, re_datasets, num_iterations=k,
+    data, buckets = program.prepare_inputs(dataset, re_datasets, None)
+    base_state = program.init_state(dataset, re_datasets, None)
+
+    def perturbed(seed):
+        # fresh warm start per rep: identical repeat executions can be
+        # served from a backend cache (see module docstring)
+        key = jax.random.PRNGKey(seed)
+        keys = jax.random.split(key, 1 + len(base_state.re_tables))
+        return GameTrainState(
+            fe_coefficients=base_state.fe_coefficients
+            + 1e-3 * jax.random.normal(keys[0], base_state.fe_coefficients.shape),
+            re_tables={
+                t: tab + 1e-3 * jax.random.normal(k, tab.shape)
+                for k, (t, tab) in zip(keys[1:], base_state.re_tables.items())
+            },
+            mf_rows=dict(base_state.mf_rows),
+            mf_cols=dict(base_state.mf_cols),
         )
-        float(jnp.asarray(losses)[-1])
+
+    def timed(k, seed):
+        # k dispatches enqueue asynchronously (no host read between sweeps),
+        # so per-call dispatch overlaps device execution and the K-step
+        # differencing isolates true per-sweep device time
+        state = perturbed(seed)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            state, loss = program.step(data, buckets, state)
         float(np.asarray(state.fe_coefficients)[0])  # host read: hard sync
         return time.perf_counter() - t0
 
     timed(1, 0)  # compile + sync
     lo = min(timed(1, s) for s in (1, 2))
-    hi = min(timed(3, s) for s in (3, 4))
-    per_sweep = max((hi - lo) / 2, 1e-6)
+    hi = min(timed(5, s) for s in (3, 4))
+    per_sweep = max((hi - lo) / 4, 1e-6)
     return {
         "metric": "fused_game_sweep_ms",
         "value": round(per_sweep * 1e3, 1),

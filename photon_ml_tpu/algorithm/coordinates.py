@@ -41,6 +41,13 @@ from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.variance import (
+    coefficient_variances,
+    diag_inverse_from_hessian,
+    inverse_of_diagonal,
+    resolve_variance_mode,
+    validate_variance_mode,
+)
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType, solve
 from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.types import TaskType
@@ -57,7 +64,11 @@ class CoordinateOptimizationConfig:
     l2_weight: float = 0.0
     l1_weight: float = 0.0
     compute_variance: bool = False
+    variance_mode: str = "auto"  # "auto" | "full" (diag(H⁻¹)) | "diagonal"
     down_sampling_rate: float = 1.0
+
+    def __post_init__(self):
+        validate_variance_mode(self.variance_mode)
 
     @property
     def uses_owlqn(self) -> bool:
@@ -81,13 +92,12 @@ class Coordinate:
 
 
 def _make_objective(task: TaskType, cfg: CoordinateOptimizationConfig,
-                    normalization: NormalizationContext | None,
-                    use_pallas: bool | None = False) -> GLMObjective:
+                    normalization: NormalizationContext | None) -> GLMObjective:
     return GLMObjective(
         loss_for_task(task),
         l2_weight=cfg.l2_weight,
         normalization=normalization,
-        use_pallas=use_pallas,
+        use_pallas=False,
     )
 
 
@@ -159,7 +169,10 @@ class FixedEffectCoordinate(Coordinate):
         variances = None
         if self.config.compute_variance:
             variances = norm.variances_to_model_space(
-                _variance_diagonal(objective, result.coefficients, batch)
+                coefficient_variances(
+                    objective, result.coefficients, batch,
+                    mode=self.config.variance_mode,
+                )
             )
         glm = GeneralizedLinearModel(
             Coefficients(means=means, variances=variances), self.task
@@ -174,15 +187,6 @@ class FixedEffectCoordinate(Coordinate):
 def _jitted_fe_solve(objective: GLMObjective, opt: OptimizerConfig,
                      batch: LabeledPointBatch, w0: Array):
     return solve(opt, objective.bind(batch), w0)
-
-
-def _variance_diagonal(objective: GLMObjective, w: Array, batch: LabeledPointBatch) -> Array:
-    """Per-coefficient variance ~ 1 / diag(H) (diagonal approximation; the
-    reference computes full-Hessian Cholesky inverse for small dims,
-    DistributedOptimizationProblem.scala:82-134 — full inverse available via
-    objective.hessian_matrix for d small enough)."""
-    diag = objective.hessian_diagonal(w, batch)
-    return 1.0 / jnp.maximum(diag, 1e-12)
 
 
 @dataclasses.dataclass
@@ -215,6 +219,14 @@ class RandomEffectCoordinate(Coordinate):
                 "feature normalization is not supported with projected "
                 "random-effect coordinates (normalize upstream or use "
                 "ProjectorType.IDENTITY)"
+            )
+        if projector != ProjectorType.IDENTITY and self.config.compute_variance:
+            # the reference computes projected-space variances and un-projects
+            # them with the model; supporting that here means threading the
+            # per-entity column maps through a second scatter — not wired yet
+            raise ValueError(
+                "variance computation is not supported with projected "
+                "random-effect coordinates (use ProjectorType.IDENTITY)"
             )
         objective = _make_objective(self.task, self.config, self.normalization)
         opt = _solve_config(self.config)
@@ -254,8 +266,39 @@ class RandomEffectCoordinate(Coordinate):
                     bucket.sample_rows, bucket.entity_rows,
                     full_offsets, table,
                 )
+        variances = None
+        if self.config.compute_variance:
+            # per-entity diag(H⁻¹): one batched Cholesky per bucket
+            # (reference SingleNodeOptimizationProblem.computeVariances:58-69
+            # runs this per RDD record; here the entity axis is vmapped).
+            # Mode resolution budgets for the whole [e, d, d] Hessian stack
+            # of the largest bucket, not one Hessian. Entities in no bucket
+            # (below active_data_lower_bound / vocab-only) keep NaN — "no
+            # variance computed" — and the model writer drops their
+            # variances field rather than persisting a false 0.
+            max_bucket = max(
+                (b.entity_rows.shape[0] for b in self.re_dataset.buckets),
+                default=1,
+            )
+            resolved = resolve_variance_mode(
+                self.config.variance_mode, self.re_dataset.dim,
+                num_problems=max_bucket,
+            )
+            kernel = (
+                _jitted_re_bucket_variances if resolved == "full"
+                else _jitted_re_bucket_variances_diagonal
+            )
+            var_table = jnp.full_like(table, jnp.nan)
+            for bucket in self.re_dataset.buckets:
+                var_table = kernel(
+                    objective,
+                    bucket.features, bucket.labels, bucket.weights,
+                    bucket.sample_rows, bucket.entity_rows,
+                    full_offsets, table, var_table,
+                )
+            variances = norm.variances_to_model_space(var_table)
         table = norm.to_model_space(table, self.intercept_index)
-        return model.with_coefficients(table), None
+        return dataclasses.replace(model, coefficients=table, variances=variances), None
 
     def score(self, model: RandomEffectModel) -> Array:
         return model.score_dataset(self.dataset)
@@ -324,6 +367,54 @@ def _jitted_re_bucket_solve(
         objective, opt, features, labels, weights, sample_rows, entity_rows,
         full_offsets, table,
     )
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jitted_re_bucket_variances(
+    objective: GLMObjective,
+    features: Array,  # [e, cap, d]
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    full_offsets: Array,
+    table: Array,  # [E, d] solved coefficients (normalized space)
+    var_table: Array,  # [E, d] accumulator
+):
+    """Per-entity diag(H⁻¹) at the solved coefficients, scattered into
+    var_table with the same index semantics as solve_entity_bucket."""
+    offsets = _bucket_offsets(sample_rows, full_offsets)
+
+    def one(f, l, o, wt, w):
+        batch = LabeledPointBatch(features=f, labels=l, offsets=o, weights=wt)
+        return diag_inverse_from_hessian(objective.hessian_matrix(w, batch))
+
+    vs = jax.vmap(one)(features, labels, offsets, weights, table[entity_rows])
+    return var_table.at[entity_rows].set(vs)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jitted_re_bucket_variances_diagonal(
+    objective: GLMObjective,
+    features: Array,
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    full_offsets: Array,
+    table: Array,
+    var_table: Array,
+):
+    """Diagonal-approximation twin of :func:`_jitted_re_bucket_variances` —
+    1/diag(H) per entity without materializing the [e, d, d] Hessian stack."""
+    offsets = _bucket_offsets(sample_rows, full_offsets)
+
+    def one(f, l, o, wt, w):
+        batch = LabeledPointBatch(features=f, labels=l, offsets=o, weights=wt)
+        return inverse_of_diagonal(objective.hessian_diagonal(w, batch))
+
+    vs = jax.vmap(one)(features, labels, offsets, weights, table[entity_rows])
+    return var_table.at[entity_rows].set(vs)
 
 
 @partial(jax.jit, static_argnums=(0, 1))

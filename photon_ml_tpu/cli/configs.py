@@ -26,6 +26,7 @@ from photon_ml_tpu.estimators import (
     RandomEffectCoordinateConfig,
 )
 from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
+from photon_ml_tpu.ops.variance import validate_variance_mode
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
 from photon_ml_tpu.projector.projectors import ProjectorType
 
@@ -99,6 +100,7 @@ class CoordinateCliConfig:
     reg_alpha: float = 0.0  # elastic-net: fraction of λ on L1
     down_sampling_rate: float = 1.0
     compute_variance: bool = False
+    variance_mode: str = "auto"  # "auto" | "full" | "diagonal"
     # random-effect only
     random_effect_type: str | None = None
     active_data_lower_bound: int | None = None
@@ -133,6 +135,7 @@ class CoordinateCliConfig:
             l2_weight=l2,
             l1_weight=l1,
             compute_variance=self.compute_variance,
+            variance_mode=self.variance_mode,
             down_sampling_rate=self.down_sampling_rate,
         )
 
@@ -193,6 +196,8 @@ def format_coordinate_config(cfg: CoordinateCliConfig) -> str:
         parts.append(f"down.sampling.rate={cfg.down_sampling_rate!r}")
     if cfg.compute_variance != d["compute_variance"]:
         parts.append("variance=true")
+    if cfg.variance_mode != d["variance_mode"]:
+        parts.append(f"variance.mode={cfg.variance_mode}")
     if cfg.random_effect_type:
         parts.append(f"random.effect.type={cfg.random_effect_type}")
     if cfg.active_data_lower_bound is not None:
@@ -247,6 +252,7 @@ def parse_coordinate_config(spec: str) -> CoordinateCliConfig:
         reg_alpha=float(pop("reg.alpha", "0")),
         down_sampling_rate=float(pop("down.sampling.rate", "1")),
         compute_variance=_bool(pop("variance", "false")),
+        variance_mode=validate_variance_mode(pop("variance.mode", "auto").lower()),
         random_effect_type=pop("random.effect.type"),
         active_data_lower_bound=(
             int(v) if (v := pop("active.data.lower.bound")) else None

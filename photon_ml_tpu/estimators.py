@@ -58,6 +58,13 @@ from photon_ml_tpu.ops.normalization import (
     build_normalization,
 )
 from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.variance import (
+    coefficient_variances,
+    diag_inverse_from_hessian,
+    inverse_of_diagonal,
+    resolve_variance_mode,
+    validate_variance_mode,
+)
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType, solve
 from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.types import TaskType
@@ -298,6 +305,7 @@ def train_glm_grid(
     normalization: NormalizationContext | None = None,
     intercept_index: int | None = None,
     compute_variance: bool = False,
+    variance_mode: str = "auto",
     lower_bounds=None,
     upper_bounds=None,
 ) -> dict[float, GeneralizedLinearModel]:
@@ -321,6 +329,11 @@ def train_glm_grid(
     control flow and stays on the sequential path.
     """
     optimizer = optimizer or OptimizerConfig()
+    # lane-aware resolution: L full Hessians materialize at once — validate
+    # before any lane trains
+    resolved_variance = resolve_variance_mode(
+        variance_mode, batch.dim, num_problems=len(regularization_weights)
+    )
     if optimizer.optimizer_type not in (
         OptimizerType.LBFGS, OptimizerType.OWLQN
     ):
@@ -363,18 +376,26 @@ def train_glm_grid(
         bounds,
     )
     norm = objective.normalization
-    diags = None
+    lane_variances = None
     if compute_variance:
-        diags = _jitted_grid_diagonals(objective, batch, results.coefficients, l2s)
+        if resolved_variance == "full":
+            # reference-fidelity diag(H⁻¹) per lane; the [L, d, d] Hessian
+            # stack shares one read of the feature block
+            lane_variances = _jitted_grid_full_variances(
+                objective, batch, results.coefficients, l2s
+            )
+        else:
+            diags = _jitted_grid_diagonals(
+                objective, batch, results.coefficients, l2s
+            )
+            lane_variances = inverse_of_diagonal(diags)
     models: dict[float, GeneralizedLinearModel] = {}
     for i, lam in enumerate(lams):
         w = results.coefficients[i]
         means = norm.to_model_space(w, intercept_index)
         variances = None
-        if diags is not None:
-            variances = norm.variances_to_model_space(
-                1.0 / jnp.maximum(diags[i], 1e-12)
-            )
+        if lane_variances is not None:
+            variances = norm.variances_to_model_space(lane_variances[i])
         models[lam] = GeneralizedLinearModel(
             Coefficients(means=means, variances=variances), task
         )
@@ -421,6 +442,17 @@ def _jitted_grid_diagonals(objective, batch, coeffs, l2v):
     return jax.vmap(per_lane)(coeffs, l2v)
 
 
+@functools.partial(jax.jit, static_argnums=(0,))
+def _jitted_grid_full_variances(objective, batch, coeffs, l2v):
+    """All lanes' diag(H⁻¹) (DistributedOptimizationProblem.scala:82-96)."""
+    def per_lane(w, l2):
+        h = objective.hessian_matrix(w, batch)
+        h = h + l2 * jnp.eye(h.shape[0], dtype=h.dtype)
+        return diag_inverse_from_hessian(h)
+
+    return jax.vmap(per_lane)(coeffs, l2v)
+
+
 def train_glm(
     batch: LabeledPointBatch,
     task: TaskType,
@@ -431,6 +463,7 @@ def train_glm(
     normalization: NormalizationContext | None = None,
     intercept_index: int | None = None,
     compute_variance: bool = False,
+    variance_mode: str = "auto",
     lower_bounds=None,
     upper_bounds=None,
 ) -> dict[float, GeneralizedLinearModel]:
@@ -443,6 +476,7 @@ def train_glm(
     normalized space internally).
     """
     optimizer = optimizer or OptimizerConfig()
+    validate_variance_mode(variance_mode)
     has_bounds = lower_bounds is not None or upper_bounds is not None
     if has_bounds and (
         elastic_net_alpha > 0.0
@@ -476,8 +510,9 @@ def train_glm(
         means = norm.to_model_space(w, intercept_index)
         variances = None
         if compute_variance:
-            diag = objective.hessian_diagonal(w, batch)
-            variances = norm.variances_to_model_space(1.0 / jnp.maximum(diag, 1e-12))
+            variances = norm.variances_to_model_space(
+                coefficient_variances(objective, w, batch, mode=variance_mode)
+            )
         models[lam] = GeneralizedLinearModel(
             Coefficients(means=means, variances=variances), task
         )

@@ -223,12 +223,24 @@ def save_game_model(
                 f.write(model.feature_shard_id + "\n")
             index_map = index_maps[model.feature_shard_id]
             table = np.asarray(model.coefficients)
+            var_table = (
+                np.asarray(model.variances) if model.variances is not None else None
+            )
             keys = [str(k) for k in np.asarray(model.entity_keys).tolist()]
 
             def records() -> Iterable[dict]:
                 for i, key in enumerate(keys):
+                    # NaN rows mark "no variance computed" for this entity
+                    # (e.g. below active_data_lower_bound) — drop the field
+                    # rather than persist a false number
+                    var_row = None
+                    if var_table is not None and bool(
+                        np.all(np.isfinite(var_table[i]))
+                    ):
+                        var_row = var_table[i]
                     glm = GeneralizedLinearModel(
-                        Coefficients(means=table[i]), model.task
+                        Coefficients(means=table[i], variances=var_row),
+                        model.task,
                     )
                     yield _glm_to_record(key, glm, index_map, sparsity_threshold)
 
@@ -380,10 +392,18 @@ def load_game_model_and_index_maps(
             keys = sorted(r["modelId"] for r in records)
             row = {k: i for i, k in enumerate(keys)}
             table = np.zeros((len(keys), index_map.size), dtype=dtype)
+            var_table = None
             model_task = task
             for record in records:
                 coeffs = _record_to_coefficients(record, index_map, dtype)
                 table[row[record["modelId"]]] = np.asarray(coeffs.means)
+                if coeffs.variances is not None:
+                    if var_table is None:
+                        # NaN = "record carried no variances": keeps entities
+                        # without the field distinguishable from genuinely
+                        # tiny variances
+                        var_table = np.full_like(table, np.nan)
+                    var_table[row[record["modelId"]]] = np.asarray(coeffs.variances)
                 model_task = _CLASS_TO_TASK.get(record.get("modelClass"), model_task)
             models[name] = RandomEffectModel(
                 coefficients=jnp.asarray(table),
@@ -391,6 +411,7 @@ def load_game_model_and_index_maps(
                 random_effect_type=re_type,
                 feature_shard_id=shard_id,
                 task=model_task,
+                variances=None if var_table is None else jnp.asarray(var_table),
             )
 
     mf_dir = os.path.join(models_dir, MATRIX_FACTORIZATION)

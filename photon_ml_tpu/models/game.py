@@ -83,7 +83,9 @@ class RandomEffectModel(DatumScoringModel):
         return score_random_effect(self.coefficients, features, entity_idx)
 
     def with_coefficients(self, coefficients: Array) -> "RandomEffectModel":
-        return dataclasses.replace(self, coefficients=coefficients)
+        """New table, dropping any variances (they were computed at the old
+        coefficients and would silently go stale)."""
+        return dataclasses.replace(self, coefficients=coefficients, variances=None)
 
 
 def score_random_effect(table: Array, features: Array, entity_idx: Array) -> Array:

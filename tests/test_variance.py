@@ -1,0 +1,472 @@
+"""Coefficient-variance fidelity tests.
+
+Reference behavior: computeVariances builds the full Hessian at the optimum
+and returns diag(H⁻¹) via Cholesky inverse
+(DistributedOptimizationProblem.scala:82-96,
+SingleNodeOptimizationProblem.scala:58-69, Linalg.scala choleskyInverse).
+These tests check the TPU implementation against closed-form numpy inverses
+and assert the variances survive the driver's Avro round trip.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.variance import (
+    FULL_VARIANCE_MAX_DIM,
+    coefficient_variances,
+    resolve_variance_mode,
+)
+from photon_ml_tpu.types import TaskType
+
+
+def _batch(n, d, seed, task=TaskType.LINEAR_REGRESSION):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    if task == TaskType.LOGISTIC_REGRESSION:
+        y = (rng.random(n) < 0.5).astype(np.float64)
+    else:
+        y = x @ rng.normal(size=d) + rng.normal(scale=0.1, size=n)
+    w = rng.uniform(0.5, 2.0, size=n)
+    return LabeledPointBatch(
+        features=jnp.asarray(x),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros(n),
+        weights=jnp.asarray(w),
+    )
+
+
+class TestModeResolution:
+    def test_auto_small_is_full(self):
+        assert resolve_variance_mode("auto", 64) == "full"
+
+    def test_auto_large_is_diagonal(self):
+        assert resolve_variance_mode("auto", FULL_VARIANCE_MAX_DIM + 1) == "diagonal"
+
+    def test_explicit_modes_pass_through(self):
+        assert resolve_variance_mode("full", 10**6) == "full"
+        assert resolve_variance_mode("diagonal", 2) == "diagonal"
+
+    def test_bad_mode_raises(self):
+        with pytest.raises(ValueError, match="variance mode"):
+            resolve_variance_mode("cholesky", 4)
+
+    def test_auto_accounts_for_lane_count(self):
+        # one 4096-dim Hessian fits the budget; 32 of them do not
+        assert resolve_variance_mode("auto", FULL_VARIANCE_MAX_DIM) == "full"
+        assert (
+            resolve_variance_mode("auto", FULL_VARIANCE_MAX_DIM, num_problems=32)
+            == "diagonal"
+        )
+
+    def test_cli_rejects_bad_mode_at_parse_time(self):
+        from photon_ml_tpu.cli.configs import parse_coordinate_config
+
+        with pytest.raises(ValueError, match="variance mode"):
+            parse_coordinate_config(
+                "name=fe,feature.shard=g,variance=true,variance.mode=cholesky"
+            )
+
+
+class TestClosedForm:
+    def test_linear_full_matches_numpy_inverse(self):
+        n, d, l2 = 200, 7, 0.5
+        batch = _batch(n, d, seed=0)
+        obj = GLMObjective(loss_for_task(TaskType.LINEAR_REGRESSION), l2_weight=l2)
+        w = jnp.asarray(np.random.default_rng(1).normal(size=d))
+        got = coefficient_variances(obj, w, batch, mode="full")
+        x = np.asarray(batch.features)
+        h = x.T @ (np.asarray(batch.weights)[:, None] * x) + l2 * np.eye(d)
+        np.testing.assert_allclose(
+            np.asarray(got), np.diag(np.linalg.inv(h)), rtol=1e-5
+        )
+
+    def test_logistic_full_matches_numpy_inverse(self):
+        n, d, l2 = 300, 5, 0.1
+        batch = _batch(n, d, seed=2, task=TaskType.LOGISTIC_REGRESSION)
+        obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=l2)
+        w = jnp.asarray(np.random.default_rng(3).normal(scale=0.3, size=d))
+        got = coefficient_variances(obj, w, batch, mode="full")
+        x = np.asarray(batch.features)
+        p = 1.0 / (1.0 + np.exp(-(x @ np.asarray(w))))
+        d2 = np.asarray(batch.weights) * p * (1.0 - p)
+        h = x.T @ (d2[:, None] * x) + l2 * np.eye(d)
+        np.testing.assert_allclose(
+            np.asarray(got), np.diag(np.linalg.inv(h)), rtol=1e-5
+        )
+
+    def test_diagonal_equals_full_for_orthogonal_design(self):
+        # With orthogonal columns and squared loss, H is diagonal, so the
+        # approximation is exact and the two modes must agree.
+        d = 6
+        q, _ = np.linalg.qr(np.random.default_rng(4).normal(size=(64, d)))
+        batch = LabeledPointBatch(
+            features=jnp.asarray(q),
+            labels=jnp.asarray(np.random.default_rng(5).normal(size=64)),
+            offsets=jnp.zeros(64),
+            weights=jnp.ones(64),
+        )
+        obj = GLMObjective(loss_for_task(TaskType.LINEAR_REGRESSION), l2_weight=0.25)
+        w = jnp.zeros(d)
+        full = coefficient_variances(obj, w, batch, mode="full")
+        diag = coefficient_variances(obj, w, batch, mode="diagonal")
+        np.testing.assert_allclose(np.asarray(full), np.asarray(diag), rtol=1e-5)
+
+    def test_full_differs_from_diagonal_when_correlated(self):
+        # Correlated features: diag(H⁻¹) ≠ 1/diag(H); guards against the
+        # round-1 behavior where "variance" silently meant the approximation.
+        rng = np.random.default_rng(6)
+        base = rng.normal(size=(100, 1))
+        x = np.hstack([base + 0.05 * rng.normal(size=(100, 3)), rng.normal(size=(100, 1))])
+        batch = LabeledPointBatch(
+            features=jnp.asarray(x),
+            labels=jnp.asarray(rng.normal(size=100)),
+            offsets=jnp.zeros(100),
+            weights=jnp.ones(100),
+        )
+        obj = GLMObjective(loss_for_task(TaskType.LINEAR_REGRESSION), l2_weight=1e-3)
+        full = coefficient_variances(obj, jnp.zeros(4), batch, mode="full")
+        diag = coefficient_variances(obj, jnp.zeros(4), batch, mode="diagonal")
+        assert not np.allclose(np.asarray(full), np.asarray(diag), rtol=0.05)
+
+    def test_normalized_objective_variances(self):
+        # Variances computed in normalized space then mapped back:
+        # var(w_model)_i = f_i^2 * var(w_norm)_i (diagonal transform).
+        n, d = 150, 4
+        batch = _batch(n, d, seed=7)
+        factors = jnp.asarray(np.random.default_rng(8).uniform(0.5, 2.0, size=d))
+        norm = NormalizationContext(factors=factors, shifts=None)
+        obj = GLMObjective(
+            loss_for_task(TaskType.LINEAR_REGRESSION), l2_weight=0.3,
+            normalization=norm,
+        )
+        w = jnp.zeros(d)
+        got = norm.variances_to_model_space(
+            coefficient_variances(obj, w, batch, mode="full")
+        )
+        # closed form in normalized space: H' = (XF)ᵀ W (XF) + λI
+        xf = np.asarray(batch.features) * np.asarray(factors)
+        h = xf.T @ (np.asarray(batch.weights)[:, None] * xf) + 0.3 * np.eye(d)
+        want = np.diag(np.linalg.inv(h)) * np.asarray(factors) ** 2
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+class TestEstimatorPaths:
+    def test_train_glm_full_variance(self):
+        from photon_ml_tpu.estimators import train_glm
+
+        batch = _batch(400, 6, seed=9)
+        models = train_glm(
+            batch, TaskType.LINEAR_REGRESSION,
+            regularization_weights=[1.0], compute_variance=True,
+            variance_mode="full",
+        )
+        glm = models[1.0]
+        x = np.asarray(batch.features)
+        h = x.T @ (np.asarray(batch.weights)[:, None] * x) + 1.0 * np.eye(6)
+        np.testing.assert_allclose(
+            np.asarray(glm.coefficients.variances),
+            np.diag(np.linalg.inv(h)),
+            rtol=1e-5,
+        )
+
+    def test_grid_full_matches_sequential(self):
+        from photon_ml_tpu.estimators import train_glm, train_glm_grid
+
+        batch = _batch(300, 5, seed=10)
+        lams = [0.1, 1.0]
+        grid = train_glm_grid(
+            batch, TaskType.LINEAR_REGRESSION,
+            regularization_weights=lams, compute_variance=True,
+            variance_mode="full",
+        )
+        seq = train_glm(
+            batch, TaskType.LINEAR_REGRESSION,
+            regularization_weights=lams, compute_variance=True,
+            variance_mode="full",
+        )
+        for lam in lams:
+            np.testing.assert_allclose(
+                np.asarray(grid[lam].coefficients.variances),
+                np.asarray(seq[lam].coefficients.variances),
+                rtol=1e-4,
+            )
+
+
+class TestRandomEffectVariances:
+    def _game_dataset(self, n=400, d=4, n_users=6, seed=20):
+        from photon_ml_tpu.data.game_data import (
+            build_game_dataset,
+            build_random_effect_dataset,
+        )
+
+        rng = np.random.default_rng(seed)
+        users = np.array([f"u{i}" for i in rng.integers(0, n_users, size=n)])
+        x = rng.normal(size=(n, d)).astype(np.float64)
+        y = (x * 0.5).sum(axis=1) + rng.normal(scale=0.2, size=n)
+        ds = build_game_dataset(
+            labels=y, feature_shards={"s": x}, entity_keys={"user": users},
+            dtype=np.float64,
+        )
+        re = build_random_effect_dataset(ds, "user", "s", bucket_sizes=(128,))
+        return ds, re, x, y, users
+
+    def test_per_entity_variances_match_closed_form(self):
+        from photon_ml_tpu.algorithm.coordinates import (
+            CoordinateOptimizationConfig,
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.optim.optimizer import OptimizerConfig
+
+        ds, re, x, y, users = self._game_dataset()
+        l2 = 1.5
+        coord = RandomEffectCoordinate(
+            coordinate_id="per-user", dataset=ds, re_dataset=re,
+            task=TaskType.LINEAR_REGRESSION,
+            config=CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=50),
+                l2_weight=l2, compute_variance=True,
+            ),
+        )
+        model, _ = coord.update_model(coord.initial_model())
+        assert model.variances is not None
+        d = x.shape[1]
+        for row, key in enumerate(np.asarray(model.entity_keys)):
+            mask = users == key
+            xe = x[mask]
+            h = xe.T @ xe + l2 * np.eye(d)
+            np.testing.assert_allclose(
+                np.asarray(model.variances)[row],
+                np.diag(np.linalg.inv(h)),
+                rtol=1e-4,
+                err_msg=f"entity {key}",
+            )
+
+    def test_projected_re_with_variance_raises(self):
+        from photon_ml_tpu.algorithm.coordinates import (
+            CoordinateOptimizationConfig,
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.data.game_data import build_random_effect_dataset
+        from photon_ml_tpu.optim.optimizer import OptimizerConfig
+        from photon_ml_tpu.projector.projectors import ProjectorType
+
+        ds, _, _, _, _ = self._game_dataset()
+        re = build_random_effect_dataset(
+            ds, "user", "s", bucket_sizes=(128,),
+            projector_type=ProjectorType.INDEX_MAP,
+        )
+        coord = RandomEffectCoordinate(
+            coordinate_id="per-user", dataset=ds, re_dataset=re,
+            task=TaskType.LINEAR_REGRESSION,
+            config=CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=5),
+                compute_variance=True,
+            ),
+        )
+        with pytest.raises(ValueError, match="variance computation"):
+            coord.update_model(coord.initial_model())
+
+    def test_re_variances_survive_avro_round_trip(self, tmp_path):
+        from photon_ml_tpu.io.index_map import IndexMap, feature_key
+        from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+        from photon_ml_tpu.models.game import GameModel, RandomEffectModel
+
+        rng = np.random.default_rng(21)
+        e, d = 5, 3
+        imap = IndexMap({feature_key(f"f{j}", ""): j for j in range(d)})
+        model = GameModel(
+            models={
+                "per-user": RandomEffectModel(
+                    coefficients=jnp.asarray(rng.normal(size=(e, d))),
+                    entity_keys=np.asarray([f"u{i}" for i in range(e)]),
+                    random_effect_type="user",
+                    feature_shard_id="s",
+                    task=TaskType.LINEAR_REGRESSION,
+                    variances=jnp.asarray(rng.uniform(0.1, 1.0, size=(e, d))),
+                )
+            },
+        )
+        out = str(tmp_path / "m")
+        save_game_model(out, model, {"s": imap})
+        back = load_game_model(out, {"s": imap}, dtype=np.float64)
+        re_model = back.models["per-user"]
+        assert re_model.variances is not None
+        np.testing.assert_allclose(
+            np.asarray(re_model.variances),
+            np.asarray(model.models["per-user"].variances),
+            rtol=1e-12,
+        )
+
+    def test_re_diagonal_mode_honored(self):
+        from photon_ml_tpu.algorithm.coordinates import (
+            CoordinateOptimizationConfig,
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.optim.optimizer import OptimizerConfig
+
+        ds, re, x, y, users = self._game_dataset()
+        l2 = 1.5
+        coord = RandomEffectCoordinate(
+            coordinate_id="per-user", dataset=ds, re_dataset=re,
+            task=TaskType.LINEAR_REGRESSION,
+            config=CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=50),
+                l2_weight=l2, compute_variance=True, variance_mode="diagonal",
+            ),
+        )
+        model, _ = coord.update_model(coord.initial_model())
+        d = x.shape[1]
+        for row, key in enumerate(np.asarray(model.entity_keys)):
+            xe = x[users == key]
+            np.testing.assert_allclose(
+                np.asarray(model.variances)[row],
+                1.0 / ((xe * xe).sum(axis=0) + l2),
+                rtol=1e-5,
+                err_msg=f"entity {key}",
+            )
+
+    def test_unbucketed_entity_variance_is_nan_and_not_persisted(self, tmp_path):
+        from photon_ml_tpu.algorithm.coordinates import (
+            CoordinateOptimizationConfig,
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.data.game_data import (
+            build_game_dataset,
+            build_random_effect_dataset,
+        )
+        from photon_ml_tpu.io import avro as avro_io
+        from photon_ml_tpu.io.index_map import IndexMap, feature_key
+        from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+        from photon_ml_tpu.models.game import GameModel
+        from photon_ml_tpu.optim.optimizer import OptimizerConfig
+
+        rng = np.random.default_rng(30)
+        d = 3
+        # "big" has 40 samples, "tiny" only 2 -> excluded by lower bound
+        users = np.array(["big"] * 40 + ["tiny"] * 2)
+        n = len(users)
+        x = rng.normal(size=(n, d)).astype(np.float64)
+        y = x.sum(axis=1) + rng.normal(scale=0.1, size=n)
+        ds = build_game_dataset(
+            labels=y, feature_shards={"s": x}, entity_keys={"user": users},
+            dtype=np.float64,
+        )
+        re = build_random_effect_dataset(
+            ds, "user", "s", bucket_sizes=(64,), active_data_lower_bound=10,
+        )
+        coord = RandomEffectCoordinate(
+            coordinate_id="per-user", dataset=ds, re_dataset=re,
+            task=TaskType.LINEAR_REGRESSION,
+            config=CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=30),
+                l2_weight=1.0, compute_variance=True,
+            ),
+        )
+        model, _ = coord.update_model(coord.initial_model())
+        keys = list(np.asarray(model.entity_keys))
+        var = np.asarray(model.variances)
+        assert np.all(np.isfinite(var[keys.index("big")]))
+        assert np.all(np.isnan(var[keys.index("tiny")]))
+
+        # save/load round trip: the NaN row must not become variance=0
+        imap = IndexMap({feature_key(f"f{j}", ""): j for j in range(d)})
+        out = str(tmp_path / "m")
+        save_game_model(out, GameModel(models={"per-user": model}), {"s": imap})
+        raw = list(avro_io.read_directory(
+            os.path.join(out, "random-effect", "per-user", "coefficients")))
+        by_id = {r["modelId"]: r for r in raw}
+        assert by_id["big"]["variances"]
+        assert not by_id["tiny"]["variances"]
+        back = load_game_model(out, {"s": imap}, dtype=np.float64)
+        bvar = np.asarray(back.models["per-user"].variances)
+        bkeys = list(np.asarray(back.models["per-user"].entity_keys))
+        assert np.all(np.isfinite(bvar[bkeys.index("big")]))
+        assert np.all(np.isnan(bvar[bkeys.index("tiny")]))
+
+    def test_singular_hessian_falls_back_finite(self):
+        # λ=0 + exactly collinear features: Cholesky non-PD; the guard must
+        # keep variances finite instead of persisting NaN
+        rng = np.random.default_rng(22)
+        x = rng.normal(size=(50, 2))
+        x = np.hstack([x, x[:, :1]])  # exact copy of column 0
+        batch = LabeledPointBatch(
+            features=jnp.asarray(x),
+            labels=jnp.asarray(rng.normal(size=50)),
+            offsets=jnp.zeros(50),
+            weights=jnp.ones(50),
+        )
+        obj = GLMObjective(loss_for_task(TaskType.LINEAR_REGRESSION), l2_weight=0.0)
+        v = coefficient_variances(obj, jnp.zeros(3), batch, mode="full")
+        assert np.all(np.isfinite(np.asarray(v)))
+
+
+class TestDriverPersistence:
+    def test_variances_survive_avro_round_trip(self, tmp_path):
+        """FE coordinate with variance=true: the saved BayesianLinearModelAvro
+        must carry diag(H⁻¹) computed at the trained point (reference
+        ModelProcessingUtils persists means+variances)."""
+        from photon_ml_tpu.cli import game_training_driver
+        from photon_ml_tpu.io import avro as avro_io
+        from photon_ml_tpu.io import photon_schemas as schemas
+        from photon_ml_tpu.io.index_map import feature_key
+        from photon_ml_tpu.io.model_io import load_game_model_and_index_maps
+
+        rng = np.random.default_rng(11)
+        n, d, l2 = 500, 4, 2.0
+        x = rng.normal(size=(n, d))
+        y = x @ rng.normal(size=d) + rng.normal(scale=0.1, size=n)
+        records = [
+            {
+                "uid": str(i),
+                "label": float(y[i]),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[i, j])}
+                    for j in range(d)
+                ],
+                "weight": 1.0,
+                "offset": 0.0,
+                "foldId": None,
+                "metadataMap": {},
+            }
+            for i in range(n)
+        ]
+        data_dir = tmp_path / "train"
+        os.makedirs(data_dir)
+        avro_io.write_container(
+            str(data_dir / "part-00000.avro"),
+            schemas.TRAINING_EXAMPLE_AVRO,
+            records,
+        )
+        out = tmp_path / "out"
+        game_training_driver.main([
+            "--input-data-path", str(data_dir),
+            "--root-output-dir", str(out),
+            "--feature-shard-configurations",
+            "name=global,feature.bags=features,intercept=false",
+            "--coordinate-configurations",
+            f"name=fe,feature.shard=global,reg.weights={l2},max.iter=60,"
+            "variance=true,variance.mode=full",
+            "--task-type", "LINEAR_REGRESSION",
+            "--coordinate-descent-iterations", "1",
+        ])
+        loaded, index_maps = load_game_model_and_index_maps(
+            str(out / "best"), dtype=np.float64
+        )
+        glm = loaded.models["fe"].glm
+        variances = np.asarray(glm.coefficients.variances)
+        assert variances.shape == (d,)
+
+        # closed form with the loader's own feature order
+        index_map = index_maps["global"]
+        cols = np.asarray([index_map[feature_key(f"f{j}", "")] for j in range(d)])
+        xo = np.zeros_like(x)
+        xo[:, cols] = x
+        h = xo.T @ xo + l2 * np.eye(d)
+        np.testing.assert_allclose(variances, np.diag(np.linalg.inv(h)), rtol=1e-4)
