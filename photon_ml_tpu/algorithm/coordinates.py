@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.data.sparse_batch import SparseLabeledPointBatch
 from photon_ml_tpu.sampling import down_sampler_for_task
 from photon_ml_tpu.data.game_data import GameDataset, RandomEffectDataset
 from photon_ml_tpu.models.coefficients import Coefficients
@@ -41,11 +42,13 @@ from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
 from photon_ml_tpu.ops.variance import (
     coefficient_variances,
     diag_inverse_from_hessian,
     inverse_of_diagonal,
     resolve_variance_mode,
+    resolve_variance_mode_for,
     validate_variance_mode,
 )
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType, solve
@@ -92,7 +95,14 @@ class Coordinate:
 
 
 def _make_objective(task: TaskType, cfg: CoordinateOptimizationConfig,
-                    normalization: NormalizationContext | None) -> GLMObjective:
+                    normalization: NormalizationContext | None,
+                    sparse: bool = False) -> GLMObjective | SparseGLMObjective:
+    if sparse:
+        return SparseGLMObjective(
+            loss_for_task(task),
+            l2_weight=cfg.l2_weight,
+            normalization=normalization,
+        )
     return GLMObjective(
         loss_for_task(task),
         l2_weight=cfg.l2_weight,
@@ -159,7 +169,16 @@ class FixedEffectCoordinate(Coordinate):
         # use_pallas=False: measured on v5e (BASELINE.md), XLA already fuses
         # the FE value+gradient into ONE pass over X at ~750 GB/s; the
         # hand-written kernel streams at ~270 GB/s. Autodiff IS the fast path.
-        objective = _make_objective(self.task, self.config, self.normalization)
+        objective = _make_objective(
+            self.task, self.config, self.normalization,
+            sparse=isinstance(batch, SparseLabeledPointBatch),
+        )
+        if self.config.compute_variance:
+            # fail a full-variance-on-sparse config BEFORE the (possibly
+            # giant-d, hours-long) solve, not after
+            resolve_variance_mode_for(
+                objective, self.config.variance_mode, batch.dim
+            )
         norm = objective.normalization
         w0 = norm.from_model_space(model.glm.coefficients.means, self.intercept_index)
         result = _jitted_fe_solve(

@@ -81,9 +81,12 @@ def parse_feature_shard_config(spec: str) -> tuple[str, FeatureShardConfiguratio
     except KeyError as e:
         raise ValueError(f"feature shard config missing {e} in {spec!r}") from None
     intercept = _bool(kv.pop("intercept", "true"))
+    sparse = _bool(kv.pop("sparse", "false"))
     if kv:
         raise ValueError(f"unknown feature shard keys {sorted(kv)} in {spec!r}")
-    return name, FeatureShardConfiguration(feature_bags=bags, has_intercept=intercept)
+    return name, FeatureShardConfiguration(
+        feature_bags=bags, has_intercept=intercept, sparse=sparse
+    )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -38,6 +38,7 @@ from photon_ml_tpu.cli.configs import (
     parse_feature_shard_config,
 )
 from photon_ml_tpu.data.batch import summarize
+from photon_ml_tpu.data.sparse_batch import SparseShard
 from photon_ml_tpu.data.validators import DataValidationType, validate_game_dataset
 from photon_ml_tpu.estimators import GameEstimator
 from photon_ml_tpu.evaluation.evaluators import parse_evaluator
@@ -270,7 +271,10 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
 
     with Timed("feature shard stats"):
         for shard_id, features in train.dataset.feature_shards.items():
-            stats = summarize(np.asarray(features), np.asarray(train.dataset.weights))
+            if isinstance(features, SparseShard):
+                stats = features.summarize(np.asarray(train.dataset.weights))
+            else:
+                stats = summarize(np.asarray(features), np.asarray(train.dataset.weights))
             write_feature_stats(
                 os.path.join(out, "feature-stats", shard_id, "part-00000.avro"),
                 stats,

@@ -47,6 +47,10 @@ class LabeledPointBatch:
     def dim(self) -> int:
         return self.features.shape[1]
 
+    @property
+    def dtype(self):
+        return self.features.dtype
+
     def with_offsets(self, offsets: Array) -> "LabeledPointBatch":
         return self.replace(offsets=offsets)
 

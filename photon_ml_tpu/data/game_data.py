@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.data.sparse_batch import SparseLabeledPointBatch, SparseShard
 from photon_ml_tpu.projector.projectors import (
     ProjectorType,
     RandomProjectionMatrix,
@@ -77,7 +78,15 @@ class GameDataset:
         if name in ("labels", "weights", "offsets"):
             value = np.asarray(getattr(self, name))
         elif name.startswith("shard/"):
-            value = np.asarray(self.feature_shards[name[len("shard/"):]])
+            shard = self.feature_shards[name[len("shard/"):]]
+            if isinstance(shard, SparseShard):
+                raise TypeError(
+                    f"feature shard '{name[len('shard/'):]}' is sparse "
+                    "(giant-d); dense host materialization would defeat it. "
+                    "Random-effect coordinates and other dense consumers "
+                    "need a dense shard."
+                )
+            value = np.asarray(shard)
         elif name.startswith("entity_idx/"):
             value = np.asarray(self.entity_idx[name[len("entity_idx/"):]])
         else:
@@ -95,10 +104,17 @@ class GameDataset:
     def entity_indices(self, re_type: str) -> Array:
         return self.entity_idx[re_type]
 
-    def fixed_effect_batch(self, shard_id: str, extra_offsets: Array | None = None) -> LabeledPointBatch:
+    def fixed_effect_batch(
+        self, shard_id: str, extra_offsets: Array | None = None
+    ) -> LabeledPointBatch | SparseLabeledPointBatch:
         offsets = self.offsets if extra_offsets is None else self.offsets + extra_offsets
+        shard = self.feature_shards[shard_id]
+        if isinstance(shard, SparseShard):
+            return SparseLabeledPointBatch.from_shard(
+                shard, self.labels, offsets, self.weights
+            )
         return LabeledPointBatch(
-            features=jnp.asarray(self.feature_shards[shard_id]),
+            features=jnp.asarray(shard),
             labels=jnp.asarray(self.labels),
             offsets=jnp.asarray(offsets),
             weights=jnp.asarray(self.weights),
@@ -465,13 +481,25 @@ def build_game_dataset(
         entity_idx[re_type] = jnp.asarray(idx)
         host_idx[re_type] = idx
 
-    host_shards = {k: np.asarray(v, dtype=dtype) for k, v in feature_shards.items()}
+    # SparseShard values pass through untouched (giant-d shards never
+    # densify — not on host, not on device)
+    host_shards = {
+        k: v for k, v in feature_shards.items()
+        if not isinstance(v, SparseShard)
+    }
+    host_shards = {k: np.asarray(v, dtype=dtype) for k, v in host_shards.items()}
+    device_shards: dict[str, object] = {
+        k: (v if isinstance(v, SparseShard) else None)
+        for k, v in feature_shards.items()
+    }
+    for k, v in host_shards.items():
+        device_shards[k] = jnp.asarray(v)
     return GameDataset(
         unique_ids=unique_ids,
         labels=jnp.asarray(labels),
         offsets=jnp.asarray(offsets),
         weights=jnp.asarray(weights),
-        feature_shards={k: jnp.asarray(v) for k, v in host_shards.items()},
+        feature_shards=device_shards,
         entity_idx=entity_idx,
         entity_vocabs=vocabs,
         ids=dict(ids or {}),

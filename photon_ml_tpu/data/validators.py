@@ -103,13 +103,31 @@ def validate_game_dataset(
 ) -> None:
     """Validate a GameDataset (reference sanityCheckDataFrameForTraining,
     GameTrainingDriver.scala:400-417)."""
+    from photon_ml_tpu.data.sparse_batch import SparseShard
+
+    if validation_type == DataValidationType.VALIDATE_DISABLED:
+        return
+    dense_shards: dict = {}
+    sparse_failures: list[str] = []
+    for k, v in dataset.feature_shards.items():
+        if isinstance(v, SparseShard):
+            # COO values are the entire feature content; O(nnz) full check
+            # regardless of sample-level validation mode
+            if not np.all(np.isfinite(v.vals)):
+                sparse_failures.append(
+                    f"feature shard '{k}' contains NaN/Inf"
+                )
+        else:
+            dense_shards[k] = np.asarray(v)
+    if sparse_failures:
+        raise DataValidationError(
+            "input data failed validation: " + "; ".join(sparse_failures)
+        )
     validate_arrays(
         labels=np.asarray(dataset.labels),
         task=task,
         offsets=np.asarray(dataset.offsets),
         weights=np.asarray(dataset.weights),
-        feature_shards={
-            k: np.asarray(v) for k, v in dataset.feature_shards.items()
-        },
+        feature_shards=dense_shards,
         validation_type=validation_type,
     )

@@ -57,12 +57,14 @@ from photon_ml_tpu.ops.normalization import (
     NormalizationType,
     build_normalization,
 )
+from photon_ml_tpu.data.sparse_batch import SparseLabeledPointBatch
 from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
 from photon_ml_tpu.ops.variance import (
     coefficient_variances,
     diag_inverse_from_hessian,
     inverse_of_diagonal,
-    resolve_variance_mode,
+    resolve_variance_mode_for,
     validate_variance_mode,
 )
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType, solve
@@ -280,11 +282,15 @@ class GameEstimator:
                     shard_id,
                 )
                 norm_type = NormalizationType.SCALE_WITH_STANDARD_DEVIATION
-            feats = np.asarray(features)
-            stats = summarize(feats, weights)
-            # match the shard dtype: float64 stats scattered into float32
-            # coefficient tables would trip jax's strict promotion rules
-            dtype = feats.dtype
+            if hasattr(features, "summarize"):  # SparseShard: COO stats
+                stats = features.summarize(weights)
+                dtype = features.dtype
+            else:
+                feats = np.asarray(features)
+                stats = summarize(feats, weights)
+                # match the shard dtype: float64 stats scattered into float32
+                # coefficient tables would trip jax's strict promotion rules
+                dtype = feats.dtype
             norms[shard_id] = build_normalization(
                 norm_type,
                 mean=jnp.asarray(stats["mean"], dtype=dtype),
@@ -329,11 +335,6 @@ def train_glm_grid(
     control flow and stays on the sequential path.
     """
     optimizer = optimizer or OptimizerConfig()
-    # lane-aware resolution: L full Hessians materialize at once — validate
-    # before any lane trains
-    resolved_variance = resolve_variance_mode(
-        variance_mode, batch.dim, num_problems=len(regularization_weights)
-    )
     if optimizer.optimizer_type not in (
         OptimizerType.LBFGS, OptimizerType.OWLQN
     ):
@@ -351,8 +352,14 @@ def train_glm_grid(
             "box constraints cannot combine with OWL-QN / elastic-net lanes"
         )
     loss = loss_for_task(task)
-    objective = GLMObjective(loss, l2_weight=0.0, normalization=normalization)
-    dtype = batch.features.dtype
+    objective = _objective_for_batch(batch, loss, 0.0, normalization)
+    # lane-aware resolution: L full Hessians materialize at once — validate
+    # before any lane trains (sparse objectives resolve to diagonal)
+    resolved_variance = resolve_variance_mode_for(
+        objective, variance_mode, batch.dim,
+        num_problems=len(regularization_weights),
+    )
+    dtype = batch.dtype
     if dtype == jnp.bfloat16:
         dtype = jnp.float32
     lams = sorted(float(l) for l in regularization_weights)
@@ -435,6 +442,16 @@ def _jitted_grid_solve(objective, use_owlqn, history, max_iter, tolerance,
     return jax.vmap(solve_one)(l2v, l1v)
 
 
+def _objective_for_batch(batch, loss, l2_weight, normalization):
+    """Dense or sparse objective by batch type — one train_glm[/grid] code
+    path serves both the [n, d] block and the giant-d flat-COO layout."""
+    if isinstance(batch, SparseLabeledPointBatch):
+        return SparseGLMObjective(
+            loss, l2_weight=l2_weight, normalization=normalization
+        )
+    return GLMObjective(loss, l2_weight=l2_weight, normalization=normalization)
+
+
 @functools.partial(jax.jit, static_argnums=(0,))
 def _jitted_grid_diagonals(objective, batch, coeffs, l2v):
     """All lanes' Hessian diagonals in one shared read of the feature block."""
@@ -490,11 +507,11 @@ def train_glm(
         )
     loss = loss_for_task(task)
     models: dict[float, GeneralizedLinearModel] = {}
-    w = jnp.zeros((batch.dim,), dtype=batch.features.dtype)
+    w = jnp.zeros((batch.dim,), dtype=batch.dtype)
     for lam in sorted(regularization_weights):
         l1 = elastic_net_alpha * lam
         l2 = (1.0 - elastic_net_alpha) * lam
-        objective = GLMObjective(loss, l2_weight=l2, normalization=normalization)
+        objective = _objective_for_batch(batch, loss, l2, normalization)
         opt = optimizer
         if l1 > 0.0:
             opt = dataclasses.replace(
@@ -502,8 +519,8 @@ def train_glm(
             )
         result = solve(
             opt, objective.bind(batch), w,
-            lower_bounds=None if lower_bounds is None else jnp.asarray(lower_bounds, batch.features.dtype),
-            upper_bounds=None if upper_bounds is None else jnp.asarray(upper_bounds, batch.features.dtype),
+            lower_bounds=None if lower_bounds is None else jnp.asarray(lower_bounds, batch.dtype),
+            upper_bounds=None if upper_bounds is None else jnp.asarray(upper_bounds, batch.dtype),
         )
         w = result.coefficients
         norm = objective.normalization

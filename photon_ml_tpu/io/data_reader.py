@@ -24,6 +24,7 @@ from typing import Iterable, Iterator, Mapping, Sequence
 import numpy as np
 
 from photon_ml_tpu.data.game_data import GameDataset, build_game_dataset
+from photon_ml_tpu.data.sparse_batch import SparseShard
 from photon_ml_tpu.io import avro as avro_io
 from photon_ml_tpu.io.index_map import (
     INTERCEPT_KEY,
@@ -43,10 +44,17 @@ RESERVED_COLUMNS = frozenset({UID, RESPONSE, "label", OFFSET, WEIGHT, META_DATA_
 @dataclasses.dataclass(frozen=True)
 class FeatureShardConfiguration:
     """Reference photon-client io/FeatureShardConfiguration.scala: which
-    feature bags merge into this shard and whether to append an intercept."""
+    feature bags merge into this shard and whether to append an intercept.
+
+    sparse=True keeps the shard as COO triples end to end (SparseShard) —
+    for giant feature spaces where a dense [n, d] block cannot exist
+    (reference AvroDataReader keeps name-term bags sparse for the same
+    reason; README.md:77 "hundreds of billions of coefficients"). Only
+    fixed-effect coordinates can train on a sparse shard."""
 
     feature_bags: tuple[str, ...]
     has_intercept: bool = True
+    sparse: bool = False
 
 
 def read_avro_records(path: str | os.PathLike) -> Iterator[dict]:
@@ -149,6 +157,35 @@ def _scatter_dense(
     return x
 
 
+def _assemble_sparse_shard(
+    n: int,
+    imap: IndexMap,
+    cfg: FeatureShardConfiguration,
+    triples: np.ndarray,
+    dtype,
+    shard: str,
+    intercept_indices: dict[str, int],
+) -> SparseShard:
+    """COO shard assembly: never densifies. The intercept column becomes n
+    explicit (i, intercept, 1.0) entries; duplicate (row, col) pairs
+    accumulate on device via the segment sums (same rule as
+    _scatter_dense's np.add.at)."""
+    row_idx = triples[:, 0].astype(np.int64)
+    col_idx = triples[:, 1].astype(np.int64)
+    vals = triples[:, 2].astype(dtype)
+    if cfg.has_intercept:
+        ii = imap.get_index(INTERCEPT_KEY)
+        if ii >= 0:
+            row_idx = np.concatenate([row_idx, np.arange(n, dtype=np.int64)])
+            col_idx = np.concatenate([col_idx, np.full(n, ii, dtype=np.int64)])
+            vals = np.concatenate([vals, np.ones(n, dtype=dtype)])
+            intercept_indices[shard] = ii
+    return SparseShard(
+        rows=row_idx, cols=col_idx, vals=vals,
+        num_samples=n, feature_dim=imap.size,
+    )
+
+
 def _apply_intercept(
     x: np.ndarray, imap: IndexMap, shard: str, intercept_indices: dict[str, int]
 ) -> None:
@@ -222,7 +259,7 @@ def records_to_game_dataset(
                         rows[shard].append((n, j, float(feat["value"])))
         n += 1
 
-    feature_shards: dict[str, np.ndarray] = {}
+    feature_shards: dict[str, object] = {}
     intercept_indices: dict[str, int] = {}
     for shard, cfg in shard_configs.items():
         imap = index_maps[shard]
@@ -231,6 +268,11 @@ def records_to_game_dataset(
             if rows[shard]
             else np.zeros((0, 3))
         )
+        if cfg.sparse:
+            feature_shards[shard] = _assemble_sparse_shard(
+                n, imap, cfg, triples, dtype, shard, intercept_indices
+            )
+            continue
         x = _scatter_dense(
             n, imap.size, triples[:, 0], triples[:, 1], triples[:, 2], dtype
         )

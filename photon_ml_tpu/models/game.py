@@ -49,7 +49,11 @@ class FixedEffectModel(DatumScoringModel):
         return self.glm.task
 
     def score_dataset(self, dataset) -> Array:
+        from photon_ml_tpu.data.sparse_batch import SparseShard
+
         features = dataset.shard_features(self.feature_shard_id)
+        if isinstance(features, SparseShard):
+            return features.device().matvec(self.glm.coefficients.means)
         return features @ self.glm.coefficients.means
 
 

@@ -50,6 +50,24 @@ def resolve_variance_mode(mode: str, dim: int, num_problems: int = 1) -> str:
     return mode
 
 
+def resolve_variance_mode_for(
+    objective, mode: str, dim: int, num_problems: int = 1
+) -> str:
+    """Like :func:`resolve_variance_mode`, but also accounts for objectives
+    that cannot materialize a dense Hessian (sparse/giant-d): AUTO falls
+    back to diagonal; an explicit "full" request raises."""
+    resolved = resolve_variance_mode(mode, dim, num_problems)
+    if resolved == "full" and not hasattr(objective, "hessian_matrix"):
+        if mode == "full":
+            raise ValueError(
+                "variance_mode='full' requires a dense Hessian; this "
+                f"objective ({type(objective).__name__}) only supports the "
+                "diagonal approximation"
+            )
+        resolved = "diagonal"
+    return resolved
+
+
 def inverse_of_diagonal(diag: Array) -> Array:
     """The diagonal approximation's clamped inverse — single definition so
     every path (sequential, grid lanes, per-entity) uses the same floor."""
@@ -99,7 +117,9 @@ def coefficient_variances(
     definite — guaranteed with l2_weight > 0, generically true for n > d);
     "diagonal" = 1/diag(H); "auto" picks by dimension.
     """
-    resolved = resolve_variance_mode(mode, int(coefficients.shape[-1]))
+    resolved = resolve_variance_mode_for(
+        objective, mode, int(coefficients.shape[-1])
+    )
     if resolved == "full":
         return _full_variances(objective, coefficients, batch)
     return _diagonal_variances(objective, coefficients, batch)

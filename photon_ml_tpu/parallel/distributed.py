@@ -111,6 +111,17 @@ def _data_pytree(dataset: GameDataset, re_specs: Sequence[RandomEffectStepSpec],
     id_types = {s.re_type for s in re_specs}
     for m in mf_specs:
         id_types |= {m.row_effect_type, m.col_effect_type}
+    from photon_ml_tpu.data.sparse_batch import SparseShard
+
+    for k in shards:
+        if isinstance(dataset.feature_shards[k], SparseShard):
+            raise ValueError(
+                f"feature shard '{k}' is sparse (giant-d); the fused "
+                "GameTrainProgram consumes dense [n, d] blocks. Train "
+                "sparse fixed-effect coordinates through the "
+                "coordinate-descent path (GameEstimator / "
+                "FixedEffectCoordinate) instead."
+            )
     return {
         "labels": jnp.asarray(dataset.labels),
         "offsets": jnp.asarray(dataset.offsets),
