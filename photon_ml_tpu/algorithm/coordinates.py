@@ -436,8 +436,7 @@ def _jitted_re_bucket_variances_diagonal(
     return var_table.at[entity_rows].set(vs)
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _jitted_re_bucket_solve_indexmap(
+def solve_entity_bucket_indexmap(
     objective: GLMObjective,
     opt: OptimizerConfig,
     features: Array,  # [e, cap, k]
@@ -448,10 +447,15 @@ def _jitted_re_bucket_solve_indexmap(
     col_index: Array,  # [e, k], padding slots hold d (the scratch column)
     full_offsets: Array,
     table_ext: Array,  # [E, d+1]
-):
+) -> Array:
     """Index-map-projected bucket solve: gather each entity's active columns
     as its warm start, solve in the projected space, scatter back. Padding
-    slots read/write the scratch column, which is re-zeroed afterwards."""
+    slots read/write the scratch column, which is re-zeroed afterwards.
+
+    Pure/traceable (reference IndexMapProjectorRDD.scala:218-257 semantics):
+    used by the single-chip jit wrapper below and by the mesh-sharded
+    fused step (parallel/distributed.py), where the entity axis shards
+    over "data"."""
     offsets = _bucket_offsets(sample_rows, full_offsets)
     w0s = table_ext[entity_rows[:, None], col_index]
     solved = _solve_bucket_entities(
@@ -461,8 +465,7 @@ def _jitted_re_bucket_solve_indexmap(
     return table_ext.at[:, -1].set(0.0)
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _jitted_re_bucket_solve_random(
+def solve_entity_bucket_random(
     objective: GLMObjective,
     opt: OptimizerConfig,
     features: Array,  # [e, cap, k] (already projected)
@@ -473,15 +476,54 @@ def _jitted_re_bucket_solve_random(
     matrix: Array,  # [d, k]
     full_offsets: Array,
     table: Array,  # [E, d]
-):
+) -> Array:
     """Random-projected bucket solve: warm start Pᵀw (the adjoint projection,
-    ≈ the projected coefficients since E[PᵀP]=I), back-project P w_k."""
+    ≈ the projected coefficients since E[PᵀP]=I), back-project P w_k.
+    Pure/traceable, shared with the fused step like its index-map twin."""
     offsets = _bucket_offsets(sample_rows, full_offsets)
     w0s = table[entity_rows] @ matrix
     solved = _solve_bucket_entities(
         objective, opt, features, labels, weights, offsets, w0s
     )
     return table.at[entity_rows].set(solved @ matrix.T)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _jitted_re_bucket_solve_indexmap(
+    objective: GLMObjective,
+    opt: OptimizerConfig,
+    features: Array,
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    col_index: Array,
+    full_offsets: Array,
+    table_ext: Array,
+):
+    return solve_entity_bucket_indexmap(
+        objective, opt, features, labels, weights, sample_rows, entity_rows,
+        col_index, full_offsets, table_ext,
+    )
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _jitted_re_bucket_solve_random(
+    objective: GLMObjective,
+    opt: OptimizerConfig,
+    features: Array,
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    matrix: Array,
+    full_offsets: Array,
+    table: Array,
+):
+    return solve_entity_bucket_random(
+        objective, opt, features, labels, weights, sample_rows, entity_rows,
+        matrix, full_offsets, table,
+    )
 
 
 @dataclasses.dataclass

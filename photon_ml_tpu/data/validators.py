@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import enum
 import logging
-from typing import Mapping
+from typing import Mapping, Sequence
 
 import numpy as np
 
@@ -56,16 +56,19 @@ def validate_arrays(
     weights: np.ndarray | None = None,
     feature_shards: Mapping[str, np.ndarray] | None = None,
     validation_type: DataValidationType = DataValidationType.VALIDATE_FULL,
+    extra_failures: Sequence[str] = (),
 ) -> None:
     """Run the reference's sanityCheckData checks; raise DataValidationError
-    listing all failures (DataValidators.scala aggregates before throwing)."""
+    listing all failures (DataValidators.scala aggregates before throwing).
+    extra_failures: pre-computed failure strings (e.g. sparse-shard checks)
+    aggregated into the same report."""
     if validation_type == DataValidationType.VALIDATE_DISABLED:
         return
 
     labels = np.asarray(labels)
     sel = _subsample(len(labels), validation_type)
     labels = labels[sel]
-    failures: list[str] = []
+    failures: list[str] = list(extra_failures)
 
     if not np.all(np.isfinite(labels)):
         failures.append("labels contain NaN/Inf")
@@ -119,10 +122,6 @@ def validate_game_dataset(
                 )
         else:
             dense_shards[k] = np.asarray(v)
-    if sparse_failures:
-        raise DataValidationError(
-            "input data failed validation: " + "; ".join(sparse_failures)
-        )
     validate_arrays(
         labels=np.asarray(dataset.labels),
         task=task,
@@ -130,4 +129,5 @@ def validate_game_dataset(
         weights=np.asarray(dataset.weights),
         feature_shards=dense_shards,
         validation_type=validation_type,
+        extra_failures=sparse_failures,
     )

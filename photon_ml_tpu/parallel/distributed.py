@@ -37,7 +37,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from photon_ml_tpu.algorithm.coordinates import solve_entity_bucket
+from photon_ml_tpu.algorithm.coordinates import (
+    solve_entity_bucket,
+    solve_entity_bucket_indexmap,
+    solve_entity_bucket_random,
+)
 from photon_ml_tpu.algorithm.mf_coordinate import solve_mf_side_bucket
 from photon_ml_tpu.models.matrix_factorization import score_matrix_factorization
 from photon_ml_tpu.data.batch import LabeledPointBatch
@@ -73,12 +77,20 @@ class GameTrainState:
 
 @dataclasses.dataclass(frozen=True)
 class RandomEffectStepSpec:
-    """Static description of one RE coordinate inside the fused step."""
+    """Static description of one RE coordinate inside the fused step.
+
+    projector: must match the RandomEffectDataset's projector_type.
+    INDEX_MAP solves each entity over its observed columns via the
+    scratch-column gather/scatter (IndexMapProjectorRDD.scala:218-257);
+    RANDOM solves in the sketched space and back-projects. The model table
+    stays [E, dim] in original space either way, so scoring and residual
+    updates are projector-agnostic."""
 
     re_type: str
     feature_shard_id: str
     optimizer: OptimizerConfig
     l2_weight: float = 0.0
+    projector: ProjectorType = ProjectorType.IDENTITY
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,31 +145,45 @@ def _data_pytree(dataset: GameDataset, re_specs: Sequence[RandomEffectStepSpec],
     }
 
 
-def _buckets_pytree(re_datasets: Mapping[str, RandomEffectDataset]) -> dict:
+def _buckets_pytree(
+    re_datasets: Mapping[str, RandomEffectDataset],
+    re_specs: Sequence[RandomEffectStepSpec] = (),
+) -> dict:
+    spec_projector = {s.re_type: s.projector for s in re_specs}
     for k, ds in re_datasets.items():
-        if ds.projector_type != ProjectorType.IDENTITY:
-            # The mesh-sharded step solves buckets in full shard space;
-            # projected buckets carry gathered/sketched columns it would
-            # scatter into the wrong table slots.
+        expected = spec_projector.get(k, ProjectorType.IDENTITY)
+        if ds.projector_type != expected:
             raise ValueError(
                 f"random-effect dataset '{k}' uses projector "
-                f"{ds.projector_type.name}; the distributed GAME step "
-                "supports ProjectorType.IDENTITY only (use the single-chip "
-                "GameEstimator path for projected coordinates)"
+                f"{ds.projector_type.name} but the step spec declares "
+                f"{expected.name} — the step's solve/scatter logic is "
+                "compiled per projector, so they must match"
             )
-    return {
-        k: [
-            {
-                "features": b.features,
-                "labels": b.labels,
-                "weights": b.weights,
-                "sample_rows": b.sample_rows,
-                "entity_rows": b.entity_rows,
-            }
-            for b in ds.buckets
-        ]
+
+    def bucket_dict(b, ds) -> dict:
+        out = {
+            "features": b.features,
+            "labels": b.labels,
+            "weights": b.weights,
+            "sample_rows": b.sample_rows,
+            "entity_rows": b.entity_rows,
+        }
+        if ds.projector_type == ProjectorType.INDEX_MAP:
+            out["col_index"] = b.col_index
+        return out
+
+    out = {
+        k: [bucket_dict(b, ds) for b in ds.buckets]
         for k, ds in re_datasets.items()
     }
+    projections = {
+        k: jnp.asarray(ds.projection.matrix)
+        for k, ds in re_datasets.items()
+        if ds.projector_type == ProjectorType.RANDOM
+    }
+    if projections:
+        out["__projections__"] = projections
+    return out
 
 
 class GameTrainProgram:
@@ -177,6 +203,7 @@ class GameTrainProgram:
         *,
         mf_specs: Sequence[MatrixFactorizationStepSpec] = (),
         normalization: NormalizationContext | None = None,
+        re_normalizations: Mapping[str, NormalizationContext] | None = None,
     ):
         self.task = task
         self.fe = fe
@@ -196,18 +223,45 @@ class GameTrainProgram:
                 f"coordinate names must be unique across the FE feature "
                 f"shard, RE types, and MF names (duplicates: {sorted(dupes)})"
             )
-        if "__mf__" in names:
+        reserved = {"__mf__", "__projections__"} & set(names)
+        if reserved:
             raise ValueError(
-                "'__mf__' is reserved (internal bucket-group key); rename "
-                "the coordinate"
+                f"{sorted(reserved)} are reserved (internal bucket-group "
+                "keys); rename the coordinate"
             )
         loss = loss_for_task(task)
         self._loss = loss
         self.normalization = normalization
         self._fe_objective = GLMObjective(loss, l2_weight=fe.l2_weight,
                                           normalization=normalization)
+        # RE normalization: factor scaling only. A margin *shift* would need
+        # per-shard intercept bookkeeping inside the fused program; the CD
+        # path is the place for standardized REs. This mirrors — and now
+        # replaces — the old silent no-normalization behavior with either
+        # real support (factors) or a loud error (shifts).
+        re_normalizations = dict(re_normalizations or {})
+        for s in self.re_specs:
+            ctx = re_normalizations.get(s.re_type)
+            if ctx is not None and ctx.shifts is not None:
+                raise ValueError(
+                    f"random-effect coordinate '{s.re_type}': the fused step "
+                    "supports factor-scaling normalization only (no shifts / "
+                    "STANDARDIZATION). Use SCALE_WITH_STANDARD_DEVIATION / "
+                    "SCALE_WITH_MAX_MAGNITUDE, or train through the "
+                    "coordinate-descent path."
+                )
+            if ctx is not None and s.projector != ProjectorType.IDENTITY:
+                raise ValueError(
+                    f"random-effect coordinate '{s.re_type}': normalization "
+                    "cannot combine with a projected coordinate (same rule "
+                    "as the coordinate-descent path)"
+                )
+        self._re_normalizations = re_normalizations
         self._re_objectives = {
-            s.re_type: GLMObjective(loss, l2_weight=s.l2_weight)
+            s.re_type: GLMObjective(
+                loss, l2_weight=s.l2_weight,
+                normalization=re_normalizations.get(s.re_type),
+            )
             for s in self.re_specs
         }
         self._mf_objectives = {
@@ -268,7 +322,8 @@ class GameTrainProgram:
             dataset, self.re_specs, self.fe.feature_shard_id, self.mf_specs
         )
         buckets = _buckets_pytree(
-            {s.re_type: re_datasets[s.re_type] for s in self.re_specs}
+            {s.re_type: re_datasets[s.re_type] for s in self.re_specs},
+            self.re_specs,
         )
         buckets["__mf__"] = {
             m.name: {
@@ -337,6 +392,10 @@ class GameTrainProgram:
                     b["features"] = jnp.pad(
                         b["features"], ((0, pad), (0, 0), (0, 0))
                     )
+                if "col_index" in b:
+                    # padded lanes' entity_rows are OOB, so the whole 2-D
+                    # scatter row drops regardless of these column values
+                    b["col_index"] = jnp.pad(b["col_index"], ((0, pad), (0, 0)))
             out = {
                 "labels": jax.device_put(b["labels"], ent2),
                 "weights": jax.device_put(b["weights"], ent2),
@@ -345,13 +404,20 @@ class GameTrainProgram:
             }
             if "features" in b:
                 out["features"] = jax.device_put(b["features"], ent3)
+            if "col_index" in b:
+                out["col_index"] = jax.device_put(b["col_index"], ent2)
             return out
 
         sharded_buckets: dict = {
             k: [put_bucket(b) for b in bs]
             for k, bs in buckets.items()
-            if k != "__mf__"
+            if k not in ("__mf__", "__projections__")
         }
+        if "__projections__" in buckets:
+            sharded_buckets["__projections__"] = {
+                k: jax.device_put(v, rep)
+                for k, v in buckets["__projections__"].items()
+            }
         if "__mf__" in buckets:
             sharded_buckets["__mf__"] = {
                 name: {
@@ -390,11 +456,16 @@ class GameTrainProgram:
         base_offsets = data["offsets"]
         fe_x = feats[self.fe.feature_shard_id]
 
+        def re_score(k: str, table: Array, shard_id: str) -> Array:
+            # tables hold normalized-space coefficients when the coordinate
+            # is normalized; score through the effective-coefficient algebra
+            # (factors only — shifts are rejected at construction)
+            eff = self._re_objectives[k].normalization.effective_coefficients(table)
+            return score_random_effect(eff, feats[shard_id], data["entity_idx"][k])
+
         re_scores = {
-            s.re_type: score_random_effect(
-                state.re_tables[s.re_type],
-                feats[s.feature_shard_id],
-                data["entity_idx"][s.re_type],
+            s.re_type: re_score(
+                s.re_type, state.re_tables[s.re_type], s.feature_shard_id
             )
             for s in self.re_specs
         }
@@ -443,22 +514,46 @@ class GameTrainProgram:
             full_offsets = base_offsets + fe_score + sum_scores(skip=k)
             table = tables[k]
             objective = self._re_objectives[k]
-            for b in buckets[k]:
-                table = solve_entity_bucket(
-                    objective,
-                    spec.optimizer,
-                    b["features"],
-                    b["labels"],
-                    b["weights"],
-                    b["sample_rows"],
-                    b["entity_rows"],
-                    full_offsets,
-                    table,
+            if spec.projector == ProjectorType.INDEX_MAP:
+                # scratch-column solve in each entity's observed columns
+                # (ports algorithm/coordinates.py's single-chip path into
+                # the SPMD program; IndexMapProjectorRDD.scala:218-257)
+                table_ext = jnp.concatenate(
+                    [table, jnp.zeros((table.shape[0], 1), table.dtype)],
+                    axis=1,
                 )
+                for b in buckets[k]:
+                    table_ext = solve_entity_bucket_indexmap(
+                        objective, spec.optimizer,
+                        b["features"], b["labels"], b["weights"],
+                        b["sample_rows"], b["entity_rows"], b["col_index"],
+                        full_offsets, table_ext,
+                    )
+                table = table_ext[:, :-1]
+            elif spec.projector == ProjectorType.RANDOM:
+                matrix = buckets["__projections__"][k]
+                for b in buckets[k]:
+                    table = solve_entity_bucket_random(
+                        objective, spec.optimizer,
+                        b["features"], b["labels"], b["weights"],
+                        b["sample_rows"], b["entity_rows"], matrix,
+                        full_offsets, table,
+                    )
+            else:
+                for b in buckets[k]:
+                    table = solve_entity_bucket(
+                        objective,
+                        spec.optimizer,
+                        b["features"],
+                        b["labels"],
+                        b["weights"],
+                        b["sample_rows"],
+                        b["entity_rows"],
+                        full_offsets,
+                        table,
+                    )
             tables[k] = table
-            re_scores[k] = score_random_effect(
-                table, feats[spec.feature_shard_id], data["entity_idx"][k]
-            )
+            re_scores[k] = re_score(k, table, spec.feature_shard_id)
 
         # ---- matrix-factorization coordinates (alternating vmapped solves)
         mf_rows = dict(state.mf_rows)
@@ -535,8 +630,12 @@ def state_to_game_model(
         feature_shard_id=program.fe.feature_shard_id,
     )
     for spec in program.re_specs:
+        # normalized coordinates hold normalized-space tables in the state;
+        # models are always persisted in original space (factors only, so
+        # no intercept index is needed)
+        re_norm = program._re_objectives[spec.re_type].normalization
         models[spec.re_type] = RandomEffectModel(
-            coefficients=state.re_tables[spec.re_type],
+            coefficients=re_norm.to_model_space(state.re_tables[spec.re_type]),
             entity_keys=dataset.entity_vocabs[spec.re_type],
             random_effect_type=spec.re_type,
             feature_shard_id=spec.feature_shard_id,
@@ -603,10 +702,12 @@ def game_model_to_state(
     re_tables = {}
     for spec in program.re_specs:
         m = model.get(spec.re_type)
-        re_tables[spec.re_type] = align(
+        aligned = align(
             m.coefficients, m.entity_keys,
             dataset.entity_vocabs[spec.re_type], spec.re_type,
         )
+        re_norm = program._re_objectives[spec.re_type].normalization
+        re_tables[spec.re_type] = re_norm.from_model_space(aligned)
     mf_rows, mf_cols = {}, {}
     for spec in program.mf_specs:
         m = model.get(spec.name)

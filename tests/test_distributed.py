@@ -359,3 +359,172 @@ def test_program_rejects_reserved_name(rng):
             FixedEffectStepSpec("g", opt),
             (RandomEffectStepSpec("__mf__", "r", opt),),
         )
+
+
+def _projected_game_data(rng, projector, n=96, d_fe=8, d_re=12, n_users=10,
+                         projected_dim=4):
+    from photon_ml_tpu.projector.projectors import ProjectorType
+
+    users = np.array([f"u{i}" for i in rng.integers(0, n_users, size=n)])
+    x_fe = rng.normal(size=(n, d_fe)).astype(np.float64)
+    # sparse per-entity features so index maps have distinct active columns
+    x_re = rng.normal(size=(n, d_re)).astype(np.float64)
+    x_re[rng.uniform(size=(n, d_re)) < 0.6] = 0.0
+    y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    dataset = build_game_dataset(
+        labels=y,
+        feature_shards={"global": x_fe, "per_entity": x_re},
+        entity_keys={"user": users},
+        dtype=np.float64,
+    )
+    kwargs = {"projector_type": ProjectorType[projector]}
+    if projector == "RANDOM":
+        kwargs["projected_dim"] = projected_dim
+    re_datasets = {
+        "user": build_random_effect_dataset(
+            dataset, "user", "per_entity", bucket_sizes=(n,), **kwargs
+        )
+    }
+    return dataset, re_datasets
+
+
+@pytest.mark.parametrize("projector", ["INDEX_MAP", "RANDOM"])
+def test_projected_re_sharded_matches_single_device(rng, projector):
+    """VERDICT r1 #4: projected RE coordinates inside the mesh-sharded fused
+    step — sharding must not change the math."""
+    from photon_ml_tpu.projector.projectors import ProjectorType
+
+    dataset, re_datasets = _projected_game_data(rng, projector)
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=5)
+    program = GameTrainProgram(
+        TaskType.LOGISTIC_REGRESSION,
+        FixedEffectStepSpec("global", opt, l2_weight=0.1),
+        (RandomEffectStepSpec("user", "per_entity", opt, l2_weight=1.0,
+                              projector=ProjectorType[projector]),),
+    )
+    state1, losses1 = train_distributed(program, dataset, re_datasets,
+                                        num_iterations=2)
+    assert np.isfinite(losses1).all() and losses1[-1] < losses1[0]
+
+    mesh = make_mesh(data=4, model=2)
+    state8, losses8 = train_distributed(
+        program, dataset, re_datasets, mesh=mesh, num_iterations=2,
+    )
+    np.testing.assert_allclose(losses1, losses8, rtol=1e-9)
+    np.testing.assert_allclose(
+        np.asarray(state1.re_tables["user"]),
+        np.asarray(state8.re_tables["user"]),
+        rtol=1e-8, atol=1e-10,
+    )
+
+
+def test_projected_re_fused_matches_cd_path(rng):
+    """The fused step's index-map solve must agree with the single-chip
+    coordinate-descent path (same buckets, same warm starts, 1 sweep)."""
+    from photon_ml_tpu.algorithm.coordinates import (
+        CoordinateOptimizationConfig,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.projector.projectors import ProjectorType
+
+    dataset, re_datasets = _projected_game_data(rng, "INDEX_MAP")
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=8)
+
+    # fused: FE disabled by an all-zero shard? Simpler: run the RE-only part
+    # by comparing the RE table after one fused sweep with zero FE update.
+    program = GameTrainProgram(
+        TaskType.LOGISTIC_REGRESSION,
+        FixedEffectStepSpec("global", OptimizerConfig(
+            optimizer_type=OptimizerType.LBFGS, max_iterations=0)),
+        (RandomEffectStepSpec("user", "per_entity", opt, l2_weight=1.0,
+                              projector=ProjectorType.INDEX_MAP),),
+    )
+    state, _ = train_distributed(program, dataset, re_datasets, num_iterations=1)
+
+    coord = RandomEffectCoordinate(
+        coordinate_id="user",
+        dataset=dataset,
+        re_dataset=re_datasets["user"],
+        task=TaskType.LOGISTIC_REGRESSION,
+        config=CoordinateOptimizationConfig(optimizer=opt, l2_weight=1.0),
+    )
+    model, _ = coord.update_model(coord.initial_model())
+    np.testing.assert_allclose(
+        np.asarray(state.re_tables["user"]),
+        np.asarray(model.coefficients),
+        rtol=1e-7, atol=1e-9,
+    )
+
+
+def test_normalized_re_fused_matches_cd_path(rng):
+    """VERDICT r1 #9: RE normalization must mean the same thing in the fused
+    step as in the CD path (factor scaling; shifts rejected loudly)."""
+    from photon_ml_tpu.algorithm.coordinates import (
+        CoordinateOptimizationConfig,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.ops.normalization import NormalizationContext
+    from photon_ml_tpu.parallel.distributed import state_to_game_model
+
+    dataset, re_datasets = _toy_game_data(rng)
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=8)
+    factors = jnp.asarray(
+        np.random.default_rng(77).uniform(0.5, 2.0, size=4)
+    )
+    norm = NormalizationContext(factors=factors, shifts=None)
+
+    program = GameTrainProgram(
+        TaskType.LOGISTIC_REGRESSION,
+        FixedEffectStepSpec("global", OptimizerConfig(
+            optimizer_type=OptimizerType.LBFGS, max_iterations=0)),
+        (RandomEffectStepSpec("user", "per_entity", opt, l2_weight=1.0),),
+        re_normalizations={"user": norm},
+    )
+    re_ds = {"user": re_datasets["user"]}
+    state, _ = train_distributed(program, dataset, re_ds, num_iterations=1)
+    fused_model = state_to_game_model(program, state, dataset)
+
+    coord = RandomEffectCoordinate(
+        coordinate_id="user",
+        dataset=dataset,
+        re_dataset=re_datasets["user"],
+        task=TaskType.LOGISTIC_REGRESSION,
+        config=CoordinateOptimizationConfig(optimizer=opt, l2_weight=1.0),
+        normalization=norm,
+    )
+    cd_model, _ = coord.update_model(coord.initial_model())
+    np.testing.assert_allclose(
+        np.asarray(fused_model.models["user"].coefficients),
+        np.asarray(cd_model.coefficients),
+        rtol=1e-7, atol=1e-9,
+    )
+
+
+def test_fused_step_rejects_shifted_re_normalization(rng):
+    from photon_ml_tpu.ops.normalization import NormalizationContext
+
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=2)
+    norm = NormalizationContext(
+        factors=jnp.ones(4), shifts=jnp.full((4,), 0.5)
+    )
+    with pytest.raises(ValueError, match="factor-scaling"):
+        GameTrainProgram(
+            TaskType.LOGISTIC_REGRESSION,
+            FixedEffectStepSpec("global", opt),
+            (RandomEffectStepSpec("user", "per_entity", opt),),
+            re_normalizations={"user": norm},
+        )
+
+
+def test_bucket_projector_spec_mismatch_rejected(rng):
+    from photon_ml_tpu.projector.projectors import ProjectorType
+
+    dataset, re_datasets = _projected_game_data(rng, "INDEX_MAP")
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=2)
+    program = GameTrainProgram(  # spec says IDENTITY, dataset is INDEX_MAP
+        TaskType.LOGISTIC_REGRESSION,
+        FixedEffectStepSpec("global", opt),
+        (RandomEffectStepSpec("user", "per_entity", opt),),
+    )
+    with pytest.raises(ValueError, match="must match"):
+        program.prepare_inputs(dataset, re_datasets, None)

@@ -101,6 +101,49 @@ class TestSparseBatch:
                 [0], [5], [1.0], [1.0], dim=5
             )
 
+    def test_negative_indices_rejected(self):
+        with pytest.raises(ValueError, match="negative"):
+            SparseLabeledPointBatch.from_coo(
+                [-1], [0], [1.0], [1.0], dim=3
+            )
+        with pytest.raises(ValueError, match="negative"):
+            SparseShard(
+                rows=np.array([0]), cols=np.array([-2]),
+                vals=np.array([1.0]), num_samples=1, feature_dim=3,
+            )
+
+    def test_dim_beyond_int32_rejected(self):
+        # device indices are int32; a silent wrap would corrupt gathers at
+        # exactly the giant-d scale this layer exists for
+        with pytest.raises(ValueError, match="int32"):
+            SparseLabeledPointBatch.from_coo(
+                [0], [0], [1.0], [1.0], dim=2**31
+            )
+        with pytest.raises(ValueError, match="int32"):
+            SparseShard(
+                rows=np.array([0]), cols=np.array([0]),
+                vals=np.array([1.0]), num_samples=1, feature_dim=2**31,
+            )
+
+    def test_validation_failures_aggregate(self):
+        # sparse NaN + bad logistic labels must surface in ONE report
+        from photon_ml_tpu.data.game_data import build_game_dataset
+        from photon_ml_tpu.data.validators import (
+            DataValidationError,
+            validate_game_dataset,
+        )
+
+        shard = SparseShard(
+            rows=np.array([0, 1]), cols=np.array([0, 1]),
+            vals=np.array([1.0, np.nan]), num_samples=2, feature_dim=3,
+        )
+        ds = build_game_dataset(
+            labels=np.array([0.0, 7.0]), feature_shards={"g": shard}
+        )
+        with pytest.raises(DataValidationError) as e:
+            validate_game_dataset(ds, TaskType.LOGISTIC_REGRESSION)
+        assert "NaN" in str(e.value) and "binary labels" in str(e.value)
+
     def test_summarize_matches_dense(self):
         # duplicates included: they must accumulate into one cell before
         # any squaring/extremum, exactly like the dense scatter
