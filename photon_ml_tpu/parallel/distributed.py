@@ -256,7 +256,6 @@ class GameTrainProgram:
                     "cannot combine with a projected coordinate (same rule "
                     "as the coordinate-descent path)"
                 )
-        self._re_normalizations = re_normalizations
         self._re_objectives = {
             s.re_type: GLMObjective(
                 loss, l2_weight=s.l2_weight,
@@ -346,23 +345,28 @@ class GameTrainProgram:
         return data, buckets
 
     def shard_inputs(self, mesh: Mesh, data, buckets, state,
-                     *, fe_feature_sharded: bool = False):
+                     *, fe_feature_sharded: bool = False, put_fn=None):
         """Lay out inputs over the mesh: samples and entities over "data",
-        FE features (and coefficient vector) over "model" when requested."""
+        FE features (and coefficient vector) over "model" when requested.
+
+        put_fn: placement function (array, sharding) -> Array. Defaults to
+        jax.device_put; pass parallel.multihost.global_put when the mesh
+        spans multiple processes (each feeds its addressable shards)."""
+        put = put_fn if put_fn is not None else jax.device_put
         vec = NamedSharding(mesh, P("data"))
         rep = NamedSharding(mesh, P())
         fe_fspec = P("data", "model") if fe_feature_sharded else P("data", None)
 
         def put_feats(shard_id, arr):
             spec = fe_fspec if shard_id == self.fe.feature_shard_id else P("data", None)
-            return jax.device_put(arr, NamedSharding(mesh, spec))
+            return put(arr, NamedSharding(mesh, spec))
 
         data = dict(data)
-        data["labels"] = jax.device_put(data["labels"], vec)
-        data["offsets"] = jax.device_put(data["offsets"], vec)
-        data["weights"] = jax.device_put(data["weights"], vec)
+        data["labels"] = put(data["labels"], vec)
+        data["offsets"] = put(data["offsets"], vec)
+        data["weights"] = put(data["weights"], vec)
         data["features"] = {k: put_feats(k, v) for k, v in data["features"].items()}
-        data["entity_idx"] = {k: jax.device_put(v, vec) for k, v in data["entity_idx"].items()}
+        data["entity_idx"] = {k: put(v, vec) for k, v in data["entity_idx"].items()}
 
         ent3 = NamedSharding(mesh, P("data", None, None))
         ent2 = NamedSharding(mesh, P("data", None))
@@ -397,15 +401,15 @@ class GameTrainProgram:
                     # scatter row drops regardless of these column values
                     b["col_index"] = jnp.pad(b["col_index"], ((0, pad), (0, 0)))
             out = {
-                "labels": jax.device_put(b["labels"], ent2),
-                "weights": jax.device_put(b["weights"], ent2),
-                "sample_rows": jax.device_put(b["sample_rows"], ent2),
-                "entity_rows": jax.device_put(b["entity_rows"], ent1),
+                "labels": put(b["labels"], ent2),
+                "weights": put(b["weights"], ent2),
+                "sample_rows": put(b["sample_rows"], ent2),
+                "entity_rows": put(b["entity_rows"], ent1),
             }
             if "features" in b:
-                out["features"] = jax.device_put(b["features"], ent3)
+                out["features"] = put(b["features"], ent3)
             if "col_index" in b:
-                out["col_index"] = jax.device_put(b["col_index"], ent2)
+                out["col_index"] = put(b["col_index"], ent2)
             return out
 
         sharded_buckets: dict = {
@@ -415,7 +419,7 @@ class GameTrainProgram:
         }
         if "__projections__" in buckets:
             sharded_buckets["__projections__"] = {
-                k: jax.device_put(v, rep)
+                k: put(v, rep)
                 for k, v in buckets["__projections__"].items()
             }
         if "__mf__" in buckets:
@@ -433,11 +437,11 @@ class GameTrainProgram:
             pad = (-int(v.shape[0])) % data_axis
             if pad:
                 v = jnp.pad(v, ((0, pad), (0, 0)))
-            return jax.device_put(v, ent2)
+            return put(v, ent2)
 
         fe_sharding = NamedSharding(mesh, P("model")) if fe_feature_sharded else rep
         state = GameTrainState(
-            fe_coefficients=jax.device_put(state.fe_coefficients, fe_sharding),
+            fe_coefficients=put(state.fe_coefficients, fe_sharding),
             re_tables={k: put_table(v) for k, v in state.re_tables.items()},
             mf_rows={k: put_table(v) for k, v in state.mf_rows.items()},
             mf_cols={k: put_table(v) for k, v in state.mf_cols.items()},
@@ -746,8 +750,14 @@ def train_distributed(
     checkpointer=None,
     checkpoint_every: int = 1,
     resume: bool = True,
+    put_fn=None,
 ):
     """Run ``num_iterations`` fused CD sweeps, optionally mesh-sharded.
+
+    put_fn: placement function forwarded to ``shard_inputs``. Defaults to
+    ``jax.device_put`` single-process and to ``multihost.global_put`` when
+    this is a multi-process run (each process feeds its addressable shards),
+    so the same call works on a laptop and on a pod.
 
     checkpointer: optional ``io.checkpoint.TrainingCheckpointer``. Saves the
     full ``GameTrainState`` (host-gathered) every ``checkpoint_every`` sweeps;
@@ -822,8 +832,13 @@ def train_distributed(
             mf_cols=trim(state_.mf_cols, table_sizes["mf_cols"]),
         )
     if mesh is not None:
+        if put_fn is None and jax.process_count() > 1:
+            from photon_ml_tpu.parallel.multihost import global_put
+
+            put_fn = global_put
         data, buckets, state = program.shard_inputs(
-            mesh, data, buckets, state, fe_feature_sharded=fe_feature_sharded
+            mesh, data, buckets, state, fe_feature_sharded=fe_feature_sharded,
+            put_fn=put_fn,
         )
     losses = list(prior_losses)
     for sweep in range(start_sweep, num_iterations):

@@ -138,3 +138,19 @@ def make_hybrid_mesh(
     else:
         grid = np.array(devices).reshape(data, model)
     return Mesh(grid, axis_names=("data", "model"))
+
+
+def global_put(arr, sharding):
+    """Place a host array onto a (possibly multi-process) sharding.
+
+    Works where plain ``jax.device_put`` may not: when the sharding spans
+    devices of OTHER processes, each process materializes only its
+    addressable shards from its own (identical) copy of the full array —
+    the standard way to feed replicated host data into a multi-host SPMD
+    program. Single-process it degrades to an ordinary placement, so it is
+    a drop-in ``put_fn`` for GameTrainProgram.shard_inputs on pods.
+    """
+    value = np.asarray(arr)
+    return jax.make_array_from_callback(
+        value.shape, sharding, lambda idx: value[idx]
+    )

@@ -1,0 +1,47 @@
+"""Shared toy GAME problem for the two-process e2e test.
+
+One definition imported both by the spawned workers (each process builds
+the identical dataset from the fixed seed) and by the in-process test that
+computes the single-process reference result.
+"""
+
+import numpy as np
+
+from photon_ml_tpu.data.game_data import (
+    build_game_dataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+from photon_ml_tpu.parallel.distributed import (
+    FixedEffectStepSpec,
+    GameTrainProgram,
+    RandomEffectStepSpec,
+)
+from photon_ml_tpu.types import TaskType
+
+
+def toy_problem(n=64, d_fe=8, d_re=4, n_users=8):
+    rng = np.random.default_rng(123)
+    users = np.array([f"u{i}" for i in rng.integers(0, n_users, size=n)])
+    x_fe = rng.normal(size=(n, d_fe)).astype(np.float64)
+    x_re = rng.normal(size=(n, d_re)).astype(np.float64)
+    logits = x_fe @ rng.normal(size=d_fe) / np.sqrt(d_fe)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float64)
+    dataset = build_game_dataset(
+        labels=y,
+        feature_shards={"global": x_fe, "per_user": x_re},
+        entity_keys={"user": users},
+        dtype=np.float64,
+    )
+    re_datasets = {
+        "user": build_random_effect_dataset(
+            dataset, "user", "per_user", bucket_sizes=(n,)
+        )
+    }
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=5)
+    program = GameTrainProgram(
+        TaskType.LOGISTIC_REGRESSION,
+        FixedEffectStepSpec("global", opt, l2_weight=0.1),
+        (RandomEffectStepSpec("user", "per_user", opt, l2_weight=1.0),),
+    )
+    return dataset, re_datasets, program
