@@ -149,8 +149,13 @@ def global_put(arr, sharding):
     the standard way to feed replicated host data into a multi-host SPMD
     program. Single-process it degrades to an ordinary placement, so it is
     a drop-in ``put_fn`` for GameTrainProgram.shard_inputs on pods.
+
+    Host numpy inputs are sliced zero-copy; a device-resident input costs
+    one device-to-host read first (prepare_inputs materializes pytrees on
+    the local device), so at pod scale feed host-built arrays where the
+    input pipeline allows.
     """
-    value = np.asarray(arr)
+    value = arr if isinstance(arr, np.ndarray) else np.asarray(arr)
     return jax.make_array_from_callback(
         value.shape, sharding, lambda idx: value[idx]
     )
