@@ -266,10 +266,20 @@ def _pearson_keep_mask(x: np.ndarray, y: np.ndarray, num_keep: int) -> np.ndarra
         score = np.where(var_x == 0.0, 0.0, score)
     score = np.where(const_nonzero, np.inf, score)  # intercept always kept
     score = np.where(all_zero, -np.inf, score)  # inactive columns rank last
-    keep = np.argsort(-score, kind="stable")[:num_keep]
+    keep = np.argsort(-_quantize_scores(score), kind="stable")[:num_keep]
     mask = np.zeros(d, dtype=bool)
     mask[keep] = True
     return mask
+
+
+def _quantize_scores(score: np.ndarray) -> np.ndarray:
+    """Round selection scores to 9 decimals before ranking, so columns whose
+    scores are mathematically equal (e.g. |corr| = 1 for every doubly-active
+    column of a 2-sample entity) tie exactly in BOTH the scalar and grouped
+    implementations — their accumulation orders (BLAS vs np.add.at) differ
+    at the last ulp, and without quantization stable argsort would pick
+    different columns per code path."""
+    return np.round(score, 9)
 
 
 def pack_bucket_lanes(
@@ -457,7 +467,7 @@ def _pearson_keep_masks_grouped(
     score = np.where(const_nonzero, np.inf, score)
     score = np.where(all_zero, -np.inf, score)
 
-    order = np.argsort(-score, axis=1, kind="stable")
+    order = np.argsort(-_quantize_scores(score), axis=1, kind="stable")
     ranked_keep = np.arange(d)[None, :] < num_keep[:, None]
     keep = np.zeros((e, d), dtype=bool)
     np.put_along_axis(keep, order, ranked_keep, axis=1)

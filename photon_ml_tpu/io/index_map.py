@@ -103,12 +103,16 @@ class IndexMap(Mapping[str, int]):
     def list_directory(directory: str | os.PathLike) -> set[str]:
         """Shard names present in a stores directory, from filenames alone —
         no store is opened (cheap existence/coverage validation)."""
+        from photon_ml_tpu.io.paldb import PARTITION_RE
+
         shards: set[str] = set()
         for fname in os.listdir(str(directory)):
             if fname.endswith(".keys"):
                 shards.add(fname[: -len(".keys")])
             elif fname.endswith(".photonix.json"):
                 shards.add(fname[: -len(".photonix.json")])
+            elif m := PARTITION_RE.match(fname):
+                shards.add(m.group("ns"))
         return shards
 
     @staticmethod
@@ -117,6 +121,8 @@ class IndexMap(Mapping[str, int]):
         ``<shard>.keys`` files and partitioned native off-heap stores
         (``<shard>.photonix.json``; reference PalDB stores). Returns
         shard id -> Mapping (OffHeapIndexMap is a drop-in)."""
+        from photon_ml_tpu.io.paldb import PARTITION_RE, load_paldb_index_map
+
         maps: dict[str, IndexMap] = {}
         directory = str(directory)
         for fname in sorted(os.listdir(directory)):
@@ -130,6 +136,11 @@ class IndexMap(Mapping[str, int]):
                     from photon_ml_tpu.io.offheap_index_map import OffHeapIndexMap
 
                     maps[shard] = OffHeapIndexMap(directory, shard)
+            elif m := PARTITION_RE.match(fname):
+                # reference-written JVM PalDB stores: migration read path
+                shard = m.group("ns")
+                if shard not in maps:
+                    maps[shard] = load_paldb_index_map(directory, shard)
         return maps
 
     def save(self, directory: str | os.PathLike, name: str = "index") -> str:

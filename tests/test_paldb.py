@@ -1,0 +1,130 @@
+"""PalDB binary-compatibility tests against REAL reference-written stores.
+
+The reference's production feature index maps are JVM PalDB stores
+(PalDBIndexMap.scala); these tests read the actual fixture files shipped in
+/root/reference (written by the JVM library) through our from-scratch
+parser — the migration path for a user's existing stores.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.index_map import (
+    INTERCEPT_KEY,
+    IndexMap,
+    feature_key,
+)
+from photon_ml_tpu.io.paldb import (
+    discover_stores,
+    load_paldb_index_map,
+    read_partition,
+)
+
+REF = "/root/reference/photon-client/src/integTest/resources"
+HEART = f"{REF}/PalDBIndexMapTest/paldb_offheapmap_for_heart"
+HEART_ICPT = f"{REF}/PalDBIndexMapTest/paldb_offheapmap_for_heart_with_intercept"
+GAME_INDEXES = f"{REF}/GameIntegTest/input/feature-indexes"
+
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference fixtures not mounted"
+)
+
+
+@needs_reference
+class TestReadReferenceStores:
+    def test_heart_two_partition_store(self):
+        m = load_paldb_index_map(HEART, "global")
+        assert len(m) == 13
+        assert sorted(m.values()) == list(range(13))
+        # heart dataset features are named "1".."13", empty term
+        assert set(m) == {feature_key(str(i), "") for i in range(1, 14)}
+
+    def test_heart_store_with_intercept(self):
+        m = load_paldb_index_map(HEART_ICPT, "global")
+        assert len(m) == 14
+        assert INTERCEPT_KEY in m
+        assert sorted(m.values()) == list(range(14))
+
+    def test_game_stores_at_scale(self):
+        # 15k-feature stores exercise multi-byte varints and packed ints
+        sizes = {}
+        for ns in ("shard1", "shard2", "shard3"):
+            m = load_paldb_index_map(GAME_INDEXES, ns)
+            assert sorted(m.values()) == list(range(len(m))), ns
+            sizes[ns] = len(m)
+        assert sizes["shard1"] == 15045
+        assert sizes["shard2"] == 15015
+        assert sizes["shard3"] == 31
+
+    def test_name_term_keys_decode(self):
+        # shard3 holds real (name, term) pairs, not just bare names
+        m = load_paldb_index_map(GAME_INDEXES, "shard3")
+        terms = {k.split("\x01")[1] for k in m}
+        assert terms - {""}, "expected non-empty terms in shard3"
+
+    def test_partition_internal_consistency(self):
+        # read_partition cross-checks name->idx against idx->name; run it
+        # on the largest fixture explicitly
+        part = read_partition(f"{GAME_INDEXES}/paldb-partition-shard1-0.dat")
+        assert part.size == 15045
+
+    def test_discover_stores(self):
+        stores = discover_stores(GAME_INDEXES)
+        assert set(stores) == {"shard1", "shard2", "shard3"}
+        assert all(len(paths) == 1 for paths in stores.values())
+
+    def test_offset_arithmetic_across_partitions(self):
+        # the 2-partition heart store: global index = local + offset
+        # (partition sizes 7 + 6); all 13 globals distinct and contiguous
+        stores = discover_stores(HEART)
+        parts = [read_partition(p) for p in stores["global"]]
+        assert [p.size for p in parts] == [7, 6]
+        m = load_paldb_index_map(HEART, "global")
+        # partition 1's features must occupy indices 7..12
+        for name in parts[1].name_to_local:
+            assert m[name] >= 7
+
+    def test_not_a_paldb_file_raises(self, tmp_path):
+        bad = tmp_path / "paldb-partition-x-0.dat"
+        bad.write_bytes(b"\x00\x08NOTPALDB" + b"\x00" * 64)
+        with pytest.raises(ValueError, match="not a PalDB"):
+            read_partition(bad)
+
+    def test_missing_namespace_raises(self):
+        with pytest.raises(FileNotFoundError, match="namespace"):
+            load_paldb_index_map(HEART, "nope")
+
+
+@needs_reference
+class TestDirectoryIntegration:
+    def test_list_and_load_directory_discover_paldb(self):
+        assert IndexMap.list_directory(GAME_INDEXES) == {
+            "shard1", "shard2", "shard3"
+        }
+        maps = IndexMap.load_directory(GAME_INDEXES)
+        assert set(maps) == {"shard1", "shard2", "shard3"}
+        assert len(maps["shard1"]) == 15045
+
+    def test_training_driver_consumes_reference_paldb_stores(self, tmp_path):
+        """End to end: --index-maps-dir pointing at the JVM-written PalDB
+        directory; the driver trains in the reference's own feature space
+        (GameDriver.prepareFeatureMaps PalDB path)."""
+        from photon_ml_tpu.cli import game_training_driver
+
+        out = tmp_path / "out"
+        summary = game_training_driver.main([
+            "--input-data-path",
+            f"{REF}/GameIntegTest/input/duplicateFeatures/yahoo-music-train.avro",
+            "--root-output-dir", str(out),
+            "--index-maps-dir", GAME_INDEXES,
+            "--feature-shard-configurations",
+            "name=shard1,feature.bags=features|userFeatures",
+            "--coordinate-configurations",
+            "name=fe,feature.shard=shard1,reg.weights=1.0,max.iter=20",
+            "--task-type", "LINEAR_REGRESSION",
+            "--coordinate-descent-iterations", "1",
+        ])
+        assert summary["num_configurations"] == 1
+        assert (out / "best" / "fixed-effect" / "fe" / "id-info").exists()
