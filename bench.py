@@ -92,16 +92,22 @@ def bench_tpu(x, y) -> tuple[float, int]:
 
     l2v = jnp.asarray(_grid(GRID), jnp.float32)
     float(run_grid(batch, l2v, 0)[1])  # compile + sync
-    best = None
-    for rep in range(3):
+
+    def timed(k, seed0):
+        # k pipelined grid solves (fresh PRNG warm starts), one final host
+        # read: per-call dispatch overlaps device execution, so k-vs-1
+        # differencing isolates the device time of one full grid
         t0 = time.perf_counter()
-        iters, checksum = run_grid(batch, l2v, rep + 1)
-        iters = int(iters)
-        float(checksum)  # host read: hard sync
+        results = [run_grid(batch, l2v, seed0 + i) for i in range(k)]
+        for _, checksum in results:
+            float(checksum)  # host read: hard sync
         elapsed = time.perf_counter() - t0
-        if best is None or elapsed < best[0]:
-            best = (elapsed, iters)
-    return best
+        return elapsed, sum(int(it) for it, _ in results)
+
+    lo = min(timed(1, s)[0] for s in (1, 2))
+    hi_t, hi_iters = min((timed(3, s) for s in (10, 20)), key=lambda r: r[0])
+    marginal = max((hi_t - lo) / 2, 1e-6)
+    return marginal, hi_iters // 3
 
 
 def bench_hot_loop_bandwidth(x, y) -> list[dict]:
@@ -258,6 +264,73 @@ def bench_game_sweep() -> dict:
     }
 
 
+def bench_sparse_fe() -> dict:
+    """Giant-d sparse fixed effect on hardware: d=10⁷ logistic L-BFGS over
+    flat-COO data (dense [n, d] would be n·d·4 ≈ 21 TB — the path the
+    reference's 'hundreds of billions of coefficients' claim needs).
+    Reported as entry-iterations/sec, marginal over extra iterations."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.sparse_batch import SparseLabeledPointBatch
+    from photon_ml_tpu.ops.losses import LogisticLoss
+    from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
+    from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+
+    rng = np.random.default_rng(3)
+    n, d, per_row = 1 << 19, 10_000_000, 32
+    rows = np.repeat(np.arange(n), per_row)
+    cols = rng.integers(0, d, size=n * per_row)
+    vals = rng.normal(size=n * per_row).astype(np.float32)
+    support = rng.choice(d, size=256, replace=False)
+    w_true = np.zeros(d, dtype=np.float32)
+    w_true[support] = rng.normal(size=256).astype(np.float32)
+    sig = rng.integers(0, 256, size=(n, 4))
+    sig_vals = rng.normal(size=(n, 4)).astype(np.float32)
+    logits = (sig_vals * w_true[support][sig]).sum(axis=1)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float32)
+    rows = np.concatenate([rows, np.repeat(np.arange(n), 4)])
+    cols = np.concatenate([cols, support[sig].ravel()])
+    vals = np.concatenate([vals, sig_vals.ravel()])
+    nnz = len(vals)
+    batch = SparseLabeledPointBatch.from_coo(rows, cols, vals, y, dim=d,
+                                             dtype=np.float32)
+    obj = SparseGLMObjective(LogisticLoss(), l2_weight=0.1)
+    bound = obj.bind(batch)
+
+    def timed(iters, seed):
+        @jax.jit
+        def run(w0):
+            r = minimize_lbfgs(bound.value_and_grad, w0, max_iter=iters,
+                               tolerance=0.0)
+            return r.value + r.coefficients[0]
+
+        key = jax.random.PRNGKey(seed)
+        w0 = 1e-3 * jax.random.normal(key, (d,), jnp.float32)
+        float(run(w0))  # compile + sync
+        best = None
+        for s in range(2):
+            w0 = 1e-3 * jax.random.normal(jax.random.PRNGKey(seed + s + 1), (d,))
+            t0 = time.perf_counter()
+            float(run(w0.astype(jnp.float32)))
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    k_lo, k_hi = 4, 16
+    marginal = max((timed(k_hi, 0) - timed(k_lo, 100)) / (k_hi - k_lo), 1e-6)
+    return {
+        "metric": "sparse_giant_fe_entry_iters_per_sec",
+        "value": round(nnz / marginal, 1),
+        "unit": (
+            f"nonzero-entries x L-BFGS-iters/sec, sparse FE d={d:.0e} "
+            f"(n={n}, nnz={nnz}, logistic, flat-COO gather/segment-sum; "
+            f"marginal over {k_hi - k_lo} extra iterations, "
+            f"{marginal*1e3:.2f} ms/iter)"
+        ),
+    }
+
+
 def bench_cpu_scipy(x, y) -> float:
     """scipy L-BFGS-B example-iters/sec over the same λ grid, sequential.
     Iteration-normalized so vs_baseline compares per-unit-work throughput —
@@ -292,6 +365,7 @@ def main():
     tpu_time, lane_iters = bench_tpu(x, y)
     extra = bench_hot_loop_bandwidth(x[: 1 << 17], y[: 1 << 17])
     extra.append(bench_game_sweep())
+    extra.append(bench_sparse_fe())
     cpu_rate = bench_cpu_scipy(x[:CPU_SUBSAMPLE], y[:CPU_SUBSAMPLE])
 
     rate = N * lane_iters / tpu_time
@@ -301,7 +375,8 @@ def main():
         "unit": (
             f"examples x L-BFGS-iters/sec over a {GRID}-lane vmapped "
             f"lambda grid (n={N}, d={D}, logistic, {lane_iters} lane-iters "
-            f"in {tpu_time:.3f}s incl. dispatch latency; vs_baseline is "
+            f"per grid, marginal {tpu_time:.3f}s/grid via pipelined 3-vs-1 "
+            "differencing — dispatch overlaps device time; vs_baseline is "
             "iteration-normalized against scipy L-BFGS-B on the same grid)"
         ),
         "vs_baseline": round(rate / cpu_rate, 2),

@@ -93,6 +93,11 @@ def _deserialize(buf: bytes, pos: int, end: int):
         return _unpack_longpacker(buf, pos + 1)[0]
     if t == _STRING:
         n, p = _unpack_longpacker(buf, pos + 1)
+        if p + n > end:
+            raise ValueError(
+                f"corrupt PalDB blob at offset {pos}: string of {n} bytes "
+                f"overruns its {end - pos}-byte region"
+            )
         return buf[p : p + n].decode("utf-8")
     raise ValueError(
         f"unsupported PalDB serialization type byte 0x{t:02x} at offset "
@@ -181,7 +186,10 @@ def read_partition(path: str | os.PathLike) -> PalDBPartition:
 
 def discover_stores(directory: str | os.PathLike) -> dict[str, list[str]]:
     """namespace -> ordered partition file paths, for every PalDB store in
-    the directory (reference partitionFilename naming)."""
+    the directory (reference partitionFilename naming).
+
+    Partition-set validation happens per namespace at LOAD time, not here —
+    one unrelated broken store must not block loading a healthy one."""
     directory = str(directory)
     found: dict[str, dict[int, str]] = {}
     for fname in os.listdir(directory):
@@ -190,16 +198,9 @@ def discover_stores(directory: str | os.PathLike) -> dict[str, list[str]]:
             found.setdefault(m.group("ns"), {})[int(m.group("idx"))] = os.path.join(
                 directory, fname
             )
-    out: dict[str, list[str]] = {}
-    for ns, parts in found.items():
-        expected = set(range(len(parts)))
-        if set(parts) != expected:
-            raise ValueError(
-                f"PalDB store '{ns}' in {directory} has partitions "
-                f"{sorted(parts)}; expected contiguous 0..{len(parts) - 1}"
-            )
-        out[ns] = [parts[i] for i in range(len(parts))]
-    return out
+    return {
+        ns: [parts[i] for i in sorted(parts)] for ns, parts in found.items()
+    }
 
 
 def load_paldb_index_map(
@@ -217,11 +218,28 @@ def load_paldb_index_map(
             f"no PalDB store for namespace '{namespace}' in {directory} "
             f"(found: {sorted(stores) or 'none'})"
         )
+    paths = stores[namespace]
+    indices = {
+        int(PARTITION_RE.match(os.path.basename(p)).group("idx")) for p in paths
+    }
+    if indices != set(range(len(paths))):
+        raise ValueError(
+            f"PalDB store '{namespace}' in {directory} has partitions "
+            f"{sorted(indices)}; expected contiguous 0..{len(paths) - 1}"
+        )
     mapping: dict[str, int] = {}
     offset = 0
-    for path in stores[namespace]:
+    for path in paths:
         part = read_partition(path)
         for name, local in part.name_to_local.items():
             mapping[name] = local + offset
         offset += part.size
+    if sorted(mapping.values()) != list(range(len(mapping))):
+        # gapped partition-local indices would silently alias two features
+        # onto one global column under the offset arithmetic
+        raise ValueError(
+            f"PalDB store '{namespace}' in {directory} yields non-contiguous "
+            "global indices — partition-local indices are gapped or "
+            "duplicated (corrupt or truncated store)"
+        )
     return IndexMap(mapping)

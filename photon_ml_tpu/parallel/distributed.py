@@ -46,6 +46,8 @@ from photon_ml_tpu.algorithm.mf_coordinate import solve_mf_side_bucket
 from photon_ml_tpu.models.matrix_factorization import score_matrix_factorization
 from photon_ml_tpu.data.batch import LabeledPointBatch
 from photon_ml_tpu.data.game_data import GameDataset, RandomEffectDataset
+from photon_ml_tpu.data.sparse_batch import sparse_margins
+from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
 from photon_ml_tpu.models.game import score_random_effect
 from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.ops.losses import loss_for_task
@@ -123,26 +125,47 @@ def _data_pytree(dataset: GameDataset, re_specs: Sequence[RandomEffectStepSpec],
     id_types = {s.re_type for s in re_specs}
     for m in mf_specs:
         id_types |= {m.row_effect_type, m.col_effect_type}
-    from photon_ml_tpu.data.sparse_batch import SparseShard
+    from photon_ml_tpu.data.sparse_batch import (
+        SparseLabeledPointBatch,
+        SparseShard,
+    )
 
+    fe_sparse = isinstance(dataset.feature_shards[fe_shard], SparseShard)
+    re_shards = {s.feature_shard_id for s in re_specs}
     for k in shards:
-        if isinstance(dataset.feature_shards[k], SparseShard):
+        if isinstance(dataset.feature_shards[k], SparseShard) and (
+            k != fe_shard or k in re_shards
+        ):
             raise ValueError(
-                f"feature shard '{k}' is sparse (giant-d); the fused "
-                "GameTrainProgram consumes dense [n, d] blocks. Train "
-                "sparse fixed-effect coordinates through the "
-                "coordinate-descent path (GameEstimator / "
-                "FixedEffectCoordinate) instead."
+                f"feature shard '{k}' is sparse (giant-d); only the "
+                "FIXED-EFFECT coordinate of the fused GameTrainProgram "
+                "supports sparse shards — random-effect/MF coordinates "
+                "consume dense [n, d] blocks."
             )
-    return {
-        "labels": jnp.asarray(dataset.labels),
+    labels = jnp.asarray(dataset.labels)
+    weights = jnp.asarray(dataset.weights)
+    data = {
+        "labels": labels,
         "offsets": jnp.asarray(dataset.offsets),
-        "weights": jnp.asarray(dataset.weights),
-        "features": {k: jnp.asarray(dataset.feature_shards[k]) for k in shards},
+        "weights": weights,
+        "features": {
+            k: jnp.asarray(dataset.feature_shards[k])
+            for k in shards
+            if not (k == fe_shard and fe_sparse)
+        },
         "entity_idx": {
             t: jnp.asarray(dataset.entity_idx[t]) for t in sorted(id_types)
         },
     }
+    if fe_sparse:
+        # flat-COO FE batch: offsets filled per step (residual scores);
+        # the static `dim` rides the pytree treedef, so sparse-vs-dense is
+        # a compile-time branch in the step
+        data["fe_sparse_batch"] = SparseLabeledPointBatch.from_shard(
+            dataset.feature_shards[fe_shard], labels,
+            jnp.zeros_like(labels), weights,
+        )
+    return data
 
 
 def _buckets_pytree(
@@ -234,6 +257,12 @@ class GameTrainProgram:
         self.normalization = normalization
         self._fe_objective = GLMObjective(loss, l2_weight=fe.l2_weight,
                                           normalization=normalization)
+        # sparse twin, used when the FE shard arrives as flat COO (the
+        # giant-d path); shares the normalization context so jit caches of
+        # both variants stay identity-keyed
+        self._fe_sparse_objective = SparseGLMObjective(
+            loss, l2_weight=fe.l2_weight, normalization=normalization
+        )
         # RE normalization: factor scaling only. A margin *shift* would need
         # per-shard intercept bookkeeping inside the fused program; the CD
         # path is the place for standardized REs. This mirrors — and now
@@ -355,6 +384,7 @@ class GameTrainProgram:
         put = put_fn if put_fn is not None else jax.device_put
         vec = NamedSharding(mesh, P("data"))
         rep = NamedSharding(mesh, P())
+        data_axis = int(mesh.shape["data"])
         fe_fspec = P("data", "model") if fe_feature_sharded else P("data", None)
 
         def put_feats(shard_id, arr):
@@ -367,11 +397,36 @@ class GameTrainProgram:
         data["weights"] = put(data["weights"], vec)
         data["features"] = {k: put_feats(k, v) for k, v in data["features"].items()}
         data["entity_idx"] = {k: put(v, vec) for k, v in data["entity_idx"].items()}
+        if "fe_sparse_batch" in data:
+            # flat entry arrays shard over "data" (nnz axis); per-sample
+            # vectors over "data"; GSPMD inserts the psum that combines
+            # per-shard partial margins and the model-axis collectives for
+            # a "model"-sharded coefficient gather
+            sb = data["fe_sparse_batch"]
+            pad = (-sb.nnz) % data_axis
+            if pad:
+                # inert entries: value 0, repeating the last row id so the
+                # segment_sum's sorted promise holds
+                last_row = sb.row_ids[-1:] if sb.nnz else jnp.zeros(1, jnp.int32)
+                sb = sb.replace(
+                    values=jnp.pad(sb.values, (0, pad)),
+                    col_indices=jnp.pad(sb.col_indices, (0, pad)),
+                    row_ids=jnp.concatenate(
+                        [sb.row_ids, jnp.broadcast_to(last_row, (pad,))]
+                    ),
+                )
+            data["fe_sparse_batch"] = sb.replace(
+                values=put(sb.values, vec),
+                col_indices=put(sb.col_indices, vec),
+                row_ids=put(sb.row_ids, vec),
+                labels=put(sb.labels, vec),
+                offsets=put(sb.offsets, vec),
+                weights=put(sb.weights, vec),
+            )
 
         ent3 = NamedSharding(mesh, P("data", None, None))
         ent2 = NamedSharding(mesh, P("data", None))
         ent1 = NamedSharding(mesh, P("data"))
-        data_axis = int(mesh.shape["data"])
 
         def put_bucket(b: dict) -> dict:
             # Pad the entity axis to a multiple of the mesh "data" axis.
@@ -458,7 +513,8 @@ class GameTrainProgram:
         feats = data["features"]
         labels, weights = data["labels"], data["weights"]
         base_offsets = data["offsets"]
-        fe_x = feats[self.fe.feature_shard_id]
+        fe_sparse = data.get("fe_sparse_batch")
+        fe_x = None if fe_sparse is not None else feats[self.fe.feature_shard_id]
 
         def re_score(k: str, table: Array, shard_id: str) -> Array:
             # tables hold normalized-space coefficients when the coordinate
@@ -494,22 +550,31 @@ class GameTrainProgram:
             return total
 
         # ---- fixed-effect coordinate (samples sharded; grads psum over mesh)
-        fe_batch = LabeledPointBatch(
-            features=fe_x,
-            labels=labels,
-            offsets=base_offsets + sum_scores(),
-            weights=weights,
-        )
+        if fe_sparse is not None:
+            fe_batch = fe_sparse.replace(offsets=base_offsets + sum_scores())
+            fe_objective = self._fe_sparse_objective
+        else:
+            fe_batch = LabeledPointBatch(
+                features=fe_x,
+                labels=labels,
+                offsets=base_offsets + sum_scores(),
+                weights=weights,
+            )
+            fe_objective = self._fe_objective
         fe_result = solve(
-            self.fe.optimizer, self._fe_objective.bind(fe_batch), state.fe_coefficients
+            self.fe.optimizer, fe_objective.bind(fe_batch), state.fe_coefficients
         )
         fe_w = fe_result.coefficients
         # fe_w lives in normalized space (warm starts stay there across steps);
         # score through the same effective-coefficient algebra the objective
         # uses so residuals and the loss are in original data space.
-        norm = self._fe_objective.normalization
+        norm = fe_objective.normalization
         eff = norm.effective_coefficients(fe_w)
-        fe_score = fe_x @ eff - norm.margin_shift(eff)
+        if fe_sparse is not None:
+            # fe_sparse keeps its zero offsets, so this is the pure margin
+            fe_score = sparse_margins(fe_sparse, eff) - norm.margin_shift(eff)
+        else:
+            fe_score = fe_x @ eff - norm.margin_shift(eff)
 
         # ---- random-effect coordinates (entities sharded, vmapped solves)
         tables = dict(state.re_tables)

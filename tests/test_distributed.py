@@ -528,3 +528,105 @@ def test_bucket_projector_spec_mismatch_rejected(rng):
     )
     with pytest.raises(ValueError, match="must match"):
         program.prepare_inputs(dataset, re_datasets, None)
+
+
+class TestSparseFixedEffectFusedStep:
+    def _data(self, rng, n=96, d_fe=10, d_re=4, n_users=8):
+        from photon_ml_tpu.data.sparse_batch import SparseShard
+
+        users = np.array([f"u{i}" for i in rng.integers(0, n_users, size=n)])
+        x_fe = rng.normal(size=(n, d_fe))
+        x_fe[rng.uniform(size=(n, d_fe)) < 0.5] = 0.0
+        x_re = rng.normal(size=(n, d_re))
+        logits = x_fe @ rng.normal(size=d_fe) / np.sqrt(d_fe)
+        y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float64)
+        rows, cols = np.nonzero(x_fe)
+        sparse_shard = SparseShard(
+            rows=rows, cols=cols, vals=x_fe[rows, cols],
+            num_samples=n, feature_dim=d_fe,
+        )
+
+        def dataset(fe_shard):
+            return build_game_dataset(
+                labels=y,
+                feature_shards={"global": fe_shard, "per_user": x_re},
+                entity_keys={"user": users},
+                dtype=np.float64,
+            )
+
+        return dataset(sparse_shard), dataset(x_fe)
+
+    def _program(self):
+        opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=6)
+        return GameTrainProgram(
+            TaskType.LOGISTIC_REGRESSION,
+            FixedEffectStepSpec("global", opt, l2_weight=0.1),
+            (RandomEffectStepSpec("user", "per_user", opt, l2_weight=1.0),),
+        )
+
+    def test_sparse_fe_matches_dense_fe(self, rng):
+        ds_sparse, ds_dense = self._data(rng)
+        re_s = {"user": build_random_effect_dataset(ds_sparse, "user", "per_user",
+                                                    bucket_sizes=(96,))}
+        re_d = {"user": build_random_effect_dataset(ds_dense, "user", "per_user",
+                                                    bucket_sizes=(96,))}
+        program = self._program()
+        state_s, losses_s = train_distributed(program, ds_sparse, re_s,
+                                              num_iterations=2)
+        state_d, losses_d = train_distributed(program, ds_dense, re_d,
+                                              num_iterations=2)
+        np.testing.assert_allclose(losses_s, losses_d, rtol=1e-8)
+        np.testing.assert_allclose(
+            np.asarray(state_s.fe_coefficients),
+            np.asarray(state_d.fe_coefficients),
+            rtol=1e-7, atol=1e-10,
+        )
+        np.testing.assert_allclose(
+            np.asarray(state_s.re_tables["user"]),
+            np.asarray(state_d.re_tables["user"]),
+            rtol=1e-7, atol=1e-10,
+        )
+
+    def test_sparse_fe_sharded_matches_single_device(self, rng):
+        """Giant-FE distributed story: flat-COO FE + model-axis-sharded
+        coefficient vector inside the fused SPMD step."""
+        ds_sparse, _ = self._data(rng, n=128)
+        re_s = {"user": build_random_effect_dataset(ds_sparse, "user", "per_user",
+                                                    bucket_sizes=(128,))}
+        program = self._program()
+        state1, losses1 = train_distributed(program, ds_sparse, re_s,
+                                            num_iterations=2)
+        mesh = make_mesh(data=4, model=2)
+        state8, losses8 = train_distributed(
+            program, ds_sparse, re_s, mesh=mesh, num_iterations=2,
+            fe_feature_sharded=True,
+        )
+        fe_spec = state8.fe_coefficients.sharding.spec
+        assert tuple(fe_spec) == ("model",), fe_spec
+        np.testing.assert_allclose(losses1, losses8, rtol=1e-8)
+        np.testing.assert_allclose(
+            np.asarray(state1.fe_coefficients),
+            np.asarray(state8.fe_coefficients),
+            rtol=1e-7, atol=1e-10,
+        )
+
+    def test_sparse_re_shard_rejected(self, rng):
+        from photon_ml_tpu.data.sparse_batch import SparseShard
+
+        n = 32
+        x = np.eye(n, 4)
+        rows, cols = np.nonzero(x)
+        shard = SparseShard(rows=rows, cols=cols, vals=x[rows, cols],
+                            num_samples=n, feature_dim=4)
+        ds = build_game_dataset(
+            labels=np.zeros(n), feature_shards={"e": shard},
+            entity_keys={"user": np.array(["u0"] * n)},
+        )
+        opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=2)
+        program = GameTrainProgram(
+            TaskType.LINEAR_REGRESSION,
+            FixedEffectStepSpec("e", opt),
+            (RandomEffectStepSpec("user", "e", opt),),
+        )
+        with pytest.raises(ValueError, match="FIXED-EFFECT"):
+            program.prepare_inputs(ds, {"user": None}, None)

@@ -96,6 +96,18 @@ class TestReadReferenceStores:
         with pytest.raises(FileNotFoundError, match="namespace"):
             load_paldb_index_map(HEART, "nope")
 
+    def test_broken_sibling_store_does_not_block_healthy_one(self, tmp_path):
+        import shutil
+
+        for f in os.listdir(HEART):
+            shutil.copy(os.path.join(HEART, f), tmp_path / f)
+        # leftover store with a missing partition 0
+        (tmp_path / "paldb-partition-old-1.dat").write_bytes(b"junk")
+        m = load_paldb_index_map(tmp_path, "global")
+        assert len(m) == 13
+        with pytest.raises(ValueError, match="contiguous"):
+            load_paldb_index_map(tmp_path, "old")
+
 
 @needs_reference
 class TestDirectoryIntegration:
