@@ -184,8 +184,8 @@ def read_partition(path: str | os.PathLike) -> PalDBPartition:
     return PalDBPartition(name_to_local=name_to_local, local_to_name=local_to_name)
 
 
-def discover_stores(directory: str | os.PathLike) -> dict[str, list[str]]:
-    """namespace -> ordered partition file paths, for every PalDB store in
+def discover_stores(directory: str | os.PathLike) -> dict[str, dict[int, str]]:
+    """namespace -> {partition index: file path}, for every PalDB store in
     the directory (reference partitionFilename naming).
 
     Partition-set validation happens per namespace at LOAD time, not here —
@@ -198,9 +198,7 @@ def discover_stores(directory: str | os.PathLike) -> dict[str, list[str]]:
             found.setdefault(m.group("ns"), {})[int(m.group("idx"))] = os.path.join(
                 directory, fname
             )
-    return {
-        ns: [parts[i] for i in sorted(parts)] for ns, parts in found.items()
-    }
+    return found
 
 
 def load_paldb_index_map(
@@ -218,18 +216,15 @@ def load_paldb_index_map(
             f"no PalDB store for namespace '{namespace}' in {directory} "
             f"(found: {sorted(stores) or 'none'})"
         )
-    paths = stores[namespace]
-    indices = {
-        int(PARTITION_RE.match(os.path.basename(p)).group("idx")) for p in paths
-    }
-    if indices != set(range(len(paths))):
+    parts = stores[namespace]
+    if set(parts) != set(range(len(parts))):
         raise ValueError(
             f"PalDB store '{namespace}' in {directory} has partitions "
-            f"{sorted(indices)}; expected contiguous 0..{len(paths) - 1}"
+            f"{sorted(parts)}; expected contiguous 0..{len(parts) - 1}"
         )
     mapping: dict[str, int] = {}
     offset = 0
-    for path in paths:
+    for path in (parts[i] for i in range(len(parts))):
         part = read_partition(path)
         for name, local in part.name_to_local.items():
             mapping[name] = local + offset

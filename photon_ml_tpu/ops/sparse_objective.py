@@ -97,7 +97,46 @@ class SparseGLMObjective:
     def value_and_gradient(
         self, coefficients: Array, batch: SparseLabeledPointBatch
     ) -> tuple[Array, Array]:
+        if batch.has_column_sorted_view:
+            return self._value_and_gradient_column_sorted(coefficients, batch)
         return jax.value_and_grad(self.value)(coefficients, batch)
+
+    def _value_and_gradient_column_sorted(
+        self, coefficients: Array, batch: SparseLabeledPointBatch
+    ) -> tuple[Array, Array]:
+        """Hand-fused value+gradient using the batch's column-sorted view.
+
+        The autodiff gradient transposes the margin gather into a
+        random-index scatter-add over [dim] — the dominant cost of giant-d
+        solves on TPU. With the entries pre-sorted by column, the same
+        reduction is a SORTED segment-sum. Full normalization algebra:
+            margin_i = Σ vals·eff[cols] − eff·shifts + offsets
+            ∂/∂w     = f ⊙ (Σ_col dz·x  −  (Σ_i dz_i)·shifts) + λw
+        (f = factors; dz = w_i·l'_i). Verified against the autodiff path in
+        tests.
+        """
+        margins = self.margins(coefficients, batch)
+        losses, dz = self.loss.loss_and_dz(margins, batch.labels)
+        total = jnp.sum(batch.weights * losses)
+        dzw = batch.weights * dz
+        contrib = dzw[batch.rows_by_col] * batch.vals_by_col
+        g_eff = jax.ops.segment_sum(
+            contrib, batch.cols_sorted,
+            num_segments=batch.dim, indices_are_sorted=True,
+        )
+        norm = self.normalization
+        if norm.shifts is not None:
+            g_eff = g_eff - jnp.sum(dzw) * norm.shifts
+        grad = g_eff * norm.factors if norm.factors is not None else g_eff
+        if self.axis_name is not None:
+            total = jax.lax.psum(total, self.axis_name)
+            grad = jax.lax.psum(grad, self.axis_name)
+        if self.l2_weight > 0.0:
+            total = total + 0.5 * self.l2_weight * jnp.vdot(
+                coefficients, coefficients
+            )
+            grad = grad + self.l2_weight * coefficients
+        return total, grad
 
     def gradient(self, coefficients: Array, batch: SparseLabeledPointBatch) -> Array:
         return self.value_and_gradient(coefficients, batch)[1]

