@@ -505,23 +505,42 @@ class GameTrainProgram:
         """One full CD sweep. Returns (new_state, training_loss)."""
         return self._step(data, buckets, state)
 
-    def _step_impl(self, data, buckets, state: GameTrainState):
-        feats = data["features"]
-        labels, weights = data["labels"], data["weights"]
-        base_offsets = data["offsets"]
+    # -- scoring helpers shared by the step and the post-hoc variance path --
+
+    def _re_coordinate_score(self, data, k: str, table: Array,
+                             shard_id: str) -> Array:
+        """Tables hold normalized-space coefficients when the coordinate is
+        normalized; score through the effective-coefficient algebra
+        (factors only — shifts are rejected at construction)."""
+        eff = self._re_objectives[k].normalization.effective_coefficients(table)
+        return score_random_effect(
+            eff, data["features"][shard_id], data["entity_idx"][k]
+        )
+
+    def _fe_margin_score(self, data, fe_w: Array) -> Array:
+        """The FE coordinate's pure margin (no offsets) from normalized-space
+        coefficients, dense or flat-COO."""
         fe_sparse = data.get("fe_sparse_batch")
-        fe_x = None if fe_sparse is not None else feats[self.fe.feature_shard_id]
+        objective = (
+            self._fe_sparse_objective if fe_sparse is not None
+            else self._fe_objective
+        )
+        norm = objective.normalization
+        eff = norm.effective_coefficients(fe_w)
+        if fe_sparse is not None:
+            # fe_sparse keeps its zero offsets, so this is the pure margin
+            return sparse_margins(fe_sparse, eff) - norm.margin_shift(eff)
+        return (
+            data["features"][self.fe.feature_shard_id] @ eff
+            - norm.margin_shift(eff)
+        )
 
-        def re_score(k: str, table: Array, shard_id: str) -> Array:
-            # tables hold normalized-space coefficients when the coordinate
-            # is normalized; score through the effective-coefficient algebra
-            # (factors only — shifts are rejected at construction)
-            eff = self._re_objectives[k].normalization.effective_coefficients(table)
-            return score_random_effect(eff, feats[shard_id], data["entity_idx"][k])
-
+    def _state_scores(self, data, state: GameTrainState) -> tuple[dict, dict]:
+        """(re_scores, mf_scores) of every non-FE coordinate at the state's
+        current tables — the residual terms of the CD recursion."""
         re_scores = {
-            s.re_type: re_score(
-                s.re_type, state.re_tables[s.re_type], s.feature_shard_id
+            s.re_type: self._re_coordinate_score(
+                data, s.re_type, state.re_tables[s.re_type], s.feature_shard_id
             )
             for s in self.re_specs
         }
@@ -534,6 +553,16 @@ class GameTrainProgram:
             )
             for m in self.mf_specs
         }
+        return re_scores, mf_scores
+
+    def _step_impl(self, data, buckets, state: GameTrainState):
+        feats = data["features"]
+        labels, weights = data["labels"], data["weights"]
+        base_offsets = data["offsets"]
+        fe_sparse = data.get("fe_sparse_batch")
+        fe_x = None if fe_sparse is not None else feats[self.fe.feature_shard_id]
+
+        re_scores, mf_scores = self._state_scores(data, state)
 
         def sum_scores(skip=None):
             total = jnp.zeros_like(base_offsets)
@@ -564,13 +593,7 @@ class GameTrainProgram:
         # fe_w lives in normalized space (warm starts stay there across steps);
         # score through the same effective-coefficient algebra the objective
         # uses so residuals and the loss are in original data space.
-        norm = fe_objective.normalization
-        eff = norm.effective_coefficients(fe_w)
-        if fe_sparse is not None:
-            # fe_sparse keeps its zero offsets, so this is the pure margin
-            fe_score = sparse_margins(fe_sparse, eff) - norm.margin_shift(eff)
-        else:
-            fe_score = fe_x @ eff - norm.margin_shift(eff)
+        fe_score = self._fe_margin_score(data, fe_w)
 
         # ---- random-effect coordinates (entities sharded, vmapped solves)
         tables = dict(state.re_tables)
@@ -618,7 +641,9 @@ class GameTrainProgram:
                         table,
                     )
             tables[k] = table
-            re_scores[k] = re_score(k, table, spec.feature_shard_id)
+            re_scores[k] = self._re_coordinate_score(
+                data, k, table, spec.feature_shard_id
+            )
 
         # ---- matrix-factorization coordinates (alternating vmapped solves)
         mf_rows = dict(state.mf_rows)
@@ -659,12 +684,131 @@ class GameTrainProgram:
         return new_state, train_loss
 
 
+def compute_state_variances(
+    program: GameTrainProgram,
+    state: GameTrainState,
+    dataset: GameDataset,
+    re_datasets: Mapping[str, RandomEffectDataset] | None = None,
+    *,
+    variance_mode: str = "auto",
+) -> tuple[Array, dict[str, Array]]:
+    """Post-hoc coefficient variances for a fused-trained state.
+
+    The reference computes variances inside each optimization problem at
+    the optimum (DistributedOptimizationProblem.computeVariances for the
+    FE, SingleNodeOptimizationProblem for each entity); the fused step
+    skips them (they are pure output, not part of the training recursion).
+    This recomputes each coordinate's residual offsets from the final
+    state — the same Hessians the reference evaluates — and returns
+    (fe_variances, {re_type: [E, d] variance table}), both mapped to
+    original model space. NaN rows mark entities no bucket trained.
+
+    Requires ``re_datasets`` when the program has RE coordinates (their
+    buckets carry the per-entity training views). Projected RE coordinates
+    are rejected, matching the CD path.
+    """
+    from photon_ml_tpu.algorithm.coordinates import (
+        _jitted_re_bucket_variances,
+        _jitted_re_bucket_variances_diagonal,
+    )
+    from photon_ml_tpu.ops.variance import (
+        coefficient_variances,
+        resolve_variance_mode,
+        validate_variance_mode,
+    )
+
+    # fail configuration errors BEFORE any device work (CD-path convention)
+    validate_variance_mode(variance_mode)
+    if program.re_specs:
+        missing = [
+            s.re_type for s in program.re_specs
+            if re_datasets is None or s.re_type not in re_datasets
+        ]
+        if missing:
+            raise ValueError(
+                "compute_state_variances needs re_datasets entries for the "
+                f"program's random-effect coordinates; missing: {missing}"
+            )
+        for spec in program.re_specs:
+            if spec.projector != ProjectorType.IDENTITY:
+                raise ValueError(
+                    f"random-effect coordinate '{spec.re_type}': variance "
+                    "computation is not supported with projected coordinates "
+                    "(same rule as the coordinate-descent path)"
+                )
+
+    data = _data_pytree(
+        dataset, program.re_specs, program.fe.feature_shard_id, program.mf_specs
+    )
+    base_offsets = data["offsets"]
+    labels, weights = data["labels"], data["weights"]
+    fe_sparse = data.get("fe_sparse_batch")
+
+    # the exact residual-offset algebra of the fused step, via its own
+    # scoring helpers (one definition for both the recursion and this path)
+    re_scores, mf_scores = program._state_scores(data, state)
+    scores = {**re_scores, **mf_scores}
+    fe_score = program._fe_margin_score(data, state.fe_coefficients)
+
+    def offsets_excluding(skip=None):
+        total = base_offsets
+        for k, v in scores.items():
+            if k != skip:
+                total = total + v
+        return total
+
+    # fixed effect: Hessian at the final coefficients with every other
+    # coordinate's score as residual offset
+    fe_offsets = offsets_excluding()
+    if fe_sparse is not None:
+        fe_batch = fe_sparse.replace(offsets=fe_offsets)
+        fe_objective = program._fe_sparse_objective
+    else:
+        fe_batch = LabeledPointBatch(
+            features=data["features"][program.fe.feature_shard_id],
+            labels=labels, offsets=fe_offsets, weights=weights,
+        )
+        fe_objective = program._fe_objective
+    fe_variances = fe_objective.normalization.variances_to_model_space(
+        coefficient_variances(
+            fe_objective, state.fe_coefficients, fe_batch, mode=variance_mode
+        )
+    )
+
+    re_variances: dict[str, Array] = {}
+    for spec in program.re_specs:
+        ds = re_datasets[spec.re_type]
+        objective = program._re_objectives[spec.re_type]
+        table = state.re_tables[spec.re_type]
+        full_offsets = offsets_excluding(skip=spec.re_type) + fe_score
+        max_bucket = max((b.entity_rows.shape[0] for b in ds.buckets), default=1)
+        resolved = resolve_variance_mode(variance_mode, ds.dim,
+                                         num_problems=max_bucket)
+        kernel = (
+            _jitted_re_bucket_variances if resolved == "full"
+            else _jitted_re_bucket_variances_diagonal
+        )
+        var_table = jnp.full_like(table, jnp.nan)
+        for b in ds.buckets:
+            var_table = kernel(
+                objective, b.features, b.labels, b.weights,
+                b.sample_rows, b.entity_rows, full_offsets, table, var_table,
+            )
+        re_variances[spec.re_type] = (
+            objective.normalization.variances_to_model_space(var_table)
+        )
+    return fe_variances, re_variances
+
+
 def state_to_game_model(
     program: GameTrainProgram,
     state: GameTrainState,
     dataset: GameDataset,
     *,
     intercept_index: int | None = None,
+    compute_variance: bool = False,
+    variance_mode: str = "auto",
+    re_datasets: Mapping[str, RandomEffectDataset] | None = None,
 ):
     """Convert a fused-step ``GameTrainState`` into a ``GameModel`` so
     multi-chip-trained models flow into the standard persistence/scoring
@@ -674,6 +818,10 @@ def state_to_game_model(
     coordinates after their RE type; MF coordinates after their spec name.
     The FE vector is converted back to original feature space (warm starts
     live in normalized space inside the step).
+
+    compute_variance=True attaches post-hoc diag(H⁻¹)-style variances from
+    :func:`compute_state_variances` (pass ``re_datasets`` for RE
+    coordinates).
     """
     from photon_ml_tpu.models.coefficients import Coefficients
     from photon_ml_tpu.models.game import (
@@ -686,11 +834,18 @@ def state_to_game_model(
         MatrixFactorizationModel,
     )
 
+    fe_variances = None
+    re_variances: dict[str, Array] = {}
+    if compute_variance:
+        fe_variances, re_variances = compute_state_variances(
+            program, state, dataset, re_datasets, variance_mode=variance_mode
+        )
+
     models: dict[str, object] = {}
     fe_means = program.fe_coefficients_model_space(state, intercept_index)
     models[program.fe.feature_shard_id] = FixedEffectModel(
         glm=GeneralizedLinearModel(
-            Coefficients(means=fe_means), program.task
+            Coefficients(means=fe_means, variances=fe_variances), program.task
         ),
         feature_shard_id=program.fe.feature_shard_id,
     )
@@ -705,6 +860,7 @@ def state_to_game_model(
             random_effect_type=spec.re_type,
             feature_shard_id=spec.feature_shard_id,
             task=program.task,
+            variances=re_variances.get(spec.re_type),
         )
     for m in program.mf_specs:
         models[m.name] = MatrixFactorizationModel(

@@ -671,3 +671,76 @@ def test_fused_step_compile_time_budget(rng):
     assert np.isfinite(float(loss))
     # generous CI budget: the failure mode being guarded is minutes/hours
     assert compile_wall < 240.0, f"fused step compiled in {compile_wall:.0f}s"
+
+
+class TestFusedStateVariances:
+    def test_fe_only_variances_match_closed_form(self, rng):
+        from photon_ml_tpu.parallel.distributed import state_to_game_model
+
+        n, d, l2 = 200, 6, 2.0
+        x = rng.normal(size=(n, d))
+        y = x @ rng.normal(size=d) + rng.normal(scale=0.1, size=n)
+        ds = build_game_dataset(labels=y, feature_shards={"g": x},
+                                dtype=np.float64)
+        opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS,
+                              max_iterations=50)
+        program = GameTrainProgram(
+            TaskType.LINEAR_REGRESSION,
+            FixedEffectStepSpec("g", opt, l2_weight=l2),
+        )
+        state, _ = train_distributed(program, ds, {}, num_iterations=1)
+        model = state_to_game_model(program, state, ds, compute_variance=True)
+        got = np.asarray(model.models["g"].glm.coefficients.variances)
+        h = x.T @ x + l2 * np.eye(d)
+        np.testing.assert_allclose(got, np.diag(np.linalg.inv(h)), rtol=1e-6)
+
+    def test_re_variances_match_closed_form_with_fe_residuals(self, rng):
+        from photon_ml_tpu.parallel.distributed import state_to_game_model
+
+        n, d_fe, d_re, l2 = 240, 5, 3, 1.5
+        users = np.array([f"u{i}" for i in rng.integers(0, 6, size=n)])
+        x_fe = rng.normal(size=(n, d_fe))
+        x_re = rng.normal(size=(n, d_re))
+        y = x_fe.sum(axis=1) + rng.normal(scale=0.2, size=n)
+        ds = build_game_dataset(
+            labels=y, feature_shards={"g": x_fe, "e": x_re},
+            entity_keys={"user": users}, dtype=np.float64,
+        )
+        re_ds = {"user": build_random_effect_dataset(ds, "user", "e",
+                                                     bucket_sizes=(n,))}
+        opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS,
+                              max_iterations=30)
+        program = GameTrainProgram(
+            TaskType.LINEAR_REGRESSION,
+            FixedEffectStepSpec("g", opt, l2_weight=0.5),
+            (RandomEffectStepSpec("user", "e", opt, l2_weight=l2),),
+        )
+        state, _ = train_distributed(program, ds, re_ds, num_iterations=1)
+        model = state_to_game_model(
+            program, state, ds, compute_variance=True, re_datasets=re_ds
+        )
+        re_model = model.models["user"]
+        assert re_model.variances is not None
+        # per-entity closed form: squared loss -> H_e = X_eᵀX_e + λI,
+        # independent of the residual offsets (d2 = 1); variances must match
+        keys = list(np.asarray(re_model.entity_keys))
+        for row, key in enumerate(keys):
+            xe = x_re[users == key]
+            h = xe.T @ xe + l2 * np.eye(d_re)
+            np.testing.assert_allclose(
+                np.asarray(re_model.variances)[row],
+                np.diag(np.linalg.inv(h)),
+                rtol=1e-5, err_msg=str(key),
+            )
+        # FE variances attached too
+        assert model.models["g"].glm.coefficients.variances is not None
+
+    def test_variances_require_re_datasets(self, rng):
+        from photon_ml_tpu.parallel.distributed import state_to_game_model
+
+        dataset, re_datasets = _toy_game_data(rng)
+        program = _program()
+        state, _ = train_distributed(program, dataset, re_datasets,
+                                     num_iterations=1)
+        with pytest.raises(ValueError, match="re_datasets"):
+            state_to_game_model(program, state, dataset, compute_variance=True)
