@@ -110,6 +110,14 @@ class GameTrainingParams:
     resume: bool = True
     #: jax.profiler trace output dir (TensorBoard); empty = disabled
     profile_dir: str | None = None
+    #: train through the fused mesh-sharded SPMD program
+    #: (parallel/distributed.py) instead of the host-loop CD path — the
+    #: cluster-scale mode of the reference driver
+    #: (GameTrainingDriver.scala:822-843). ``mesh_shape`` lays the devices
+    #: out as {"data": N, "model": M}; empty with distributed=True means all
+    #: devices on "data".
+    distributed: bool = False
+    mesh_shape: dict[str, int] | None = None
 
     def validate(self) -> None:
         """Cross-parameter checks (reference validateParams:196-298)."""
@@ -293,6 +301,23 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
         if isinstance(imap, IndexMap):
             imap.save(os.path.join(out, "index-maps"), shard_id)
 
+    mesh = None
+    model_axis = 1
+    if params.distributed or params.mesh_shape:
+        # the multi-chip entry point: one ("data", "model") mesh over all
+        # (possibly multi-process) devices, topology-aware across slices
+        from photon_ml_tpu.parallel.multihost import make_hybrid_mesh
+
+        shape = dict(params.mesh_shape or {})
+        model_axis = int(shape.get("model", 1))
+        mesh = make_hybrid_mesh(
+            data=shape.get("data"), model=model_axis
+        )
+        job_log.info(
+            "distributed mode: mesh %s over %d devices",
+            dict(zip(mesh.axis_names, mesh.devices.shape)), mesh.devices.size,
+        )
+
     def make_estimator(reg_weights, checkpointer=None) -> GameEstimator:
         return GameEstimator(
             task=params.task_type,
@@ -308,6 +333,8 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
             checkpointer=checkpointer,
             checkpoint_every=params.checkpoint_every,
             resume=params.resume,
+            mesh=mesh,
+            fe_feature_sharded=model_axis > 1,
         )
 
     def make_checkpointer(config_index: int, reg_weights):
@@ -362,6 +389,7 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
             )
 
     summary: dict = {
+        "distributed": mesh is not None,
         "num_configurations": len(grid),
         # effective configs in re-runnable CLI form (reference ScoptParameter
         # print-round-trip)
@@ -527,6 +555,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="ignore existing checkpoints (fresh run)")
     p.add_argument("--profile-dir",
                    help="write a jax.profiler (TensorBoard) trace here")
+    p.add_argument("--distributed", action="store_true",
+                   help="train through the fused mesh-sharded SPMD program "
+                        "over all devices (multi-chip/multi-host path)")
+    p.add_argument("--mesh", default="",
+                   help="device mesh layout 'data=8,model=1' (implies "
+                        "--distributed; model>1 shards the fixed-effect "
+                        "feature axis)")
     return p
 
 
@@ -574,7 +609,32 @@ def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
         checkpoint_every=args.checkpoint_every,
         resume=not args.no_resume,
         profile_dir=args.profile_dir,
+        distributed=args.distributed or bool(args.mesh),
+        mesh_shape=_parse_mesh_shape(args.mesh),
     )
+
+
+def _parse_mesh_shape(spec: str) -> dict[str, int] | None:
+    """'data=8,model=1' -> {"data": 8, "model": 1}; '' -> None."""
+    if not spec:
+        return None
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        key, _, value = part.partition("=")
+        key = key.strip()
+        if (
+            key not in ("data", "model")
+            or not value.strip().isdigit()
+            or int(value) < 1
+        ):
+            raise ValueError(
+                f"bad --mesh component {part!r}; expected data=N,model=M "
+                "with N,M >= 1"
+            )
+        out[key] = int(value)
+    return out
 
 
 def main(argv: Sequence[str] | None = None) -> dict:

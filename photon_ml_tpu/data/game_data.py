@@ -120,6 +120,83 @@ class GameDataset:
         )
 
 
+def pad_game_dataset(dataset: GameDataset, multiple: int) -> tuple[GameDataset, int]:
+    """Pad the sample axis with zero-weight rows to a multiple of ``multiple``.
+
+    Mesh sharding wants the sample axis divisible by the mesh "data" axis
+    (parallel/mesh.py). Padding rows carry weight 0 (they contribute nothing
+    to any weighted aggregate), entity index -1 (scored as 0 by
+    score_random_effect), offset/label 0, zero feature rows, and fresh
+    negative unique ids (so stable-id hashing never collides with real
+    rows). Sparse shards pad by bumping ``num_samples`` only — no new
+    entries. Entity buckets built from the unpadded dataset stay valid:
+    their ``sample_rows`` indices are unchanged by appending rows.
+
+    Returns (padded dataset, original sample count); the original object is
+    returned untouched when already divisible.
+    """
+    n = dataset.num_samples
+    pad = (-n) % max(1, int(multiple))
+    if pad == 0:
+        return dataset, n
+
+    def padded_vec(name: str) -> tuple[np.ndarray, Array]:
+        arr = dataset.host_array(name)
+        out = np.concatenate([arr, np.zeros(pad, dtype=arr.dtype)])
+        return out, jnp.asarray(out)
+
+    labels_h, labels_d = padded_vec("labels")
+    offsets_h, offsets_d = padded_vec("offsets")
+    # weights pad with zeros — the whole point
+    weights_h, weights_d = padded_vec("weights")
+
+    shards: dict[str, object] = {}
+    host_cache = {"labels": labels_h, "offsets": offsets_h, "weights": weights_h}
+    for k, v in dataset.feature_shards.items():
+        if isinstance(v, SparseShard):
+            shards[k] = dataclasses.replace(
+                v, num_samples=v.num_samples + pad, _device=None
+            )
+        else:
+            arr = np.asarray(v)
+            arr = np.concatenate(
+                [arr, np.zeros((pad, arr.shape[1]), dtype=arr.dtype)]
+            )
+            shards[k] = jnp.asarray(arr)
+            host_cache[f"shard/{k}"] = arr
+
+    entity_idx: dict[str, Array] = {}
+    for t, idx in dataset.entity_idx.items():
+        arr = np.concatenate(
+            [np.asarray(idx), np.full(pad, -1, dtype=np.int32)]
+        ).astype(np.int32)
+        entity_idx[t] = jnp.asarray(arr)
+        host_cache[f"entity_idx/{t}"] = arr
+
+    ids = {
+        k: np.concatenate([np.asarray(v), np.zeros(pad, np.asarray(v).dtype)])
+        for k, v in dataset.ids.items()
+    }
+    unique_ids = np.concatenate(
+        [np.asarray(dataset.unique_ids),
+         -(np.arange(pad, dtype=np.int64) + 1 + np.abs(dataset.unique_ids).max(initial=0))]
+    )
+    return (
+        dataclasses.replace(
+            dataset,
+            unique_ids=unique_ids,
+            labels=labels_d,
+            offsets=offsets_d,
+            weights=weights_d,
+            feature_shards=shards,
+            entity_idx=entity_idx,
+            ids=ids,
+            host_cache=host_cache,
+        ),
+        n,
+    )
+
+
 @dataclasses.dataclass
 class EntityBucket:
     """One size-bucket of random-effect training data.

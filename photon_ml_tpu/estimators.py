@@ -57,7 +57,7 @@ from photon_ml_tpu.ops.normalization import (
     NormalizationType,
     build_normalization,
 )
-from photon_ml_tpu.data.sparse_batch import SparseLabeledPointBatch
+from photon_ml_tpu.data.sparse_batch import SparseLabeledPointBatch, SparseShard
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
 from photon_ml_tpu.ops.variance import (
@@ -148,6 +148,17 @@ class GameEstimator:
     resume: bool = True
     #: raise DivergenceError on non-finite coordinate updates
     check_finite: bool = True
+    #: jax.sharding.Mesh ("data", "model") — when set, fit() trains through
+    #: the fused mesh-sharded SPMD program (parallel/distributed.py) instead
+    #: of the host-loop CD path: one jitted step per sweep spanning every
+    #: coordinate, collectives inserted by XLA. This is the cluster-scale
+    #: path of the reference (GameTrainingDriver.scala:822-843 →
+    #: GameEstimator.fit over Spark executors), reachable from the same
+    #: estimator facade.
+    mesh: object | None = None
+    #: shard the FE coordinate's feature axis over the mesh "model" axis
+    #: (giant-d coordinates; requires mesh)
+    fe_feature_sharded: bool = False
 
     def fit(
         self,
@@ -155,6 +166,8 @@ class GameEstimator:
         validation_dataset: GameDataset | None = None,
         initial_model: GameModel | None = None,
     ) -> CoordinateDescentResult:
+        if self.mesh is not None:
+            return self._fit_distributed(dataset, validation_dataset, initial_model)
         sequence = list(self.update_sequence or self.coordinate_configs.keys())
 
         norms = self._prepare_normalization(dataset)
@@ -259,6 +272,360 @@ class GameEstimator:
             checkpoint_every=self.checkpoint_every,
             resume=self.resume,
             check_finite=self.check_finite,
+        )
+
+    def _fit_distributed(
+        self,
+        dataset: GameDataset,
+        validation_dataset: GameDataset | None = None,
+        initial_model: GameModel | None = None,
+    ) -> CoordinateDescentResult:
+        """fit() over the fused mesh-sharded SPMD program.
+
+        One jitted step per sweep covers the full coordinate sequence
+        (FE → REs → MFs, the fused step's fixed internal order), with
+        per-sweep validation scoring and best-model tracking — the
+        distributed analogue of run_coordinate_descent. Returns the same
+        CoordinateDescentResult shape, so drivers/tuners work unchanged.
+
+        Differences from the CD path, by design:
+        - coordinate update order inside a sweep is FE → REs → MFs
+          regardless of ``update_sequence`` (the sequence still selects
+          WHICH coordinates train);
+        - exactly one trainable fixed-effect coordinate is required;
+        - locked coordinates contribute fixed score offsets (their models
+          pass through to the output untouched);
+        - when any coordinate requests variances, post-hoc variances are
+          computed for ALL coordinates at the final (and best) state.
+        """
+        from photon_ml_tpu.algorithm.coordinates import (
+            ModelCoordinate,
+            _solve_config,
+        )
+        from photon_ml_tpu.io.checkpoint import DivergenceError
+        from photon_ml_tpu.parallel.distributed import (
+            FixedEffectStepSpec,
+            GameTrainProgram,
+            MatrixFactorizationStepSpec,
+            RandomEffectStepSpec,
+            game_model_to_state,
+            state_to_game_model,
+            train_distributed,
+        )
+
+        sequence = list(self.update_sequence or self.coordinate_configs.keys())
+        locked = set(self.locked_coordinates)
+        if locked and initial_model is None:
+            raise ValueError(
+                "locked coordinates require an initial model "
+                "(partial retraining needs a pre-trained model)"
+            )
+
+        fe_ids = [
+            cid for cid in sequence
+            if cid not in locked
+            and isinstance(self.coordinate_configs[cid], FixedEffectCoordinateConfig)
+        ]
+        if len(fe_ids) > 1:
+            raise ValueError(
+                "distributed (mesh) training supports at most one trainable "
+                f"fixed-effect coordinate; got {fe_ids}. Train through the "
+                "coordinate-descent path (mesh=None) for multi-FE layouts."
+            )
+        if fe_ids:
+            fe_cid = fe_ids[0]
+            fe_cfg: FixedEffectCoordinateConfig = self.coordinate_configs[fe_cid]
+            fe_shard = fe_cfg.feature_shard_id
+        else:
+            # RE/MF-only (or locked-FE) layout: the fused step always carries
+            # an FE coordinate, so synthesize a zero-width one — the d=0
+            # solve is a no-op and its (empty) model is dropped on output
+            fe_cid = None
+            fe_shard = "__no_fe__"
+            while fe_shard in dataset.feature_shards:
+                fe_shard = "_" + fe_shard
+            fe_cfg = FixedEffectCoordinateConfig(
+                fe_shard,
+                CoordinateOptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=1)
+                ),
+            )
+            def with_empty_shard(ds):
+                empty = jnp.zeros((ds.num_samples, 0), dtype=np.asarray(ds.labels).dtype)
+                return dataclasses.replace(
+                    ds, feature_shards={**ds.feature_shards, fe_shard: empty}
+                )
+            dataset = with_empty_shard(dataset)
+            if validation_dataset is not None:
+                validation_dataset = with_empty_shard(validation_dataset)
+        fe_intercept = self.intercept_indices.get(fe_shard)
+
+        # feature-axis ("model") sharding wants the FE dim divisible by the
+        # mesh model axis: right-pad with zero columns (their coefficients
+        # stay exactly 0 — zero data column + L2 — and are sliced off again
+        # on output)
+        fe_pad = 0
+        if self.fe_feature_sharded and fe_cid is not None:
+            model_axis = int(self.mesh.shape["model"])
+            fe_dim = int(dataset.feature_shards[fe_shard].shape[1])
+            fe_pad = (-fe_dim) % model_axis
+        if fe_pad:
+            def with_padded_fe(ds):
+                shard = ds.feature_shards[fe_shard]
+                host_cache = dict(ds.host_cache)
+                if isinstance(shard, SparseShard):
+                    shard = dataclasses.replace(
+                        shard, feature_dim=shard.feature_dim + fe_pad,
+                        _device=None,
+                    )
+                else:
+                    arr = np.asarray(shard)
+                    arr = np.concatenate(
+                        [arr, np.zeros((arr.shape[0], fe_pad), arr.dtype)],
+                        axis=1,
+                    )
+                    host_cache[f"shard/{fe_shard}"] = arr
+                    shard = jnp.asarray(arr)
+                return dataclasses.replace(
+                    ds,
+                    feature_shards={**ds.feature_shards, fe_shard: shard},
+                    host_cache=host_cache,
+                )
+            dataset = with_padded_fe(dataset)
+            if validation_dataset is not None:
+                validation_dataset = with_padded_fe(validation_dataset)
+        norms = self._prepare_normalization(dataset)
+
+        re_specs: list[RandomEffectStepSpec] = []
+        re_datasets = {}
+        re_cid_of_type: dict[str, str] = {}
+        mf_specs: list[MatrixFactorizationStepSpec] = []
+        mf_datasets = {}
+        re_normalizations: dict[str, NormalizationContext] = {}
+        for cid in sequence:
+            if cid in locked or cid == fe_cid:
+                continue
+            cfg = self.coordinate_configs[cid]
+            if isinstance(cfg, FixedEffectCoordinateConfig):
+                raise AssertionError("unreachable: multiple FE checked above")
+            if isinstance(cfg, MatrixFactorizationCoordinateConfig):
+                mf_datasets[cid] = build_mf_dataset(
+                    dataset, cfg.row_effect_type, cfg.col_effect_type,
+                    active_data_upper_bound=cfg.active_data_upper_bound,
+                    seed=cfg.seed,
+                )
+                mf_specs.append(MatrixFactorizationStepSpec(
+                    name=cid,
+                    row_effect_type=cfg.row_effect_type,
+                    col_effect_type=cfg.col_effect_type,
+                    num_latent_factors=cfg.num_latent_factors,
+                    optimizer=_solve_config(cfg.optimization),
+                    l2_weight=cfg.optimization.l2_weight,
+                    num_alternations=cfg.num_alternations,
+                    seed=cfg.seed,
+                ))
+                continue
+            re_type = cfg.random_effect_type
+            if re_type in re_cid_of_type:
+                raise ValueError(
+                    f"distributed training: coordinates "
+                    f"'{re_cid_of_type[re_type]}' and '{cid}' share random "
+                    f"effect type '{re_type}' — the fused step keys its "
+                    "coefficient tables by RE type; merge or rename"
+                )
+            re_cid_of_type[re_type] = cid
+            re_datasets[re_type] = build_random_effect_dataset(
+                dataset, re_type, cfg.feature_shard_id,
+                active_data_upper_bound=cfg.active_data_upper_bound,
+                active_data_lower_bound=cfg.active_data_lower_bound,
+                projector_type=cfg.projector_type,
+                projected_dim=cfg.projected_dim,
+                features_to_samples_ratio=cfg.features_to_samples_ratio,
+            )
+            norm = norms.get(cfg.feature_shard_id)
+            if norm is not None:
+                re_normalizations[re_type] = norm
+            re_specs.append(RandomEffectStepSpec(
+                re_type=re_type,
+                feature_shard_id=cfg.feature_shard_id,
+                optimizer=_solve_config(cfg.optimization),
+                l2_weight=cfg.optimization.l2_weight,
+                projector=cfg.projector_type,
+            ))
+
+        program = GameTrainProgram(
+            self.task,
+            FixedEffectStepSpec(
+                feature_shard_id=fe_shard,
+                optimizer=_solve_config(fe_cfg.optimization),
+                l2_weight=fe_cfg.optimization.l2_weight,
+                down_sampling_rate=fe_cfg.optimization.down_sampling_rate,
+            ),
+            tuple(re_specs),
+            mf_specs=tuple(mf_specs),
+            normalization=norms.get(fe_shard),
+            re_normalizations=re_normalizations,
+        )
+
+        # locked coordinates: fixed residual offsets + pass-through models
+        # (reference ModelCoordinate semantics inside one fused program)
+        locked_models: dict[str, object] = {}
+        train_ds, val_ds = dataset, validation_dataset
+        if locked:
+            def locked_total(ds) -> jnp.ndarray:
+                total = jnp.zeros_like(ds.offsets)
+                for cid in sequence:
+                    if cid not in locked:
+                        continue
+                    m = initial_model.get(cid)
+                    locked_models[cid] = m
+                    total = total + ModelCoordinate(cid, ds, m).score(m)
+                return total
+
+            def with_extra_offsets(ds, extra):
+                new_off = ds.offsets + extra
+                return dataclasses.replace(
+                    ds, offsets=new_off,
+                    host_cache={**ds.host_cache, "offsets": np.asarray(new_off)},
+                )
+
+            train_ds = with_extra_offsets(dataset, locked_total(dataset))
+            if validation_dataset is not None:
+                val_ds = with_extra_offsets(
+                    validation_dataset, locked_total(validation_dataset)
+                )
+
+        warm_state = None
+        if initial_model is not None:
+            # The estimator's GameModel keys are coordinate ids; the program
+            # keys the FE by feature shard and REs by effect type. Re-key
+            # before conversion — a mismatch here would silently cold-start
+            # every coordinate (missing_ok is for genuinely absent ones).
+            program_key: dict[str, str] = {}
+            if fe_cid is not None:
+                program_key[fe_cid] = fe_shard
+            program_key.update({cid: t for t, cid in re_cid_of_type.items()})
+            remapped = {
+                program_key.get(cid, cid): m
+                for cid, m in initial_model.models.items()
+            }
+            if fe_pad and fe_shard in remapped:
+                from photon_ml_tpu.models.game import FixedEffectModel
+
+                means = np.asarray(remapped[fe_shard].glm.coefficients.means)
+                means = np.concatenate([means, np.zeros(fe_pad, means.dtype)])
+                remapped[fe_shard] = FixedEffectModel(
+                    glm=GeneralizedLinearModel(
+                        Coefficients(means=jnp.asarray(means)), self.task
+                    ),
+                    feature_shard_id=fe_shard,
+                )
+            warm_state = game_model_to_state(
+                program, GameModel(models=remapped), train_ds,
+                intercept_index=fe_intercept, missing_ok=True,
+                re_datasets=re_datasets, mf_datasets=mf_datasets,
+            )
+
+        evaluators: list[Evaluator] = [
+            parse_evaluator(s) for s in self.validation_evaluators
+        ]
+        train_eval_data = EvaluationData(
+            labels=np.asarray(dataset.host_array("labels")),
+            offsets=np.asarray(dataset.host_array("offsets")),
+            weights=np.asarray(dataset.host_array("weights")),
+            ids=dataset.ids,
+        )
+        val_eval_data = None
+        if validation_dataset is not None and evaluators:
+            val_eval_data = EvaluationData(
+                labels=np.asarray(validation_dataset.host_array("labels")),
+                offsets=np.asarray(validation_dataset.host_array("offsets")),
+                weights=np.asarray(validation_dataset.host_array("weights")),
+                ids=validation_dataset.ids,
+            )
+
+        result = train_distributed(
+            program,
+            train_ds,
+            re_datasets,
+            mf_datasets=mf_datasets,
+            mesh=self.mesh,
+            num_iterations=self.num_iterations,
+            fe_feature_sharded=self.fe_feature_sharded,
+            state=warm_state,
+            checkpointer=self.checkpointer,
+            checkpoint_every=self.checkpoint_every,
+            resume=self.resume,
+            validation_dataset=val_ds if val_eval_data is not None else None,
+            validation_evaluators=evaluators,
+            validation_eval_data=val_eval_data,
+            training_evaluator=default_evaluator_for_task(self.task),
+            training_eval_data=train_eval_data,
+        )
+        if self.check_finite and not all(np.isfinite(result.losses)):
+            raise DivergenceError(
+                f"distributed training produced non-finite sweep losses: "
+                f"{result.losses}"
+            )
+
+        trainable_cids = {} if fe_cid is None else {fe_shard: fe_cid}
+        trainable_cids.update(
+            {t: cid for t, cid in re_cid_of_type.items()}
+        )
+
+        compute_var = any(
+            self.coordinate_configs[cid].optimization.compute_variance
+            for cid in sequence if cid not in locked
+        )
+
+        def to_game_model(state) -> GameModel:
+            m = state_to_game_model(
+                program, state, train_ds,
+                intercept_index=fe_intercept,
+                compute_variance=compute_var,
+                variance_mode=fe_cfg.optimization.variance_mode,
+                re_datasets=re_datasets,
+            )
+            models_by_name = dict(m.models)
+            if fe_pad:
+                # slice the zero coefficients of the model-axis padding
+                # columns back off (persisted models keep the true dim)
+                from photon_ml_tpu.models.game import FixedEffectModel
+
+                c = models_by_name[fe_shard].glm.coefficients
+                models_by_name[fe_shard] = FixedEffectModel(
+                    glm=GeneralizedLinearModel(
+                        Coefficients(
+                            means=c.means[:-fe_pad],
+                            variances=None if c.variances is None
+                            else c.variances[:-fe_pad],
+                        ),
+                        self.task,
+                    ),
+                    feature_shard_id=fe_shard,
+                )
+            # re-key from the program's internal names (FE: feature shard
+            # id; RE: effect type; MF: coordinate id) to coordinate ids,
+            # preserving the update-sequence order — the CD path's contract
+            renamed = {
+                trainable_cids.get(k, k): v for k, v in models_by_name.items()
+                if not (fe_cid is None and k == fe_shard)  # synthetic FE
+            }
+            renamed.update(locked_models)
+            return GameModel(models={
+                cid: renamed[cid] for cid in sequence if cid in renamed
+            })
+
+        final_model = to_game_model(result.state)
+        best_model = (
+            to_game_model(result.best_state)
+            if result.best_state is not None else final_model
+        )
+        return CoordinateDescentResult(
+            model=final_model,
+            best_model=best_model,
+            best_metric=result.best_metric,
+            metric_history=result.metric_history,
         )
 
     def _prepare_normalization(self, dataset: GameDataset) -> dict[str, NormalizationContext]:

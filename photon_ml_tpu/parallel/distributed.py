@@ -97,9 +97,19 @@ class RandomEffectStepSpec:
 
 @dataclasses.dataclass(frozen=True)
 class FixedEffectStepSpec:
+    """Static description of the fixed-effect coordinate.
+
+    down_sampling_rate < 1 trains the FE solve on down-sampled weights
+    (reference DistributedOptimizationProblem.runWithSampling:145-160):
+    ``train_distributed`` computes a per-sweep stable-id multiplier with the
+    same splitmix64 sampler the CD path uses and feeds it into the step as
+    ``data["fe_weight_multiplier"]``; scoring and the training loss still
+    cover every sample."""
+
     feature_shard_id: str
     optimizer: OptimizerConfig
     l2_weight: float = 0.0
+    down_sampling_rate: float = 1.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -297,6 +307,7 @@ class GameTrainProgram:
             for m in self.mf_specs
         }
         self._step = jax.jit(self._step_impl)
+        self._score = jax.jit(self._score_impl)
 
     def fe_coefficients_model_space(self, state: GameTrainState,
                                     intercept_index: int | None = None) -> Array:
@@ -373,17 +384,13 @@ class GameTrainProgram:
         }
         return data, buckets
 
-    def shard_inputs(self, mesh: Mesh, data, buckets, state,
-                     *, fe_feature_sharded: bool = False, put_fn=None):
-        """Lay out inputs over the mesh: samples and entities over "data",
-        FE features (and coefficient vector) over "model" when requested.
-
-        put_fn: placement function (array, sharding) -> Array. Defaults to
-        jax.device_put; pass parallel.multihost.global_put when the mesh
-        spans multiple processes (each feeds its addressable shards)."""
+    def _shard_data(self, mesh: Mesh, data, *, fe_feature_sharded: bool = False,
+                    put_fn=None):
+        """Lay a data pytree (training or scoring) out over the mesh:
+        sample-axis arrays over "data", the FE feature axis over "model"
+        when requested."""
         put = put_fn if put_fn is not None else jax.device_put
         vec = NamedSharding(mesh, P("data"))
-        rep = NamedSharding(mesh, P())
         data_axis = int(mesh.shape["data"])
         fe_fspec = P("data", "model") if fe_feature_sharded else P("data", None)
 
@@ -419,6 +426,22 @@ class GameTrainProgram:
                     cols_sorted=put(sb.cols_sorted, vec),
                 )
             data["fe_sparse_batch"] = sb
+        return data
+
+    def shard_inputs(self, mesh: Mesh, data, buckets, state,
+                     *, fe_feature_sharded: bool = False, put_fn=None):
+        """Lay out inputs over the mesh: samples and entities over "data",
+        FE features (and coefficient vector) over "model" when requested.
+
+        put_fn: placement function (array, sharding) -> Array. Defaults to
+        jax.device_put; pass parallel.multihost.global_put when the mesh
+        spans multiple processes (each feeds its addressable shards)."""
+        put = put_fn if put_fn is not None else jax.device_put
+        rep = NamedSharding(mesh, P())
+        data_axis = int(mesh.shape["data"])
+        data = self._shard_data(
+            mesh, data, fe_feature_sharded=fe_feature_sharded, put_fn=put_fn
+        )
 
         ent3 = NamedSharding(mesh, P("data", None, None))
         ent2 = NamedSharding(mesh, P("data", None))
@@ -505,6 +528,39 @@ class GameTrainProgram:
         """One full CD sweep. Returns (new_state, training_loss)."""
         return self._step(data, buckets, state)
 
+    # -- whole-model scoring (validation / best-model tracking) --------------
+
+    def prepare_scoring_inputs(self, dataset: GameDataset) -> dict:
+        """Data pytree for :meth:`score` over an arbitrary dataset (e.g. the
+        validation split) — same layout the training step consumes, no
+        entity buckets needed."""
+        return _data_pytree(
+            dataset, self.re_specs, self.fe.feature_shard_id, self.mf_specs
+        )
+
+    def shard_scoring_inputs(self, mesh: Mesh, data, *,
+                             fe_feature_sharded: bool = False, put_fn=None):
+        return self._shard_data(
+            mesh, data, fe_feature_sharded=fe_feature_sharded, put_fn=put_fn
+        )
+
+    def score(self, data, state: GameTrainState) -> Array:
+        """[n] total model scores (margins INCLUDING the data offsets) at
+        ``state`` — the validation-scoring analogue of the reference's
+        per-update ``GameModel.scoreAndValidate``
+        (CoordinateDescent.scala:291-356), as one jitted SPMD program over
+        the same mesh shardings as the training step."""
+        return self._score(data, state)
+
+    def _score_impl(self, data, state: GameTrainState) -> Array:
+        re_scores, mf_scores = self._state_scores(data, state)
+        total = data["offsets"] + self._fe_margin_score(data, state.fe_coefficients)
+        for v in re_scores.values():
+            total = total + v
+        for v in mf_scores.values():
+            total = total + v
+        return total
+
     # -- scoring helpers shared by the step and the post-hoc variance path --
 
     def _re_coordinate_score(self, data, k: str, table: Array,
@@ -575,15 +631,22 @@ class GameTrainProgram:
             return total
 
         # ---- fixed-effect coordinate (samples sharded; grads psum over mesh)
+        # optional down-sampling: train the FE solve on multiplied weights
+        # (0 = dropped, 1/rate = kept negative); every other use of
+        # ``weights`` — RE solves, the training loss — stays full-sample
+        fe_mult = data.get("fe_weight_multiplier")
+        fe_weights = weights if fe_mult is None else weights * fe_mult
         if fe_sparse is not None:
-            fe_batch = fe_sparse.replace(offsets=base_offsets + sum_scores())
+            fe_batch = fe_sparse.replace(
+                offsets=base_offsets + sum_scores(), weights=fe_weights
+            )
             fe_objective = self._fe_sparse_objective
         else:
             fe_batch = LabeledPointBatch(
                 features=fe_x,
                 labels=labels,
                 offsets=base_offsets + sum_scores(),
-                weights=weights,
+                weights=fe_weights,
             )
             fe_objective = self._fe_objective
         fe_result = solve(
@@ -881,6 +944,9 @@ def game_model_to_state(
     dataset: GameDataset,
     *,
     intercept_index: int | None = None,
+    missing_ok: bool = False,
+    re_datasets: Mapping[str, RandomEffectDataset] | None = None,
+    mf_datasets: Mapping[str, "MFDataset"] | None = None,
 ) -> GameTrainState:
     """Inverse of :func:`state_to_game_model`: warm-start the fused step from
     a (possibly loaded-from-Avro) GameModel.
@@ -890,12 +956,31 @@ def game_model_to_state(
     another whose vocab ordering differs; entities absent from the model
     start at zero. The FE vector is converted into normalized space (the
     step's warm-start convention).
+
+    missing_ok=True cold-starts (zeros / fresh factors) any coordinate the
+    model lacks instead of raising — needed when a partial model warm-starts
+    a program with more coordinates (reference GameEstimator.getInitialModel
+    tolerates absent coordinates the same way). Requires ``re_datasets`` /
+    ``mf_datasets`` for the cold-started coordinates' table shapes.
     """
-    fe_model = model.get(program.fe.feature_shard_id)
+    def coordinate_model(cid: str):
+        try:
+            return model.get(cid)
+        except KeyError:
+            if missing_ok:
+                return None
+            raise
+
     norm = program._fe_objective.normalization
-    fe_w = norm.from_model_space(
-        jnp.asarray(fe_model.glm.coefficients.means), intercept_index
-    )
+    fe_model = coordinate_model(program.fe.feature_shard_id)
+    if fe_model is None:
+        fe_dim = dataset.feature_shards[program.fe.feature_shard_id].shape[1]
+        dtype = dataset.feature_shards[program.fe.feature_shard_id].dtype
+        fe_w = jnp.zeros((fe_dim,), dtype=dtype)
+    else:
+        fe_w = norm.from_model_space(
+            jnp.asarray(fe_model.glm.coefficients.means), intercept_index
+        )
 
     def align(table, model_keys, vocab, coordinate: str) -> Array:
         table = np.asarray(table)
@@ -922,7 +1007,19 @@ def game_model_to_state(
 
     re_tables = {}
     for spec in program.re_specs:
-        m = model.get(spec.re_type)
+        m = coordinate_model(spec.re_type)
+        if m is None:
+            ds = (re_datasets or {}).get(spec.re_type)
+            if ds is None:
+                raise ValueError(
+                    f"missing_ok warm start: coordinate '{spec.re_type}' is "
+                    "absent from the model AND re_datasets — cannot size the "
+                    "cold-start table"
+                )
+            re_tables[spec.re_type] = jnp.zeros(
+                (ds.num_entities, ds.dim), dtype=fe_w.dtype
+            )
+            continue
         aligned = align(
             m.coefficients, m.entity_keys,
             dataset.entity_vocabs[spec.re_type], spec.re_type,
@@ -931,7 +1028,29 @@ def game_model_to_state(
         re_tables[spec.re_type] = re_norm.from_model_space(aligned)
     mf_rows, mf_cols = {}, {}
     for spec in program.mf_specs:
-        m = model.get(spec.name)
+        m = coordinate_model(spec.name)
+        if m is None:
+            from photon_ml_tpu.models.matrix_factorization import init_factors
+
+            mf = (mf_datasets or {}).get(spec.name)
+            if mf is None:
+                raise ValueError(
+                    f"missing_ok warm start: MF coordinate '{spec.name}' is "
+                    "absent from the model AND mf_datasets — cannot size the "
+                    "cold-start factors"
+                )
+            row, col = init_factors(
+                mf.num_row_entities, mf.num_col_entities,
+                spec.num_latent_factors, seed=spec.seed, dtype=fe_w.dtype,
+            )
+            row_mask, col_mask = mf.trained_masks()
+            mf_rows[spec.name] = jnp.where(
+                jnp.asarray(row_mask)[:, None], row, 0.0
+            )
+            mf_cols[spec.name] = jnp.where(
+                jnp.asarray(col_mask)[:, None], col, 0.0
+            )
+            continue
         model_k = np.asarray(m.row_factors).shape[1]
         if model_k != spec.num_latent_factors:
             raise ValueError(
@@ -954,6 +1073,38 @@ def game_model_to_state(
     )
 
 
+@dataclasses.dataclass
+class DistributedTrainResult:
+    """Result of :func:`train_distributed`.
+
+    Iterates as ``(state, losses)`` for backward compatibility with the
+    2-tuple this function used to return. ``best_state``/``best_metric``/
+    ``metric_history`` are populated when validation evaluators were given
+    (reference CoordinateDescent best-model tracking, :183-192, :323-356);
+    otherwise ``best_state`` is None and callers should treat the final
+    state as best.
+    """
+
+    state: GameTrainState
+    losses: list[float]
+    best_state: GameTrainState | None = None
+    best_metric: float = float("nan")
+    metric_history: list[dict] = dataclasses.field(default_factory=list)
+
+    def __iter__(self):
+        return iter((self.state, self.losses))
+
+
+def _host_scores(scores: Array, n: int) -> np.ndarray:
+    """Gather a (possibly mesh-sharded, possibly multi-process) score vector
+    to the host and drop mesh-padding rows."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        scores = multihost_utils.process_allgather(scores, tiled=True)
+    return np.asarray(jax.device_get(scores))[:n]
+
+
 def train_distributed(
     program: GameTrainProgram,
     dataset: GameDataset,
@@ -968,7 +1119,13 @@ def train_distributed(
     checkpoint_every: int = 1,
     resume: bool = True,
     put_fn=None,
-):
+    validation_dataset: GameDataset | None = None,
+    validation_evaluators: Sequence = (),
+    validation_eval_data=None,
+    training_evaluator=None,
+    training_eval_data=None,
+    down_sampling_seed: int = 0,
+) -> DistributedTrainResult:
     """Run ``num_iterations`` fused CD sweeps, optionally mesh-sharded.
 
     put_fn: placement function forwarded to ``shard_inputs``. Defaults to
@@ -983,21 +1140,46 @@ def train_distributed(
     ``shard_inputs`` path, so a run checkpointed on one topology restores
     onto another (elastic recovery — absent in the reference, SURVEY.md §5).
 
-    Returns (final_state, [loss per sweep]).
+    Validation (reference CoordinateDescent.scala:183-192, 291-356): when
+    ``validation_dataset`` + ``validation_evaluators`` (+
+    ``validation_eval_data``, an evaluation.EvaluationData over the
+    *unpadded* validation split) are given, each sweep scores the validation
+    split through the program's jitted scoring program over the same mesh,
+    evaluates every evaluator host-side, and tracks the best state by the
+    FIRST evaluator's ``better_than`` direction. ``training_evaluator`` +
+    ``training_eval_data`` add a per-sweep ``train:<name>`` metric.
+
+    Datasets whose sample counts don't divide the mesh "data" axis are
+    padded with zero-weight rows automatically (pad_game_dataset).
+
+    Returns a :class:`DistributedTrainResult` (unpacks as
+    ``(final_state, losses)``).
     """
     start_sweep = 0
     prior_losses: list[float] = []
+    best_state: GameTrainState | None = None
+    best_metric = float("nan")
+    history: list[dict] = []
     # An explicit caller-supplied state takes precedence over resume: passing
     # both a warm start and a stale checkpoint must not silently ignore the
     # warm start.
     if checkpointer is not None and resume and state is None:
         ckpt = checkpointer.restore()
         if ckpt is not None:
-            def by_prefix(prefix):
+            if "fe_coefficients" not in ckpt.arrays:
+                # e.g. a CD-path checkpoint (model/... keys) in the same dir
+                raise ValueError(
+                    f"checkpoint at {checkpointer.directory} is not a "
+                    "distributed-training checkpoint (no 'fe_coefficients' "
+                    f"array; found keys like {sorted(ckpt.arrays)[:3]}). Pass "
+                    "resume=False or use a fresh checkpoint directory."
+                )
+            def by_prefix(prefix, arrays=None):
+                arrays = ckpt.arrays if arrays is None else arrays
                 return {
                     k[len(prefix):]: jnp.asarray(v)
-                    for k, v in ckpt.arrays.items()
-                    if k.startswith(prefix)
+                    for k, v in arrays.items()
+                    if k.startswith(prefix) and "/" not in k[len(prefix):]
                 }
             state = GameTrainState(
                 fe_coefficients=jnp.asarray(ckpt.arrays["fe_coefficients"]),
@@ -1022,12 +1204,70 @@ def train_distributed(
                     f"{found}, program expects {expected}. Pass resume=False "
                     "or use a fresh checkpoint directory."
                 )
+            if "best/fe_coefficients" in ckpt.arrays:
+                best_state = GameTrainState(
+                    fe_coefficients=jnp.asarray(ckpt.arrays["best/fe_coefficients"]),
+                    re_tables=by_prefix("best/re_tables/"),
+                    mf_rows=by_prefix("best/mf_rows/"),
+                    mf_cols=by_prefix("best/mf_cols/"),
+                )
+            best_metric = float(ckpt.meta.get("best_metric", float("nan")))
             start_sweep = min(int(ckpt.step), num_iterations)
             prior_losses = [float(x) for x in ckpt.meta.get("losses", [])][:start_sweep]
+            history = [
+                h for h in ckpt.meta.get("metric_history", [])
+                if int(h.get("iteration", 0)) < start_sweep
+            ]
+
+    n_train = dataset.num_samples
+    n_val = validation_dataset.num_samples if validation_dataset is not None else 0
+    if mesh is not None:
+        from photon_ml_tpu.data.game_data import pad_game_dataset
+
+        data_axis = int(mesh.shape["data"])
+        # buckets reference sample rows by index, which appending zero-weight
+        # rows leaves intact — pad AFTER the caller built re_datasets
+        dataset, n_train = pad_game_dataset(dataset, data_axis)
+        if validation_dataset is not None:
+            validation_dataset, n_val = pad_game_dataset(
+                validation_dataset, data_axis
+            )
 
     data, buckets = program.prepare_inputs(dataset, re_datasets, mf_datasets)
     if state is None:
         state = program.init_state(dataset, re_datasets, mf_datasets)
+
+    # per-sweep FE down-sampling multipliers (stable-id splitmix64, identical
+    # to the CD path's FixedEffectCoordinate seed rotation)
+    sampler = None
+    if program.fe.down_sampling_rate < 1.0:
+        from photon_ml_tpu.sampling import down_sampler_for_task
+
+        sampler = down_sampler_for_task(
+            program.task, program.fe.down_sampling_rate
+        )
+        samp_labels = dataset.host_array("labels")
+        samp_weights = dataset.host_array("weights")
+        samp_uids = np.asarray(dataset.unique_ids)
+        samp_dtype = np.asarray(samp_weights).dtype
+
+    def sweep_multiplier(sweep: int):
+        new_w = sampler.down_sample_weights(
+            samp_labels, samp_weights, samp_uids,
+            seed=down_sampling_seed + sweep,
+        )
+        mult = np.where(
+            samp_weights > 0, new_w / np.where(samp_weights > 0, samp_weights, 1.0), 0.0
+        ).astype(samp_dtype)
+        if mesh is not None:
+            put = put_fn if put_fn is not None else jax.device_put
+            return put(jnp.asarray(mult), NamedSharding(mesh, P("data")))
+        return jnp.asarray(mult)
+
+    val_data = None
+    evaluators = list(validation_evaluators)
+    if validation_dataset is not None and evaluators and validation_eval_data is not None:
+        val_data = program.prepare_scoring_inputs(validation_dataset)
 
     # true entity counts, to slice off any mesh-padding rows on the way out
     table_sizes = {
@@ -1057,21 +1297,65 @@ def train_distributed(
             mesh, data, buckets, state, fe_feature_sharded=fe_feature_sharded,
             put_fn=put_fn,
         )
+        if val_data is not None:
+            val_data = program.shard_scoring_inputs(
+                mesh, val_data, fe_feature_sharded=fe_feature_sharded,
+                put_fn=put_fn,
+            )
+
+    def state_arrays(state_: GameTrainState, prefix: str = "") -> dict:
+        clean = unpadded(state_)
+        arrays = {prefix + "fe_coefficients": jax.device_get(clean.fe_coefficients)}
+        for sub, tables in (
+            ("re_tables/", clean.re_tables),
+            ("mf_rows/", clean.mf_rows),
+            ("mf_cols/", clean.mf_cols),
+        ):
+            for k, v in tables.items():
+                arrays[prefix + sub + k] = jax.device_get(v)
+        return arrays
+
     losses = list(prior_losses)
     for sweep in range(start_sweep, num_iterations):
+        if sampler is not None:
+            data["fe_weight_multiplier"] = sweep_multiplier(sweep)
         state, loss = program.step(data, buckets, state)
         losses.append(float(loss))
+
+        metrics: dict[str, float] = {}
+        if training_evaluator is not None and training_eval_data is not None:
+            train_scores = _host_scores(program.score(data, state), n_train)
+            metrics[f"train:{training_evaluator.name}"] = float(
+                training_evaluator.evaluate(train_scores, training_eval_data)
+            )
+        if val_data is not None:
+            val_scores = _host_scores(program.score(val_data, state), n_val)
+            for i, ev in enumerate(evaluators):
+                v = float(ev.evaluate(val_scores, validation_eval_data))
+                metrics[f"validate:{ev.name}"] = v
+                if i == 0 and (
+                    best_state is None or ev.better_than(v, best_metric)
+                ):
+                    best_state, best_metric = state, v
+        if metrics:
+            history.append({"iteration": sweep, "coordinate": "fused_sweep",
+                            **metrics})
+
         if checkpointer is not None and (
             (sweep + 1) % max(1, checkpoint_every) == 0 or sweep + 1 == num_iterations
         ):
-            clean = unpadded(state)
-            arrays = {"fe_coefficients": jax.device_get(clean.fe_coefficients)}
-            for prefix, tables in (
-                ("re_tables/", clean.re_tables),
-                ("mf_rows/", clean.mf_rows),
-                ("mf_cols/", clean.mf_cols),
-            ):
-                for k, v in tables.items():
-                    arrays[prefix + k] = jax.device_get(v)
-            checkpointer.save(sweep + 1, arrays, {"losses": losses})
-    return unpadded(state), losses
+            arrays = state_arrays(state)
+            if best_state is not None:
+                arrays.update(state_arrays(best_state, prefix="best/"))
+            checkpointer.save(
+                sweep + 1, arrays,
+                {"losses": losses, "metric_history": history,
+                 "best_metric": best_metric},
+            )
+    return DistributedTrainResult(
+        state=unpadded(state),
+        losses=losses,
+        best_state=None if best_state is None else unpadded(best_state),
+        best_metric=best_metric,
+        metric_history=history,
+    )

