@@ -302,7 +302,6 @@ class GameEstimator:
             ModelCoordinate,
             _solve_config,
         )
-        from photon_ml_tpu.io.checkpoint import DivergenceError
         from photon_ml_tpu.parallel.distributed import (
             FixedEffectStepSpec,
             GameTrainProgram,
@@ -561,12 +560,8 @@ class GameEstimator:
             validation_eval_data=val_eval_data,
             training_evaluator=default_evaluator_for_task(self.task),
             training_eval_data=train_eval_data,
+            check_finite=self.check_finite,
         )
-        if self.check_finite and not all(np.isfinite(result.losses)):
-            raise DivergenceError(
-                f"distributed training produced non-finite sweep losses: "
-                f"{result.losses}"
-            )
 
         trainable_cids = {} if fe_cid is None else {fe_shard: fe_cid}
         trainable_cids.update(
@@ -720,12 +715,17 @@ def train_glm_grid(
         )
     loss = loss_for_task(task)
     objective = _objective_for_batch(batch, loss, 0.0, normalization)
-    # lane-aware resolution: L full Hessians materialize at once — validate
-    # before any lane trains (sparse objectives resolve to diagonal)
-    resolved_variance = resolve_variance_mode_for(
-        objective, variance_mode, batch.dim,
-        num_problems=len(regularization_weights),
-    )
+    # cheap typo check always; the full-vs-diagonal capability resolution
+    # (L full Hessians at once; sparse objectives are diagonal-only) only
+    # matters — and should only be able to fail — when variances are
+    # actually requested
+    validate_variance_mode(variance_mode)
+    resolved_variance = None
+    if compute_variance:
+        resolved_variance = resolve_variance_mode_for(
+            objective, variance_mode, batch.dim,
+            num_problems=len(regularization_weights),
+        )
     dtype = batch.dtype
     if dtype == jnp.bfloat16:
         dtype = jnp.float32

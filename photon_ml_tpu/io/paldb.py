@@ -88,9 +88,20 @@ def _deserialize(buf: bytes, pos: int, end: int):
     if _INT_SMALL_BASE <= t <= _INT_SMALL_BASE + _INT_SMALL_MAX:
         return t - _INT_SMALL_BASE
     if t == _INT_BYTE:
+        if pos + 1 >= end:
+            raise ValueError(
+                f"corrupt PalDB blob at offset {pos}: INT_BYTE payload "
+                "overruns its region"
+            )
         return buf[pos + 1]
     if t == _INT_PACKED:
-        return _unpack_longpacker(buf, pos + 1)[0]
+        value, p = _unpack_longpacker(buf, pos + 1)
+        if p > end:
+            raise ValueError(
+                f"corrupt PalDB blob at offset {pos}: packed int of "
+                f"{p - pos - 1} bytes overruns its {end - pos}-byte region"
+            )
+        return value
     if t == _STRING:
         n, p = _unpack_longpacker(buf, pos + 1)
         if p + n > end:

@@ -1125,6 +1125,7 @@ def train_distributed(
     training_evaluator=None,
     training_eval_data=None,
     down_sampling_seed: int = 0,
+    check_finite: bool = True,
 ) -> DistributedTrainResult:
     """Run ``num_iterations`` fused CD sweeps, optionally mesh-sharded.
 
@@ -1321,6 +1322,21 @@ def train_distributed(
             data["fe_weight_multiplier"] = sweep_multiplier(sweep)
         state, loss = program.step(data, buckets, state)
         losses.append(float(loss))
+        if check_finite and not np.isfinite(losses[-1]):
+            # raise BEFORE the checkpoint save below would overwrite the
+            # last finite state with NaNs (CD-path DivergenceError contract,
+            # coordinate_descent.py)
+            from photon_ml_tpu.io.checkpoint import DivergenceError
+
+            raise DivergenceError(
+                f"fused training step produced non-finite loss "
+                f"{losses[-1]} at sweep {sweep}"
+                + (
+                    f"; last good checkpoint: step "
+                    f"{checkpointer.latest_step()} in {checkpointer.directory}"
+                    if checkpointer is not None else ""
+                )
+            )
 
         metrics: dict[str, float] = {}
         if training_evaluator is not None and training_eval_data is not None:
@@ -1355,7 +1371,12 @@ def train_distributed(
     return DistributedTrainResult(
         state=unpadded(state),
         losses=losses,
-        best_state=None if best_state is None else unpadded(best_state),
+        # best == final collapses to None ("treat final as best") so callers
+        # never convert/variance-compute the same state twice
+        best_state=(
+            None if best_state is None or best_state is state
+            else unpadded(best_state)
+        ),
         best_metric=best_metric,
         metric_history=history,
     )

@@ -231,6 +231,31 @@ class TestFitDistributed:
         assert warm.best_metric < 0.5 * cold.best_metric
 
 
+class TestDistributedDivergence:
+    def test_non_finite_loss_raises_before_checkpoint(self, data, tmp_path):
+        """A NaN label must raise DivergenceError at the offending sweep
+        (CD contract) — not train through and checkpoint NaN state."""
+        from photon_ml_tpu.io.checkpoint import DivergenceError, TrainingCheckpointer
+
+        train, _ = data
+        labels = train.host_array("labels").copy()
+        labels[3] = np.nan
+        bad = dataclasses.replace(
+            train,
+            labels=np.asarray(labels),
+            host_cache={**train.host_cache, "labels": labels},
+        )
+        ckpt = TrainingCheckpointer(str(tmp_path / "ck"))
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinate_configs={"fe": CONFIGS["fe"]},
+            num_iterations=3, mesh=make_mesh(), checkpointer=ckpt,
+        )
+        with pytest.raises(DivergenceError):
+            est.fit(bad)
+        assert ckpt.latest_step() is None  # nothing NaN was persisted
+
+
 class TestPadGameDataset:
     def test_pads_and_preserves(self, data):
         train, _ = data
