@@ -131,17 +131,14 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
     batch = LabeledPointBatch.create(jax.device_put(x), jax.device_put(y))
     k_lo, k_hi = 16, 96
     rng = np.random.default_rng(7)
-    out = []
-    for label, use_pallas in (("autodiff_xla", False), ("pallas_kernel", True)):
-        obj = GLMObjective(LogisticLoss(), l2_weight=0.5, use_pallas=use_pallas)
 
+    def marginal_of(step_fn):
         def timed(k):
             @jax.jit
             def run(w0, b):
-                def step(w, _):
-                    v, g = obj.value_and_gradient(w, b)
-                    return w - 1e-4 * g, v
-                w, vs = jax.lax.scan(step, w0, None, length=k)
+                w, vs = jax.lax.scan(
+                    lambda w, _: step_fn(w, b), w0, None, length=k
+                )
                 return vs.sum() + w.sum()
 
             float(run(jnp.zeros(d, jnp.float32), batch))  # compile+sync
@@ -154,17 +151,48 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
                 best = el if best is None or el < best else best
             return best
 
-        marginal = (timed(k_hi) - timed(k_lo)) / (k_hi - k_lo)
-        marginal = max(marginal, 1e-6)
+        return max((timed(k_hi) - timed(k_lo)) / (k_hi - k_lo), 1e-6)
+
+    # Same-run stream calibration (one X read per step): the tunnel pool's
+    # chips vary run to run (r3 study measured the SAME stream probe at
+    # 567-747 GB/s across rounds of one process), so fractions are only
+    # meaningful against a bandwidth measured on THIS run's chip — the r2
+    # "221 vs 750 GB/s" contradiction was exactly this tenancy variance.
+    stream_marginal = marginal_of(
+        lambda w, b: (w + jnp.sum(b.features @ w) * 1e-30, jnp.float32(0))
+    )
+    stream_gbps = xbytes / stream_marginal / 1e9
+    out = [{
+        "metric": "fe_hot_loop_stream_gbps",
+        "value": round(stream_gbps, 1),
+        "unit": (
+            f"same-run calibration: one [n, d]-matvec X read per step "
+            f"(n={n}, d={d}; nominal v5e roofline {HBM_ROOFLINE_GBPS} GB/s; "
+            "hot-loop fractions below are vs THIS number)"
+        ),
+    }]
+    # X passes per eval: autodiff reads X twice (margin matvec + transpose
+    # matvec — XLA does not fuse them into one read); the Pallas kernel's
+    # whole point is ONE fused pass (ops/pallas_glm.py)
+    for label, use_pallas, x_passes in (
+        ("autodiff_xla", False, 2), ("pallas_kernel", True, 1)
+    ):
+        obj = GLMObjective(LogisticLoss(), l2_weight=0.5, use_pallas=use_pallas)
+
+        def step(w, b, _obj=obj):
+            v, g = _obj.value_and_gradient(w, b)
+            return w - 1e-4 * g, v
+
+        marginal = marginal_of(step)
         gbps = xbytes / marginal / 1e9
         out.append({
             "metric": f"fe_hot_loop_hbm_gbps_{label}",
             "value": round(gbps, 1),
             "unit": (
-                f"achieved HBM GB/s, marginal over {k_hi - k_lo} extra "
-                f"value+grad evals (n={n}, d={d}, logistic; roofline "
-                f"{HBM_ROOFLINE_GBPS} GB/s; fraction "
-                f"{gbps / HBM_ROOFLINE_GBPS:.2f})"
+                f"achieved GB/s per value+grad eval counting ONE X read "
+                f"({x_passes} actual X pass(es) per eval), marginal over "
+                f"{k_hi - k_lo} extra evals; actual-traffic fraction of the "
+                f"same-run stream rate: {x_passes * gbps / stream_gbps:.2f}"
             ),
         })
     return out
@@ -293,26 +321,34 @@ def bench_sparse_fe() -> dict:
     cols = np.concatenate([cols, support[sig].ravel()])
     vals = np.concatenate([vals, sig_vals.ravel()])
     nnz = len(vals)
+    # default ELL layout: dense row-sum margins + broadcast dz (measured
+    # 330 ms/iter vs 644 flat-COO vs 733 in r2 — BASELINE.md r3 study; the
+    # remaining cost is the w-gather at ~7 ns/index and the transpose
+    # scatter, both per-index-rate-bound on v5e)
     batch = SparseLabeledPointBatch.from_coo(rows, cols, vals, y, dim=d,
                                              dtype=np.float32)
     obj = SparseGLMObjective(LogisticLoss(), l2_weight=0.1)
-    bound = obj.bind(batch)
+
+    from functools import partial
+
+    # batch rides as a jit ARGUMENT: closing over it would embed the COO
+    # arrays as constants in the remote-compile request (HTTP 413 over the
+    # tunnel — the real cause of r2's "compile service drops")
+    @partial(jax.jit, static_argnums=(2,))
+    def run(w0, b, iters):
+        r = minimize_lbfgs(obj.bind(b).value_and_grad, w0, max_iter=iters,
+                           tolerance=0.0)
+        return r.value + r.coefficients[0]
 
     def timed(iters, seed):
-        @jax.jit
-        def run(w0):
-            r = minimize_lbfgs(bound.value_and_grad, w0, max_iter=iters,
-                               tolerance=0.0)
-            return r.value + r.coefficients[0]
-
         key = jax.random.PRNGKey(seed)
         w0 = 1e-3 * jax.random.normal(key, (d,), jnp.float32)
-        float(run(w0))  # compile + sync
+        float(run(w0, batch, iters))  # compile + sync
         best = None
         for s in range(2):
             w0 = 1e-3 * jax.random.normal(jax.random.PRNGKey(seed + s + 1), (d,))
             t0 = time.perf_counter()
-            float(run(w0.astype(jnp.float32)))
+            float(run(w0.astype(jnp.float32), batch, iters))
             el = time.perf_counter() - t0
             best = el if best is None or el < best else best
         return best
@@ -324,9 +360,69 @@ def bench_sparse_fe() -> dict:
         "value": round(nnz / marginal, 1),
         "unit": (
             f"nonzero-entries x L-BFGS-iters/sec, sparse FE d={d:.0e} "
-            f"(n={n}, nnz={nnz}, logistic, flat-COO gather/segment-sum; "
+            f"(n={n}, nnz={nnz}, logistic, ELL padded-row layout; "
             f"marginal over {k_hi - k_lo} extra iterations, "
-            f"{marginal*1e3:.2f} ms/iter)"
+            f"{marginal*1e3:.2f} ms/iter; was 733 ms/iter flat-COO in r2)"
+        ),
+    }
+
+
+def bench_sparse_fe_1e8() -> dict:
+    """d=10⁸ sparse FE via TRON (VERDICT r2 #5: a step toward the
+    reference's 'hundreds of billions of coefficients', README.md:77).
+    TRON holds O(1) work vectors of size d where LBFGS history is 2·m·d —
+    the survey's hard-parts recipe (SURVEY.md §7); the Hessian-vector ladder
+    reuses the ELL forward + transpose-scatter."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.sparse_batch import SparseLabeledPointBatch
+    from photon_ml_tpu.ops.losses import LogisticLoss
+    from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
+    from photon_ml_tpu.optim.tron import minimize_tron
+
+    from functools import partial
+
+    rng = np.random.default_rng(5)
+    n, d, per_row = 1 << 18, 100_000_000, 16
+    rows = np.repeat(np.arange(n), per_row)
+    cols = rng.integers(0, d, size=n * per_row)
+    vals = rng.normal(size=n * per_row).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    nnz = len(vals)
+    batch = SparseLabeledPointBatch.from_coo(rows, cols, vals, y, dim=d,
+                                             dtype=np.float32)
+    obj = SparseGLMObjective(LogisticLoss(), l2_weight=0.1)
+
+    @partial(jax.jit, static_argnums=(2,))
+    def run(w0, b, iters):
+        bound = obj.bind(b)
+        r = minimize_tron(bound.value_and_grad, bound.hessian_vector, w0,
+                          max_iter=iters, max_cg_iter=2, tolerance=0.0)
+        return r.value + r.coefficients[0]
+
+    def timed(iters, seed):
+        w0 = 1e-3 * jax.random.normal(jax.random.PRNGKey(seed), (d,), jnp.float32)
+        float(run(w0, batch, iters))  # compile + sync
+        best = None
+        for s in range(2):
+            w0 = 1e-3 * jax.random.normal(jax.random.PRNGKey(seed + s + 1),
+                                          (d,), jnp.float32)
+            t0 = time.perf_counter()
+            float(run(w0, batch, iters))
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    k_lo, k_hi = 2, 8
+    marginal = max((timed(k_hi, 0) - timed(k_lo, 100)) / (k_hi - k_lo), 1e-6)
+    return {
+        "metric": "sparse_1e8_fe_tron_ms_per_iter",
+        "value": round(marginal * 1e3, 1),
+        "unit": (
+            f"marginal ms per TRON outer iteration (2 CG steps), sparse FE "
+            f"d={d:.0e} (n={n}, nnz={nnz}, logistic, ELL layout; "
+            f"{nnz / marginal / 1e6:.1f}M entry-iters/sec)"
         ),
     }
 
@@ -366,6 +462,7 @@ def main():
     extra = bench_hot_loop_bandwidth(x[: 1 << 17], y[: 1 << 17])
     extra.append(bench_game_sweep())
     extra.append(bench_sparse_fe())
+    extra.append(bench_sparse_fe_1e8())
     cpu_rate = bench_cpu_scipy(x[:CPU_SUBSAMPLE], y[:CPU_SUBSAMPLE])
 
     rate = N * lane_iters / tpu_time

@@ -419,12 +419,25 @@ class GameTrainProgram:
                 offsets=put(sb.offsets, vec),
                 weights=put(sb.weights, vec),
             )
+            if sb.has_ell_view:
+                # [n, L] rides the sample axis like a dense feature block
+                sb = sb.replace(
+                    ell_vals=put(sb.ell_vals, NamedSharding(mesh, P("data", None))),
+                    ell_cols=put(sb.ell_cols, NamedSharding(mesh, P("data", None))),
+                )
             if sb.has_column_sorted_view:
                 sb = sb.replace(
                     vals_by_col=put(sb.vals_by_col, vec),
                     rows_by_col=put(sb.rows_by_col, vec),
                     cols_sorted=put(sb.cols_sorted, vec),
                 )
+                if sb.col_bounds is not None:
+                    # [dim+1] run boundaries ride with the coefficient
+                    # vector's layout (replicated; model-sharding of giant d
+                    # splits the batch by columns before it gets here)
+                    sb = sb.replace(
+                        col_bounds=put(sb.col_bounds, NamedSharding(mesh, P()))
+                    )
             data["fe_sparse_batch"] = sb
         return data
 

@@ -80,12 +80,15 @@ class TestSparseBatch:
         )
 
     def test_nnz_padding_is_inert(self):
-        sb, db = _pair()
+        """Flat-COO entry padding contributes nothing (ell=False isolates
+        the flat layout; the batch's .values hold ONLY the overflow tail
+        when the ELL view is on)."""
+        rows, cols, vals = _random_coo(64, 12, 300, 0, duplicates=True)
+        labels = np.random.default_rng(1).random(64)
+        common = dict(dim=12, dtype=np.float64, ell=False)
+        sb = SparseLabeledPointBatch.from_coo(rows, cols, vals, labels, **common)
         padded = SparseLabeledPointBatch.from_coo(
-            np.asarray(sb.row_ids), np.asarray(sb.col_indices),
-            np.asarray(sb.values), np.asarray(sb.labels), dim=sb.dim,
-            offsets=np.asarray(sb.offsets), weights=np.asarray(sb.weights),
-            dtype=np.float64, pad_nnz_to=sb.nnz + 57,
+            rows, cols, vals, labels, pad_nnz_to=sb.nnz + 57, **common
         )
         assert padded.nnz == sb.nnz + 57
         w = jnp.asarray(np.random.default_rng(3).normal(size=sb.dim))
@@ -94,6 +97,36 @@ class TestSparseBatch:
             np.asarray(sparse_margins(sb, w)),
             rtol=1e-12,
         )
+
+    def test_ell_view_matches_flat_and_dense(self):
+        """The default ELL view (incl. overflow tail at a forced tiny
+        width) computes identical margins/column-sums to flat COO."""
+        from photon_ml_tpu.data.sparse_batch import sparse_column_sum
+
+        rows, cols, vals = _random_coo(64, 12, 300, 5, duplicates=True)
+        labels = np.random.default_rng(1).random(64)
+        flat = SparseLabeledPointBatch.from_coo(
+            rows, cols, vals, labels, dim=12, dtype=np.float64, ell=False
+        )
+        w = jnp.asarray(np.random.default_rng(3).normal(size=12))
+        rw = jnp.asarray(np.random.default_rng(4).uniform(0.5, 2.0, size=64))
+        for ell in ("auto", 2):  # 2 forces a large overflow tail
+            eb = SparseLabeledPointBatch.from_coo(
+                rows, cols, vals, labels, dim=12, dtype=np.float64, ell=ell
+            )
+            assert eb.has_ell_view
+            if ell == 2:
+                assert eb.values.shape[0] > 0  # tail exercised
+            np.testing.assert_allclose(
+                np.asarray(sparse_margins(eb, w)),
+                np.asarray(sparse_margins(flat, w)), rtol=1e-12,
+            )
+            for sq in (False, True):
+                np.testing.assert_allclose(
+                    np.asarray(sparse_column_sum(eb, rw, square_values=sq)),
+                    np.asarray(sparse_column_sum(flat, rw, square_values=sq)),
+                    rtol=1e-12,
+                )
 
     def test_out_of_range_indices_rejected(self):
         with pytest.raises(ValueError, match="dim"):
@@ -315,6 +348,53 @@ class TestColumnSortedGradient:
         v2, g2 = so.value_and_gradient(w, plain)
         np.testing.assert_allclose(float(v1), float(v2), rtol=1e-12)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-10)
+
+    def test_segment_sum_fallback_matches(self):
+        """col_bounds=None falls back to the sorted segment-sum — both
+        reductions of the column-sorted view agree with autodiff."""
+        sb = self._batch(seed=37)
+        no_bounds = sb.replace(col_bounds=None)
+        plain = sb.replace(
+            vals_by_col=None, rows_by_col=None, cols_sorted=None,
+            col_bounds=None,
+        )
+        so = SparseGLMObjective(
+            loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=0.3
+        )
+        w = jnp.asarray(np.random.default_rng(38).normal(scale=0.1, size=sb.dim))
+        _, g_bounds = so.value_and_gradient(w, sb)
+        _, g_seg = so.value_and_gradient(w, no_bounds)
+        _, g_auto = so.value_and_gradient(w, plain)
+        np.testing.assert_allclose(np.asarray(g_bounds), np.asarray(g_auto), rtol=1e-9)
+        np.testing.assert_allclose(np.asarray(g_seg), np.asarray(g_auto), rtol=1e-9)
+
+    @pytest.mark.parametrize("with_factors", [False, True])
+    def test_hessian_vector_matches_autodiff(self, with_factors):
+        """The scatter-free Hv (TRON's CG ladder at giant d) equals the
+        forward-over-reverse jvp, with and without factor normalization."""
+        rng = np.random.default_rng(39)
+        sb = self._batch(seed=40)
+        norm = None
+        if with_factors:
+            norm = NormalizationContext(
+                factors=jnp.asarray(rng.uniform(0.5, 2.0, size=sb.dim)),
+                shifts=None,
+            )
+        so = SparseGLMObjective(
+            loss_for_task(TaskType.POISSON_REGRESSION), l2_weight=0.7,
+            normalization=norm,
+        )
+        plain = sb.replace(
+            vals_by_col=None, rows_by_col=None, cols_sorted=None,
+            col_bounds=None,
+        )
+        w = jnp.asarray(rng.normal(scale=0.1, size=sb.dim))
+        v = jnp.asarray(rng.normal(size=sb.dim))
+        hv_fast = so.hessian_vector(w, v, sb)
+        hv_auto = so.hessian_vector(w, v, plain)
+        np.testing.assert_allclose(
+            np.asarray(hv_fast), np.asarray(hv_auto), rtol=1e-8
+        )
 
     def test_solver_equivalence(self):
         from photon_ml_tpu.estimators import train_glm
