@@ -224,11 +224,17 @@ class RandomEffectCoordinate(Coordinate):
         re = self.re_dataset
         dtype = self.dataset.feature_shards[re.feature_shard_id].dtype
         return RandomEffectModel(
-            coefficients=jnp.zeros((re.num_entities, re.dim), dtype=dtype),
+            # compact (sparse-shard) coordinates hold [E, K] tables over each
+            # entity's active columns; dense hold [E, dim]
+            coefficients=jnp.zeros(
+                (re.num_entities, re.table_width), dtype=dtype
+            ),
             entity_keys=self.dataset.entity_vocabs[re.random_effect_type],
             random_effect_type=re.random_effect_type,
             feature_shard_id=re.feature_shard_id,
             task=self.task,
+            active_cols=re.active_cols,
+            feature_dim=re.dim if re.is_compact else None,
         )
 
     def update_model(self, model: RandomEffectModel, extra_offsets: Array | None = None):

@@ -249,6 +249,14 @@ class RandomEffectDataset:
     dim: int
     projector_type: "ProjectorType" = None  # set in __post_init__
     projection: "RandomProjectionMatrix | None" = None
+    #: giant-d_re compact mode (sparse feature shard): [E, K] sorted active
+    #: GLOBAL columns per entity (pad = dim); the coefficient table is then
+    #: [E, K] over these columns, bucket ``col_index`` holds LOCAL positions
+    #: (pad = K), and scoring maps data entries to positions
+    #: (models/game.compact_entry_positions). This is the reference's
+    #: per-entity projection insight (IndexMapProjectorRDD.scala:218-257)
+    #: without ever materializing [E, d_re].
+    active_cols: np.ndarray | None = None
 
     def __post_init__(self):
         if self.projector_type is None:
@@ -257,6 +265,19 @@ class RandomEffectDataset:
     @property
     def num_trained_entities(self) -> int:
         return sum(b.num_entities for b in self.buckets)
+
+    @property
+    def is_compact(self) -> bool:
+        return self.active_cols is not None
+
+    @property
+    def table_width(self) -> int:
+        """Second axis of the coefficient table: K in compact mode, the
+        full shard width otherwise."""
+        return (
+            int(self.active_cols.shape[1]) if self.active_cols is not None
+            else self.dim
+        )
 
 
 def _stable_priorities(sample_ids: np.ndarray, seed: int) -> np.ndarray:
@@ -415,6 +436,29 @@ def build_random_effect_dataset(
       dropped columns are zeroed in its block (and therefore excluded from
       INDEX_MAP active columns).
     """
+    shard = dataset.feature_shards[shard_id]
+    if isinstance(shard, SparseShard):
+        # giant-d_re path: per-entity observed-column blocks from the COO
+        # triples, compact [E, K] coefficient table — never densify
+        if projector_type not in (ProjectorType.IDENTITY, ProjectorType.INDEX_MAP):
+            raise ValueError(
+                f"sparse random-effect shard '{shard_id}': only "
+                "IDENTITY/INDEX_MAP projectors are supported (the compact "
+                "representation IS an index-map projection)"
+            )
+        if features_to_samples_ratio is not None:
+            raise ValueError(
+                "features_to_samples_ratio (Pearson selection) is not "
+                "supported on sparse random-effect shards"
+            )
+        return _build_sparse_random_effect_dataset(
+            dataset, re_type, shard_id, shard,
+            active_data_upper_bound=active_data_upper_bound,
+            active_data_lower_bound=active_data_lower_bound,
+            bucket_sizes=bucket_sizes,
+            seed=seed,
+        )
+
     entity_idx = dataset.host_array(f"entity_idx/{re_type}")
     features = dataset.host_array(f"shard/{shard_id}")
     labels = dataset.host_array("labels")
@@ -495,6 +539,135 @@ def build_random_effect_dataset(
         dim=dim,
         projector_type=projector_type,
         projection=projection,
+    )
+
+
+def _build_sparse_random_effect_dataset(
+    dataset: GameDataset,
+    re_type: str,
+    shard_id: str,
+    shard: SparseShard,
+    *,
+    active_data_upper_bound: int | None,
+    active_data_lower_bound: int | None,
+    bucket_sizes: Sequence[int],
+    seed: int,
+) -> RandomEffectDataset:
+    """Compact per-entity blocks from a sparse (giant-d_re) shard.
+
+    The reference trains each entity on its OBSERVED feature support
+    (IndexMapProjectorRDD.scala:218-257, LocalDataSet.scala:36-173). Here:
+    each entity's active columns = the union of nonzero columns across its
+    kept samples (small, even when d_re is 10⁶+); its dense training block
+    is [cap, bdim] over those columns; the coefficient table is [E, K]
+    compact. Bucket ``col_index`` holds LOCAL table positions (pad = K), so
+    the existing INDEX_MAP bucket solver runs unchanged with a [E, K+1]
+    scratch-column table.
+    """
+    entity_idx = dataset.host_array(f"entity_idx/{re_type}")
+    labels = dataset.host_array("labels")
+    weights = dataset.host_array("weights")
+    unique_ids = np.asarray(dataset.unique_ids)
+    n = dataset.num_samples
+    dim = int(shard.feature_dim)
+    num_entities = len(dataset.entity_vocabs[re_type])
+
+    rows_s, cols_s, vals_s = shard.coalesced()
+    rows_s = np.asarray(rows_s)
+    cols_s = np.asarray(cols_s)
+    vals_s = np.asarray(vals_s)
+    row_ptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(np.bincount(rows_s, minlength=n), out=row_ptr[1:])
+
+    per_bucket = group_entities_into_buckets(
+        entity_idx,
+        unique_ids,
+        bucket_sizes=bucket_sizes,
+        active_data_upper_bound=active_data_upper_bound,
+        active_data_lower_bound=active_data_lower_bound,
+        seed=seed,
+    )
+
+    # pass 1: per-bucket entry expansion + per-entity active columns
+    staged = []
+    for cap, members in per_bucket.items():
+        if not members:
+            continue
+        e = len(members)
+        be, rows_concat, lane, slot = pack_bucket_lanes(members)
+        bl = np.zeros((e, cap), dtype=labels.dtype)
+        bw = np.zeros((e, cap), dtype=weights.dtype)
+        bs = np.full((e, cap), -1, dtype=np.int32)
+        bl[lane, slot] = labels[rows_concat]
+        bw[lane, slot] = weights[rows_concat]
+        bs[lane, slot] = rows_concat
+
+        # expand the kept samples' COO entries (vectorized CSR slicing)
+        cnt = row_ptr[rows_concat + 1] - row_ptr[rows_concat]
+        total = int(cnt.sum())
+        if total:
+            base = np.repeat(row_ptr[rows_concat], cnt)
+            offs = np.arange(total) - np.repeat(np.cumsum(cnt) - cnt, cnt)
+            eidx = base + offs
+            ecol = cols_s[eidx]
+            evals = vals_s[eidx]
+            elane = np.repeat(lane, cnt)
+            eslot = np.repeat(slot, cnt)
+        else:
+            ecol = np.zeros(0, np.int64)
+            evals = np.zeros(0, vals_s.dtype)
+            elane = np.zeros(0, np.int64)
+            eslot = np.zeros(0, np.int64)
+
+        # per-lane sorted unique active columns
+        key = elane * (dim + 1) + ecol
+        uniq = np.unique(key)
+        ulane, ucol = uniq // (dim + 1), uniq % (dim + 1)
+        counts = np.bincount(ulane, minlength=e)
+        bdim = max(int(counts.max(initial=0)), 1)
+        starts = np.zeros(e + 1, dtype=np.int64)
+        np.cumsum(counts, out=starts[1:])
+        pos_of_uniq = np.arange(len(uniq)) - starts[ulane]
+        bc = np.full((e, bdim), dim, dtype=np.int32)  # pad = dim (global)
+        bc[ulane, pos_of_uniq] = ucol
+        # entry -> position in its lane's active list (uniq is sorted, so
+        # searchsorted over the flat unique keys localizes each entry)
+        epos = np.searchsorted(uniq, key)
+        epos = epos - starts[elane]
+
+        bf = np.zeros((e, cap, bdim), dtype=vals_s.dtype)
+        bf[elane, eslot, epos] = evals
+        staged.append((cap, e, be, bl, bw, bs, bc, bf, bdim))
+
+    k_width = max((bdim for *_, bdim in staged), default=1)
+    active_cols = np.full((num_entities, k_width), dim, dtype=np.int32)
+    buckets: list[EntityBucket] = []
+    for cap, e, be, bl, bw, bs, bc, bf, bdim in staged:
+        active_cols[be, :bdim] = bc
+        # local table positions: the canonical active list IS this bucket's
+        # bc row (entities live in exactly one bucket), so position p maps
+        # to table slot p; pads point at the scratch column K
+        local = np.broadcast_to(
+            np.arange(bdim, dtype=np.int32), (e, bdim)
+        ).copy()
+        local[bc >= dim] = k_width
+        buckets.append(EntityBucket(
+            features=jnp.asarray(bf),
+            labels=jnp.asarray(bl),
+            weights=jnp.asarray(bw),
+            entity_rows=jnp.asarray(be),
+            sample_rows=jnp.asarray(bs),
+            col_index=jnp.asarray(local),
+        ))
+
+    return RandomEffectDataset(
+        random_effect_type=re_type,
+        feature_shard_id=shard_id,
+        buckets=buckets,
+        num_entities=num_entities,
+        dim=dim,
+        projector_type=ProjectorType.INDEX_MAP,
+        active_cols=active_cols,
     )
 
 

@@ -449,7 +449,9 @@ class GameEstimator:
                 feature_shard_id=cfg.feature_shard_id,
                 optimizer=_solve_config(cfg.optimization),
                 l2_weight=cfg.optimization.l2_weight,
-                projector=cfg.projector_type,
+                # the dataset's projector, not the config's: sparse shards
+                # coerce to the compact INDEX_MAP representation
+                projector=re_datasets[re_type].projector_type,
             ))
 
         program = GameTrainProgram(

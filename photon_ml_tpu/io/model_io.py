@@ -78,6 +78,39 @@ def _coefficients_to_name_term_values(
     return out
 
 
+def _compact_row_to_record(
+    model_id: str,
+    values: np.ndarray,  # [K]
+    cols: np.ndarray,  # [K] global columns, pad = dim
+    var_row: np.ndarray | None,
+    task,
+    index_map: IndexMap,
+    threshold: float,
+    dim: int,
+) -> dict:
+    """One compact (giant-d_re) entity row as the standard per-feature
+    name-term-value record — on disk it matches a dense row exactly."""
+    def ntv(vals: np.ndarray, thr: float) -> list[dict]:
+        out = []
+        for j, v in zip(cols.tolist(), np.asarray(vals).tolist()):
+            if j >= dim or (thr != 0.0 and abs(v) < thr):
+                continue
+            key = index_map.get_feature_name(int(j))
+            if key is None:
+                continue
+            name, term = split_feature_key(key)
+            out.append({"name": name, "term": term, "value": float(v)})
+        return out
+
+    return {
+        "modelId": model_id,
+        "modelClass": _MODEL_CLASS.get(task),
+        "means": ntv(values, threshold),
+        "variances": None if var_row is None else ntv(var_row, 0.0),
+        "lossFunction": None,
+    }
+
+
 def _glm_to_record(
     model_id: str,
     glm: GeneralizedLinearModel,
@@ -158,6 +191,71 @@ def _write_chunked(
             break
 
 
+def _load_compact_random_effect(
+    records: list[dict], re_type: str, shard_id: str,
+    index_map: IndexMap, task, dtype,
+) -> RandomEffectModel:
+    """Decode per-entity records into the compact [E, K] layout (sorted
+    active global columns per entity; K = widest entity; pad = dim)."""
+    dim = index_map.size
+    keys = sorted(r["modelId"] for r in records)
+    row = {k: i for i, k in enumerate(keys)}
+    per_entity: list[tuple[np.ndarray, np.ndarray, np.ndarray | None]] = [
+        (np.zeros(0, np.int64), np.zeros(0, dtype), None)
+    ] * len(keys)
+    model_task = task
+    any_var = False
+    for record in records:
+        cols, vals = [], []
+        for ntv in record["means"]:
+            j = index_map.get_index(
+                feature_key(ntv["name"], ntv.get("term") or "")
+            )
+            if j >= 0:
+                cols.append(j)
+                vals.append(ntv["value"])
+        cols = np.asarray(cols, dtype=np.int64)
+        vals = np.asarray(vals, dtype=dtype)
+        order = np.argsort(cols)
+        cols, vals = cols[order], vals[order]
+        var = None
+        if record.get("variances"):
+            vmap = {}
+            for ntv in record["variances"]:
+                j = index_map.get_index(
+                    feature_key(ntv["name"], ntv.get("term") or "")
+                )
+                if j >= 0:
+                    vmap[j] = ntv["value"]
+            # unlisted active columns default to 0.0, matching the dense
+            # loader (_record_to_coefficients); NaN stays reserved for pad
+            # slots / entities without the field
+            var = np.asarray([vmap.get(int(c), 0.0) for c in cols], dtype)
+            any_var = True
+        per_entity[row[record["modelId"]]] = (cols, vals, var)
+        model_task = _CLASS_TO_TASK.get(record.get("modelClass"), model_task)
+    k_width = max((len(c) for c, _, _ in per_entity), default=0) or 1
+    e = len(keys)
+    active = np.full((e, k_width), dim, dtype=np.int32)
+    table = np.zeros((e, k_width), dtype=dtype)
+    var_table = np.full((e, k_width), np.nan, dtype=dtype) if any_var else None
+    for i, (cols, vals, var) in enumerate(per_entity):
+        active[i, : len(cols)] = cols
+        table[i, : len(cols)] = vals
+        if var is not None:
+            var_table[i, : len(cols)] = var
+    return RandomEffectModel(
+        coefficients=jnp.asarray(table),
+        entity_keys=np.asarray(keys),
+        random_effect_type=re_type,
+        feature_shard_id=shard_id,
+        task=model_task,
+        variances=None if var_table is None else jnp.asarray(var_table),
+        active_cols=active,
+        feature_dim=dim,
+    )
+
+
 def _record_to_coefficients(record: dict, index_map: IndexMap, dtype) -> Coefficients:
     d = index_map.size
     means = np.zeros((d,), dtype=dtype)
@@ -227,17 +325,40 @@ def save_game_model(
                 np.asarray(model.variances) if model.variances is not None else None
             )
             keys = [str(k) for k in np.asarray(model.entity_keys).tolist()]
+            active_cols = (
+                np.asarray(model.active_cols)
+                if model.active_cols is not None else None
+            )
 
             def records() -> Iterable[dict]:
                 for i, key in enumerate(keys):
                     # NaN rows mark "no variance computed" for this entity
                     # (e.g. below active_data_lower_bound) — drop the field
-                    # rather than persist a false number
+                    # rather than persist a false number. Compact rows check
+                    # only the ACTIVE slots (their pad slots are NaN by
+                    # construction and are never written to disk anyway).
                     var_row = None
-                    if var_table is not None and bool(
-                        np.all(np.isfinite(var_table[i]))
-                    ):
-                        var_row = var_table[i]
+                    if var_table is not None:
+                        if active_cols is not None:
+                            live = active_cols[i] < model.dim
+                            finite = bool(
+                                np.all(np.isfinite(var_table[i][live]))
+                            ) if live.any() else False
+                        else:
+                            finite = bool(np.all(np.isfinite(var_table[i])))
+                        if finite:
+                            var_row = var_table[i]
+                    if active_cols is not None:
+                        # compact rows: table slot j is GLOBAL column
+                        # active_cols[i, j]; the wire format is already
+                        # per-feature name-term-value, so compact and dense
+                        # models are indistinguishable on disk
+                        yield _compact_row_to_record(
+                            key, table[i], active_cols[i], var_row,
+                            model.task, index_map, sparsity_threshold,
+                            model.dim,
+                        )
+                        continue
                     glm = GeneralizedLinearModel(
                         Coefficients(means=table[i], variances=var_row),
                         model.task,
@@ -289,6 +410,7 @@ def load_game_model(
     *,
     coordinates_to_load: set[str] | None = None,
     dtype=np.float32,
+    compact_random_effect_threshold: int = 1_000_000,
 ) -> GameModel:
     """Load a GAME model saved in the reference layout.
 
@@ -301,6 +423,7 @@ def load_game_model(
     return load_game_model_and_index_maps(
         models_dir, index_maps,
         coordinates_to_load=coordinates_to_load, dtype=dtype,
+        compact_random_effect_threshold=compact_random_effect_threshold,
     )[0]
 
 
@@ -310,6 +433,7 @@ def load_game_model_and_index_maps(
     *,
     coordinates_to_load: set[str] | None = None,
     dtype=np.float32,
+    compact_random_effect_threshold: int = 1_000_000,
 ) -> tuple[GameModel, dict[str, IndexMap]]:
     """Like :func:`load_game_model` but also returns the index maps in use —
     callers that need the maps afterwards (e.g. to read scoring data in the
@@ -389,6 +513,13 @@ def load_game_model_and_index_maps(
                 )
                 continue
             records = read_records(coeff_dir)
+            if index_map.size > compact_random_effect_threshold:
+                # giant-d_re coordinate: never materialize [E, dim] — load
+                # straight into the compact [E, K] active-column layout
+                models[name] = _load_compact_random_effect(
+                    records, re_type, shard_id, index_map, task, dtype
+                )
+                continue
             keys = sorted(r["modelId"] for r in records)
             row = {k: i for i, k in enumerate(keys)}
             table = np.zeros((len(keys), index_map.size), dtype=dtype)

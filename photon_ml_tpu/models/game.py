@@ -72,18 +72,47 @@ class RandomEffectModel(DatumScoringModel):
     feature_shard_id: str
     task: TaskType
     variances: Array | None = None
+    #: compact (giant-d_re) mode: coefficients are [E, K] over each entity's
+    #: sorted active GLOBAL columns (active_cols [E, K] int32, pad =
+    #: feature_dim); set feature_dim to the true shard width
+    active_cols: np.ndarray | None = None
+    feature_dim: int | None = None
 
     @property
     def num_entities(self) -> int:
         return self.coefficients.shape[0]
 
     @property
+    def is_compact(self) -> bool:
+        return self.active_cols is not None
+
+    @property
     def dim(self) -> int:
+        if self.active_cols is not None:
+            return int(self.feature_dim)
         return self.coefficients.shape[1]
 
     def score_dataset(self, dataset) -> Array:
         features = dataset.shard_features(self.feature_shard_id)
         entity_idx = dataset.entity_indices(self.random_effect_type)
+        if self.active_cols is not None:
+            from photon_ml_tpu.data.sparse_batch import SparseShard
+
+            if not isinstance(features, SparseShard):
+                raise TypeError(
+                    f"compact random-effect model '{self.random_effect_type}'"
+                    " scores sparse feature shards; this dataset's shard "
+                    f"'{self.feature_shard_id}' is dense"
+                )
+            ent, pos, rows, vals = compact_entry_positions(
+                features, np.asarray(entity_idx), self.active_cols
+            )
+            return score_random_effect_compact(
+                self.coefficients,
+                jnp.asarray(ent), jnp.asarray(pos),
+                jnp.asarray(rows), jnp.asarray(vals),
+                dataset.num_samples,
+            )
         return score_random_effect(self.coefficients, features, entity_idx)
 
     def with_coefficients(self, coefficients: Array) -> "RandomEffectModel":
@@ -106,6 +135,81 @@ def score_random_effect(table: Array, features: Array, entity_idx: Array) -> Arr
     rows = table[safe_idx]
     scores = jnp.einsum("nd,nd->n", features, rows)
     return jnp.where(entity_idx >= 0, scores, 0.0)
+
+
+def compact_entry_positions(
+    shard, entity_idx: np.ndarray, active_cols: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Map each COO entry of ``shard`` to its position in its sample's
+    entity's active-column list (host precompute for compact RE scoring).
+
+    Returns (ent [nnz], pos [nnz], rows [nnz], vals [nnz]): entry k of
+    sample i with column j scores vals·table[ent, pos]; pos = K (the
+    scratch/zero slot) when j is not among entity's active columns or the
+    sample's entity is unseen (idx < 0) — those entries contribute 0, the
+    reference's untrained-column semantics. Cached on the shard keyed by
+    the active-column content.
+    """
+    import hashlib
+
+    # key on BOTH inputs: the same shard object can appear in datasets with
+    # different sample/entity mappings (a stale entity_idx would silently
+    # score the wrong samples)
+    key = (
+        active_cols.shape,
+        hashlib.sha1(np.ascontiguousarray(active_cols)).hexdigest(),
+        hashlib.sha1(
+            np.ascontiguousarray(np.asarray(entity_idx, dtype=np.int64))
+        ).hexdigest(),
+    )
+    cache = getattr(shard, "_compact_pos_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(shard, "_compact_pos_cache", cache)
+    if key in cache:
+        return cache[key]
+
+    rows_s, cols_s, vals_s = shard.coalesced()
+    rows_s = np.asarray(rows_s)
+    cols_s = np.asarray(cols_s)
+    vals_s = np.asarray(vals_s)
+    e, k = active_cols.shape
+    dimp = int(shard.feature_dim) + 1
+    ent = entity_idx[rows_s].astype(np.int64)
+    valid = ent >= 0
+    ent_safe = np.where(valid, ent, 0)
+    keys = ent_safe * dimp + cols_s
+    # active_cols rows are sorted ascending with pads == dim at the end, so
+    # the flattened (entity*(dim+1) + col) keys are globally non-decreasing
+    flat = (
+        (np.arange(e, dtype=np.int64) * dimp)[:, None] + active_cols
+    ).ravel()
+    idx = np.clip(np.searchsorted(flat, keys), 0, max(e * k - 1, 0))
+    hit = (flat[idx] == keys) if e * k else np.zeros(len(keys), bool)
+    pos = np.where(hit & valid, idx - ent_safe * k, k).astype(np.int32)
+    out = (
+        ent_safe.astype(np.int32), pos,
+        rows_s.astype(np.int32), vals_s,
+    )
+    cache[key] = out
+    return out
+
+
+def score_random_effect_compact(
+    table: Array, ent: Array, pos: Array, rows: Array, vals: Array, n: int
+) -> Array:
+    """scores from a compact [E, K] table: one gather over the entry-to-
+    table-slot mapping + a row segment-sum — O(nnz), nothing of size d_re.
+    """
+    if table.shape[0] == 0:
+        return jnp.zeros((n,), dtype=vals.dtype)
+    table_ext = jnp.concatenate(
+        [table, jnp.zeros((table.shape[0], 1), table.dtype)], axis=1
+    )
+    contrib = vals * table_ext[ent, pos]
+    return jax.ops.segment_sum(
+        contrib, rows, num_segments=n, indices_are_sorted=True
+    )
 
 
 @dataclasses.dataclass(frozen=True)

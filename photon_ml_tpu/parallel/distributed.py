@@ -46,7 +46,7 @@ from photon_ml_tpu.algorithm.mf_coordinate import solve_mf_side_bucket
 from photon_ml_tpu.models.matrix_factorization import score_matrix_factorization
 from photon_ml_tpu.data.batch import LabeledPointBatch
 from photon_ml_tpu.data.game_data import GameDataset, RandomEffectDataset
-from photon_ml_tpu.data.sparse_batch import sparse_margins
+from photon_ml_tpu.data.sparse_batch import SparseShard, sparse_margins
 from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
 from photon_ml_tpu.models.game import score_random_effect
 from photon_ml_tpu.projector.projectors import ProjectorType
@@ -141,17 +141,15 @@ def _data_pytree(dataset: GameDataset, re_specs: Sequence[RandomEffectStepSpec],
     )
 
     fe_sparse = isinstance(dataset.feature_shards[fe_shard], SparseShard)
-    re_shards = {s.feature_shard_id for s in re_specs}
+    # sparse RE shards ride as compact per-entry mappings (see
+    # prepare_inputs), never as dense blocks
     for k in shards:
-        if isinstance(dataset.feature_shards[k], SparseShard) and (
-            k != fe_shard or k in re_shards
-        ):
-            raise ValueError(
-                f"feature shard '{k}' is sparse (giant-d); only the "
-                "FIXED-EFFECT coordinate of the fused GameTrainProgram "
-                "supports sparse shards — random-effect/MF coordinates "
-                "consume dense [n, d] blocks."
-            )
+        if isinstance(dataset.feature_shards[k], SparseShard) and k != fe_shard:
+            if k not in {s.feature_shard_id for s in re_specs}:
+                raise ValueError(
+                    f"feature shard '{k}' is sparse (giant-d) but is not "
+                    "the fixed-effect shard or a random-effect shard"
+                )
     labels = jnp.asarray(dataset.labels)
     weights = jnp.asarray(dataset.weights)
     data = {
@@ -161,7 +159,7 @@ def _data_pytree(dataset: GameDataset, re_specs: Sequence[RandomEffectStepSpec],
         "features": {
             k: jnp.asarray(dataset.feature_shards[k])
             for k in shards
-            if not (k == fe_shard and fe_sparse)
+            if not isinstance(dataset.feature_shards[k], SparseShard)
         },
         "entity_idx": {
             t: jnp.asarray(dataset.entity_idx[t]) for t in sorted(id_types)
@@ -329,7 +327,8 @@ class GameTrainProgram:
         dtype = dtype or dataset.feature_shards[self.fe.feature_shard_id].dtype
         tables = {
             s.re_type: jnp.zeros(
-                (re_datasets[s.re_type].num_entities, re_datasets[s.re_type].dim),
+                (re_datasets[s.re_type].num_entities,
+                 re_datasets[s.re_type].table_width),  # K in compact mode
                 dtype=dtype,
             )
             for s in self.re_specs
@@ -354,12 +353,45 @@ class GameTrainProgram:
             mf_cols=mf_cols,
         )
 
+    def _attach_re_sparse(self, data: dict, dataset: GameDataset,
+                          re_datasets: Mapping[str, RandomEffectDataset]):
+        """Compact (sparse-shard) RE coordinates: per-entry (entity, table
+        position, row, value) mappings for O(nnz) scoring inside the step
+        (models/game.compact_entry_positions against the TRAINING
+        active-column lists)."""
+        from photon_ml_tpu.models.game import compact_entry_positions
+
+        for s in self.re_specs:
+            shard = dataset.feature_shards[s.feature_shard_id]
+            ds = re_datasets.get(s.re_type) if re_datasets else None
+            if not isinstance(shard, SparseShard):
+                continue
+            if ds is None or ds.active_cols is None:
+                raise ValueError(
+                    f"random-effect coordinate '{s.re_type}' uses a sparse "
+                    "feature shard; its RandomEffectDataset (with "
+                    "active_cols) is required to prepare inputs"
+                )
+            ent, pos, rows, vals = compact_entry_positions(
+                shard,
+                np.asarray(dataset.host_array(f"entity_idx/{s.re_type}")),
+                ds.active_cols,
+            )
+            data.setdefault("re_sparse", {})[s.re_type] = {
+                "ent": jnp.asarray(ent),
+                "pos": jnp.asarray(pos),
+                "rows": jnp.asarray(rows),
+                "vals": jnp.asarray(vals),
+            }
+        return data
+
     def prepare_inputs(self, dataset: GameDataset,
                        re_datasets: Mapping[str, RandomEffectDataset],
                        mf_datasets: Mapping[str, "MFDataset"] | None = None):
         data = _data_pytree(
             dataset, self.re_specs, self.fe.feature_shard_id, self.mf_specs
         )
+        data = self._attach_re_sparse(data, dataset, re_datasets)
         buckets = _buckets_pytree(
             {s.re_type: re_datasets[s.re_type] for s in self.re_specs},
             self.re_specs,
@@ -439,6 +471,28 @@ class GameTrainProgram:
                         col_bounds=put(sb.col_bounds, NamedSharding(mesh, P()))
                     )
             data["fe_sparse_batch"] = sb
+        if "re_sparse" in data:
+            # compact RE entry mappings: nnz axis over "data"; pads carry
+            # value 0 + the last row id (keeps the row segment-sum's sorted
+            # promise) + entity 0 (their zero values contribute nothing)
+            placed = {}
+            for k, sp in data["re_sparse"].items():
+                nnz = int(sp["vals"].shape[0])
+                pad = (-nnz) % data_axis
+                if pad:
+                    last_row = (
+                        sp["rows"][-1:] if nnz else jnp.zeros(1, jnp.int32)
+                    )
+                    sp = {
+                        "ent": jnp.pad(sp["ent"], (0, pad)),
+                        "pos": jnp.pad(sp["pos"], (0, pad)),
+                        "rows": jnp.concatenate(
+                            [sp["rows"], jnp.broadcast_to(last_row, (pad,))]
+                        ),
+                        "vals": jnp.pad(sp["vals"], (0, pad)),
+                    }
+                placed[k] = {n_: put(v, vec) for n_, v in sp.items()}
+            data["re_sparse"] = placed
         return data
 
     def shard_inputs(self, mesh: Mesh, data, buckets, state,
@@ -543,13 +597,19 @@ class GameTrainProgram:
 
     # -- whole-model scoring (validation / best-model tracking) --------------
 
-    def prepare_scoring_inputs(self, dataset: GameDataset) -> dict:
+    def prepare_scoring_inputs(
+        self, dataset: GameDataset,
+        re_datasets: Mapping[str, RandomEffectDataset] | None = None,
+    ) -> dict:
         """Data pytree for :meth:`score` over an arbitrary dataset (e.g. the
         validation split) — same layout the training step consumes, no
-        entity buckets needed."""
-        return _data_pytree(
+        entity buckets needed. Compact (sparse-shard) RE coordinates need
+        ``re_datasets`` (the TRAINING datasets: their active-column lists
+        define the table layout being scored)."""
+        data = _data_pytree(
             dataset, self.re_specs, self.fe.feature_shard_id, self.mf_specs
         )
+        return self._attach_re_sparse(data, dataset, re_datasets or {})
 
     def shard_scoring_inputs(self, mesh: Mesh, data, *,
                              fe_feature_sharded: bool = False, put_fn=None):
@@ -581,6 +641,16 @@ class GameTrainProgram:
         """Tables hold normalized-space coefficients when the coordinate is
         normalized; score through the effective-coefficient algebra
         (factors only — shifts are rejected at construction)."""
+        sp = data.get("re_sparse", {}).get(k)
+        if sp is not None:
+            # compact [E, K] table over per-entity active columns
+            # (normalization is rejected for projected/compact coordinates)
+            from photon_ml_tpu.models.game import score_random_effect_compact
+
+            return score_random_effect_compact(
+                table, sp["ent"], sp["pos"], sp["rows"], sp["vals"],
+                data["labels"].shape[0],
+            )
         eff = self._re_objectives[k].normalization.effective_coefficients(table)
         return score_random_effect(
             eff, data["features"][shard_id], data["entity_idx"][k]
@@ -930,6 +1000,16 @@ def state_to_game_model(
         # models are always persisted in original space (factors only, so
         # no intercept index is needed)
         re_norm = program._re_objectives[spec.re_type].normalization
+        ds = (re_datasets or {}).get(spec.re_type)
+        is_compact = ds is not None and ds.active_cols is not None
+        if isinstance(
+            dataset.feature_shards[spec.feature_shard_id], SparseShard
+        ) and not is_compact:
+            raise ValueError(
+                f"random-effect coordinate '{spec.re_type}' trained on a "
+                "sparse shard; pass its RandomEffectDataset via re_datasets "
+                "so the compact model keeps its active-column lists"
+            )
         models[spec.re_type] = RandomEffectModel(
             coefficients=re_norm.to_model_space(state.re_tables[spec.re_type]),
             entity_keys=dataset.entity_vocabs[spec.re_type],
@@ -937,6 +1017,8 @@ def state_to_game_model(
             feature_shard_id=spec.feature_shard_id,
             task=program.task,
             variances=re_variances.get(spec.re_type),
+            active_cols=ds.active_cols if is_compact else None,
+            feature_dim=ds.dim if is_compact else None,
         )
     for m in program.mf_specs:
         models[m.name] = MatrixFactorizationModel(
@@ -949,6 +1031,34 @@ def state_to_game_model(
             task=program.task,
         )
     return GameModel(models=models)
+
+
+def _remap_compact_rows(
+    values: np.ndarray,
+    model_cols: np.ndarray | None,
+    target_cols: np.ndarray,
+    dim: int,
+) -> np.ndarray:
+    """Re-key per-entity coefficient rows onto new active-column lists.
+
+    values: [E, Km] compact (with model_cols [E, Km], sorted, pad=dim) or
+    [E, dim] dense (model_cols None). target_cols: [E, Kt] sorted pad=dim.
+    Returns [E, Kt]; columns absent from the source row are 0.
+    """
+    e, kt = target_cols.shape
+    if model_cols is None:  # dense source: plain per-row gather
+        safe = np.minimum(target_cols, dim - 1)
+        out = values[np.arange(e)[:, None], safe]
+        return (out * (target_cols < dim)).astype(values.dtype)
+    km = model_cols.shape[1]
+    dimp = dim + 1
+    base = (np.arange(e, dtype=np.int64) * dimp)[:, None]
+    flat = (base + model_cols).ravel()
+    keys = (base + target_cols).ravel()
+    idx = np.clip(np.searchsorted(flat, keys), 0, max(e * km - 1, 0))
+    hit = (flat[idx] == keys) & (keys % dimp < dim)
+    out = np.where(hit, values.ravel()[idx], 0.0).reshape(e, kt)
+    return out.astype(values.dtype)
 
 
 def game_model_to_state(
@@ -1021,8 +1131,9 @@ def game_model_to_state(
     re_tables = {}
     for spec in program.re_specs:
         m = coordinate_model(spec.re_type)
+        ds = (re_datasets or {}).get(spec.re_type)
+        ds_compact = ds is not None and ds.active_cols is not None
         if m is None:
-            ds = (re_datasets or {}).get(spec.re_type)
             if ds is None:
                 raise ValueError(
                     f"missing_ok warm start: coordinate '{spec.re_type}' is "
@@ -1030,13 +1141,41 @@ def game_model_to_state(
                     "cold-start table"
                 )
             re_tables[spec.re_type] = jnp.zeros(
-                (ds.num_entities, ds.dim), dtype=fe_w.dtype
+                (ds.num_entities, ds.table_width), dtype=fe_w.dtype
             )
             continue
         aligned = align(
             m.coefficients, m.entity_keys,
             dataset.entity_vocabs[spec.re_type], spec.re_type,
         )
+        if ds_compact or getattr(m, "active_cols", None) is not None:
+            # compact-layout warm starts re-key per entity from the model's
+            # active columns to the dataset's (a grid re-fit on the same
+            # data keeps identical lists; cross-dataset fits remap, columns
+            # absent from the new list are dropped, new ones start at 0)
+            if ds is None or ds.active_cols is None:
+                raise ValueError(
+                    f"warm-start model for '{spec.re_type}' is compact but "
+                    "the program's dataset is dense — incompatible layouts"
+                )
+            model_cols = None
+            if getattr(m, "active_cols", None) is not None:
+                # align the model's column lists to the dataset vocab order
+                model_cols = np.asarray(align(
+                    m.active_cols, m.entity_keys,
+                    dataset.entity_vocabs[spec.re_type], spec.re_type,
+                )).astype(np.int64)
+                # rows absent from the model aligned to all-zeros — make
+                # them all-pads instead so nothing matches
+                absent = ~np.isin(
+                    np.asarray(dataset.entity_vocabs[spec.re_type]).astype(str),
+                    np.asarray(m.entity_keys).astype(str),
+                )
+                model_cols[absent] = ds.dim
+            aligned = jnp.asarray(_remap_compact_rows(
+                np.asarray(aligned), model_cols,
+                np.asarray(ds.active_cols, dtype=np.int64), ds.dim,
+            ))
         re_norm = program._re_objectives[spec.re_type].normalization
         re_tables[spec.re_type] = re_norm.from_model_space(aligned)
     mf_rows, mf_cols = {}, {}
@@ -1281,7 +1420,9 @@ def train_distributed(
     val_data = None
     evaluators = list(validation_evaluators)
     if validation_dataset is not None and evaluators and validation_eval_data is not None:
-        val_data = program.prepare_scoring_inputs(validation_dataset)
+        val_data = program.prepare_scoring_inputs(
+            validation_dataset, re_datasets
+        )
 
     # true entity counts, to slice off any mesh-padding rows on the way out
     table_sizes = {

@@ -610,8 +610,13 @@ class TestSparseFixedEffectFusedStep:
             rtol=1e-7, atol=1e-10,
         )
 
-    def test_sparse_re_shard_rejected(self, rng):
+    def test_sparse_re_shard_needs_compact_dataset(self, rng):
+        """Sparse RE shards train compact (r3, test_sparse_random_effects);
+        preparing inputs without the compact RandomEffectDataset (its
+        active-column lists define the table layout) must fail loudly, not
+        silently score zeros."""
         from photon_ml_tpu.data.sparse_batch import SparseShard
+        from photon_ml_tpu.projector.projectors import ProjectorType
 
         n = 32
         x = np.eye(n, 4)
@@ -626,10 +631,11 @@ class TestSparseFixedEffectFusedStep:
         program = GameTrainProgram(
             TaskType.LINEAR_REGRESSION,
             FixedEffectStepSpec("e", opt),
-            (RandomEffectStepSpec("user", "e", opt),),
+            (RandomEffectStepSpec("user", "e", opt,
+                                  projector=ProjectorType.INDEX_MAP),),
         )
-        with pytest.raises(ValueError, match="FIXED-EFFECT"):
-            program.prepare_inputs(ds, {"user": None}, None)
+        with pytest.raises(ValueError, match="active_cols"):
+            program.prepare_scoring_inputs(ds)
 
 
 def test_fused_step_compile_time_budget(rng):

@@ -635,7 +635,9 @@ class TestShardIntegration:
         with pytest.raises(ValueError, match="dense Hessian"):
             coord.update_model(coord.initial_model())
 
-    def test_random_effect_on_sparse_shard_raises(self):
+    def test_random_effect_on_sparse_shard_builds_compact(self):
+        """r3: sparse RE shards build the compact per-entity representation
+        instead of raising (full coverage in test_sparse_random_effects)."""
         from photon_ml_tpu.data.game_data import (
             build_game_dataset,
             build_random_effect_dataset,
@@ -653,8 +655,8 @@ class TestShardIntegration:
             labels=np.zeros(n), feature_shards={"g": shard},
             entity_keys={"user": np.array([f"u{i % 4}" for i in range(n)])},
         )
-        with pytest.raises(TypeError, match="sparse"):
-            build_random_effect_dataset(ds, "user", "g", bucket_sizes=(32,))
+        red = build_random_effect_dataset(ds, "user", "g", bucket_sizes=(32,))
+        assert red.is_compact and red.num_entities == 4
 
     def test_driver_end_to_end_sparse_shard(self, tmp_path):
         from photon_ml_tpu.cli import game_training_driver

@@ -1,0 +1,261 @@
+"""Sparse (giant-d_re) random effects: compact per-entity blocks.
+
+VERDICT r2 #6: the reference trains each entity on its observed feature
+support (IndexMapProjectorRDD.scala:218-257, LocalDataSet.scala:36-173);
+here a sparse RE shard builds [E, K] compact coefficient tables over
+per-entity active columns — never materializing [E, d_re] — trained by the
+existing INDEX_MAP bucket solver in BOTH the CD and fused mesh paths.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.algorithm.coordinates import CoordinateOptimizationConfig
+from photon_ml_tpu.data.game_data import (
+    build_game_dataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.data.sparse_batch import SparseShard
+from photon_ml_tpu.estimators import (
+    FixedEffectCoordinateConfig,
+    GameEstimator,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.models.game import (
+    compact_entry_positions,
+    score_random_effect,
+    score_random_effect_compact,
+)
+from photon_ml_tpu.optim.optimizer import OptimizerConfig
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.projector.projectors import ProjectorType
+from photon_ml_tpu.types import TaskType
+
+
+def _make(n=300, d_re=4000, E=15, support=5, seed=0, vocabs=None):
+    """Synthetic GAME data whose RE shard is sparse: each entity touches
+    only its own small column set."""
+    rng = np.random.default_rng(seed)
+    users = np.array([f"u{i}" for i in rng.integers(0, E, size=n)])
+    ui = np.array([int(u[1:]) for u in users])
+    truth = np.random.default_rng(99)
+    ent_cols = {e: np.sort(truth.choice(d_re, size=support, replace=False))
+                for e in range(E)}
+    w_true = {e: truth.normal(size=support) for e in range(E)}
+    xg = rng.normal(size=(n, 4))
+    wg = truth.normal(size=4)
+    rows, cols, vals = [], [], []
+    y = np.zeros(n)
+    for i in range(n):
+        e = ui[i]
+        xv = rng.normal(size=support)
+        rows += [i] * support
+        cols += list(ent_cols[e])
+        vals += list(xv)
+        y[i] = xg[i] @ wg + xv @ w_true[e] + 0.05 * rng.normal()
+    shard = SparseShard(
+        rows=np.array(rows), cols=np.array(cols),
+        vals=np.array(vals, dtype=np.float64),
+        num_samples=n, feature_dim=d_re,
+    )
+    ds = build_game_dataset(
+        labels=y, feature_shards={"global": xg, "re": shard},
+        entity_keys={"userId": users}, dtype=np.float64,
+        entity_vocabs=vocabs,
+    )
+    return ds, ent_cols, w_true
+
+
+OPT = CoordinateOptimizationConfig(
+    optimizer=OptimizerConfig(max_iterations=30), l2_weight=0.1
+)
+CONFIGS = {
+    "fe": FixedEffectCoordinateConfig("global", OPT),
+    "per-user": RandomEffectCoordinateConfig("userId", "re", OPT),
+}
+
+
+class TestCompactBuilder:
+    def test_active_cols_match_entity_support(self):
+        ds, ent_cols, _ = _make()
+        red = build_random_effect_dataset(ds, "userId", "re")
+        assert red.is_compact
+        assert red.projector_type == ProjectorType.INDEX_MAP
+        assert red.table_width == 5
+        row_of = {k: i for i, k in enumerate(ds.entity_vocabs["userId"])}
+        for e, cols in ent_cols.items():
+            got = np.asarray(red.active_cols[row_of[f"u{e}"]])
+            np.testing.assert_array_equal(got[got < red.dim], cols)
+
+    def test_random_projector_rejected(self):
+        ds, _, _ = _make()
+        with pytest.raises(ValueError, match="IDENTITY/INDEX_MAP"):
+            build_random_effect_dataset(
+                ds, "userId", "re",
+                projector_type=ProjectorType.RANDOM, projected_dim=3,
+            )
+
+    def test_pearson_rejected(self):
+        ds, _, _ = _make()
+        with pytest.raises(ValueError, match="Pearson"):
+            build_random_effect_dataset(
+                ds, "userId", "re", features_to_samples_ratio=0.5
+            )
+
+
+class TestCompactScoring:
+    def test_matches_dense_reference(self):
+        """Compact scoring == dense table scoring on the densified shard."""
+        ds, _, _ = _make(d_re=200)  # small enough to densify for reference
+        red = build_random_effect_dataset(ds, "userId", "re")
+        rng = np.random.default_rng(3)
+        e, k = red.active_cols.shape
+        table = rng.normal(size=(e, k))
+        # densify the compact table
+        dense = np.zeros((e, red.dim))
+        for i in range(e):
+            for j, c in enumerate(red.active_cols[i]):
+                if c < red.dim:
+                    dense[i, c] = table[i, j]
+        shard = ds.feature_shards["re"]
+        rows, cols, vals = shard.coalesced()
+        x = np.zeros((ds.num_samples, red.dim))
+        x[np.asarray(rows), np.asarray(cols)] = np.asarray(vals)
+        ref = score_random_effect(
+            jnp.asarray(dense), jnp.asarray(x), ds.entity_idx["userId"]
+        )
+        ent, pos, rws, vls = compact_entry_positions(
+            shard, np.asarray(ds.host_array("entity_idx/userId")),
+            red.active_cols,
+        )
+        got = score_random_effect_compact(
+            jnp.asarray(table), jnp.asarray(ent), jnp.asarray(pos),
+            jnp.asarray(rws), jnp.asarray(vls), ds.num_samples,
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-12)
+
+
+class TestCompactTraining:
+    def _fit(self, ds, mesh, val=None, initial_model=None, iters=2):
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinate_configs=CONFIGS,
+            num_iterations=iters,
+            validation_evaluators=("RMSE",) if val is not None else (),
+            mesh=mesh,
+        )
+        return est.fit(ds, validation_dataset=val, initial_model=initial_model)
+
+    def test_cd_recovers_entity_coefficients(self):
+        ds, ent_cols, w_true = _make()
+        res = self._fit(ds, None, val=ds)
+        assert res.best_metric < 0.15
+        m = res.model.get("per-user")
+        assert m.is_compact and m.dim == 4000
+        row_of = {k: i for i, k in enumerate(m.entity_keys)}
+        for e in (0, 7):
+            r = row_of[f"u{e}"]
+            k = np.asarray(m.active_cols[r])
+            mask = k < 4000
+            got = dict(zip(k[mask], np.asarray(m.coefficients[r])[mask]))
+            for c, w in zip(ent_cols[e], w_true[e]):
+                assert abs(got.get(c, 0.0) - w) < 0.3
+
+    def test_fused_matches_cd(self):
+        """Giant-d_re RE trains through the fused mesh path and agrees with
+        the CD path (the VERDICT's done-criterion)."""
+        ds, _, _ = _make(n=296)  # non-divisible by 8
+        cd = self._fit(ds, None, val=ds)
+        fused = self._fit(ds, make_mesh(), val=ds)
+        assert np.isclose(fused.best_metric, cd.best_metric, rtol=5e-3)
+        np.testing.assert_allclose(
+            np.asarray(fused.model.get("per-user").coefficients),
+            np.asarray(cd.model.get("per-user").coefficients),
+            atol=5e-3,
+        )
+
+    def test_sharding_invariance(self):
+        """1-device and 8-device meshes produce the same trained tables."""
+        ds, _, _ = _make(n=304)
+        r1 = self._fit(ds, make_mesh(data=1, model=1))
+        r8 = self._fit(ds, make_mesh())
+        np.testing.assert_allclose(
+            np.asarray(r1.model.get("per-user").coefficients),
+            np.asarray(r8.model.get("per-user").coefficients),
+            atol=1e-5,
+        )
+
+    def test_fused_warm_start_compact(self):
+        """Compact tables warm-start across fits (grid-style), re-keyed per
+        entity by active column."""
+        ds, _, _ = _make()
+        base = self._fit(ds, make_mesh(), val=ds, iters=2)
+        tiny = {
+            "fe": FixedEffectCoordinateConfig(
+                "global", CoordinateOptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=1), l2_weight=0.1
+                )
+            ),
+            "per-user": RandomEffectCoordinateConfig(
+                "userId", "re", CoordinateOptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=1), l2_weight=0.1
+                )
+            ),
+        }
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION, coordinate_configs=tiny,
+            num_iterations=1, validation_evaluators=("RMSE",),
+            mesh=make_mesh(),
+        )
+        warm = est.fit(ds, validation_dataset=ds, initial_model=base.model)
+        cold = est.fit(ds, validation_dataset=ds)
+        assert warm.best_metric < 1.2 * base.best_metric
+        assert warm.best_metric < 0.5 * cold.best_metric
+
+
+class TestCompactModelIO:
+    def test_save_load_round_trip(self, tmp_path):
+        from photon_ml_tpu.io.index_map import IndexMap
+        from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+
+        ds, _, _ = _make(d_re=500)
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION, coordinate_configs=CONFIGS,
+            num_iterations=1,
+        )
+        res = est.fit(ds)
+        index_maps = {
+            "global": IndexMap.from_keys([f"g{i}\x01" for i in range(4)]),
+            "re": IndexMap.from_keys([f"f{i}\x01" for i in range(500)]),
+        }
+        save_game_model(tmp_path / "m", res.model, index_maps,
+                        sparsity_threshold=0.0)
+
+        # compact load (threshold below dim) reproduces scores exactly
+        compact = load_game_model(
+            tmp_path / "m", index_maps, dtype=np.float64,
+            compact_random_effect_threshold=100,
+        )
+        assert compact.get("per-user").is_compact
+        # dense load (threshold above dim) reproduces them too
+        dense = load_game_model(
+            tmp_path / "m", index_maps, dtype=np.float64,
+            compact_random_effect_threshold=10_000,
+        )
+        assert not dense.get("per-user").is_compact
+        s0 = np.asarray(res.model.get("per-user").score_dataset(ds))
+        s1 = np.asarray(compact.get("per-user").score_dataset(ds))
+        np.testing.assert_allclose(s1, s0, atol=1e-9)
+        # dense model scoring needs a dense shard; check the tables agree
+        dt = np.asarray(dense.get("per-user").coefficients)
+        cm = compact.get("per-user")
+        for i in range(cm.num_entities):
+            cols = np.asarray(cm.active_cols[i])
+            mask = cols < 500
+            np.testing.assert_allclose(
+                dt[i][cols[mask]],
+                np.asarray(cm.coefficients[i])[mask], atol=1e-12,
+            )
