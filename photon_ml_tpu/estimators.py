@@ -295,8 +295,9 @@ class GameEstimator:
         - exactly one trainable fixed-effect coordinate is required;
         - locked coordinates contribute fixed score offsets (their models
           pass through to the output untouched);
-        - when any coordinate requests variances, post-hoc variances are
-          computed for ALL coordinates at the final (and best) state.
+        - variances are computed post-hoc at the final (and best) state:
+          for the random-effect coordinates that request them, plus the
+          fixed effect whenever any coordinate does.
         """
         from photon_ml_tpu.algorithm.coordinates import (
             ModelCoordinate,
@@ -452,7 +453,23 @@ class GameEstimator:
                 # the dataset's projector, not the config's: sparse shards
                 # coerce to the compact INDEX_MAP representation
                 projector=re_datasets[re_type].projector_type,
+                intercept_index=self.intercept_indices.get(cfg.feature_shard_id),
             ))
+
+        # fail variance-on-projected configs BEFORE the (possibly long)
+        # training run, not at model conversion afterwards (CD-path rule:
+        # only coordinates that REQUEST variances must be unprojected)
+        for spec in re_specs:
+            cid = re_cid_of_type[spec.re_type]
+            if (
+                self.coordinate_configs[cid].optimization.compute_variance
+                and spec.projector != ProjectorType.IDENTITY
+            ):
+                raise ValueError(
+                    f"random-effect coordinate '{cid}': variance computation "
+                    "is not supported with projected/compact coordinates "
+                    "(same rule as the coordinate-descent path)"
+                )
 
         program = GameTrainProgram(
             self.task,
@@ -574,6 +591,10 @@ class GameEstimator:
             self.coordinate_configs[cid].optimization.compute_variance
             for cid in sequence if cid not in locked
         )
+        variance_re_types = {
+            t for t, cid in re_cid_of_type.items()
+            if self.coordinate_configs[cid].optimization.compute_variance
+        }
 
         def to_game_model(state) -> GameModel:
             m = state_to_game_model(
@@ -582,6 +603,7 @@ class GameEstimator:
                 compute_variance=compute_var,
                 variance_mode=fe_cfg.optimization.variance_mode,
                 re_datasets=re_datasets,
+                variance_re_types=variance_re_types,
             )
             models_by_name = dict(m.models)
             if fe_pad:

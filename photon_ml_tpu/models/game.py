@@ -99,11 +99,19 @@ class RandomEffectModel(DatumScoringModel):
             from photon_ml_tpu.data.sparse_batch import SparseShard
 
             if not isinstance(features, SparseShard):
-                raise TypeError(
-                    f"compact random-effect model '{self.random_effect_type}'"
-                    " scores sparse feature shards; this dataset's shard "
-                    f"'{self.feature_shard_id}' is dense"
-                )
+                # dense shard, compact model (e.g. a giant model loaded
+                # compact scoring a small dense dataset): gather each
+                # sample's entity's active columns — O(n·K), no [E, d]
+                idx = jnp.asarray(entity_idx)
+                safe = jnp.maximum(idx, 0)
+                cols = jnp.asarray(self.active_cols, dtype=jnp.int32)[safe]
+                dim = int(self.feature_dim)
+                x = jnp.take_along_axis(
+                    jnp.asarray(features),
+                    jnp.minimum(cols, dim - 1), axis=1,
+                ) * (cols < dim)
+                scores = jnp.einsum("nk,nk->n", x, self.coefficients[safe])
+                return jnp.where(idx >= 0, scores, 0.0)
             ent, pos, rows, vals = compact_entry_positions(
                 features, np.asarray(entity_idx), self.active_cols
             )
@@ -135,6 +143,33 @@ def score_random_effect(table: Array, features: Array, entity_idx: Array) -> Arr
     rows = table[safe_idx]
     scores = jnp.einsum("nd,nd->n", features, rows)
     return jnp.where(entity_idx >= 0, scores, 0.0)
+
+
+def match_active_positions(
+    ent: np.ndarray, cols: np.ndarray, active_cols: np.ndarray, dim: int
+) -> np.ndarray:
+    """Position of each (entity, global column) query in the entity's sorted
+    active-column list, or K (the scratch slot) when absent.
+
+    The shared core of every compact-layout lookup (entry scoring, warm-start
+    remaps): encode (entity, col) as entity·(dim+1)+col — globally
+    non-decreasing because active_cols rows are sorted ascending with pads
+    == dim — and binary-search the flattened lists. Pad queries (col >= dim)
+    and negative entities resolve to K.
+    """
+    e, k = active_cols.shape
+    dimp = int(dim) + 1
+    ent = np.asarray(ent, dtype=np.int64)
+    valid = (ent >= 0) & (np.asarray(cols) < dim)
+    ent_safe = np.where(ent >= 0, ent, 0)
+    keys = ent_safe * dimp + np.asarray(cols, dtype=np.int64)
+    flat = (
+        (np.arange(e, dtype=np.int64) * dimp)[:, None]
+        + np.asarray(active_cols, dtype=np.int64)
+    ).ravel()
+    idx = np.clip(np.searchsorted(flat, keys), 0, max(e * k - 1, 0))
+    hit = (flat[idx] == keys) if e * k else np.zeros(len(keys), bool)
+    return np.where(hit & valid, idx - ent_safe * k, k).astype(np.int32)
 
 
 def compact_entry_positions(
@@ -173,22 +208,10 @@ def compact_entry_positions(
     rows_s = np.asarray(rows_s)
     cols_s = np.asarray(cols_s)
     vals_s = np.asarray(vals_s)
-    e, k = active_cols.shape
-    dimp = int(shard.feature_dim) + 1
     ent = entity_idx[rows_s].astype(np.int64)
-    valid = ent >= 0
-    ent_safe = np.where(valid, ent, 0)
-    keys = ent_safe * dimp + cols_s
-    # active_cols rows are sorted ascending with pads == dim at the end, so
-    # the flattened (entity*(dim+1) + col) keys are globally non-decreasing
-    flat = (
-        (np.arange(e, dtype=np.int64) * dimp)[:, None] + active_cols
-    ).ravel()
-    idx = np.clip(np.searchsorted(flat, keys), 0, max(e * k - 1, 0))
-    hit = (flat[idx] == keys) if e * k else np.zeros(len(keys), bool)
-    pos = np.where(hit & valid, idx - ent_safe * k, k).astype(np.int32)
+    pos = match_active_positions(ent, cols_s, active_cols, shard.feature_dim)
     out = (
-        ent_safe.astype(np.int32), pos,
+        np.where(ent >= 0, ent, 0).astype(np.int32), pos,
         rows_s.astype(np.int32), vals_s,
     )
     cache[key] = out

@@ -93,6 +93,10 @@ class RandomEffectStepSpec:
     optimizer: OptimizerConfig
     l2_weight: float = 0.0
     projector: ProjectorType = ProjectorType.IDENTITY
+    #: intercept column of the feature shard — required when the
+    #: coordinate's normalization carries shifts (STANDARDIZATION): model-
+    #: space conversion absorbs each entity's margin shift into it
+    intercept_index: int | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -271,21 +275,24 @@ class GameTrainProgram:
         self._fe_sparse_objective = SparseGLMObjective(
             loss, l2_weight=fe.l2_weight, normalization=normalization
         )
-        # RE normalization: factor scaling only. A margin *shift* would need
-        # per-shard intercept bookkeeping inside the fused program; the CD
-        # path is the place for standardized REs. This mirrors — and now
-        # replaces — the old silent no-normalization behavior with either
-        # real support (factors) or a loud error (shifts).
+        # RE normalization: the full factor+shift algebra. Factors scale the
+        # effective coefficients; shifts subtract each entity's margin-shift
+        # scalar in scoring (_re_coordinate_score) and are absorbed into the
+        # shard's intercept on model-space conversion — the spec must carry
+        # intercept_index then (same contract as the FE/CD paths,
+        # ValueAndGradientAggregator.scala:36-49).
         re_normalizations = dict(re_normalizations or {})
         for s in self.re_specs:
             ctx = re_normalizations.get(s.re_type)
-            if ctx is not None and ctx.shifts is not None:
+            if (
+                ctx is not None and ctx.shifts is not None
+                and s.intercept_index is None
+            ):
                 raise ValueError(
-                    f"random-effect coordinate '{s.re_type}': the fused step "
-                    "supports factor-scaling normalization only (no shifts / "
-                    "STANDARDIZATION). Use SCALE_WITH_STANDARD_DEVIATION / "
-                    "SCALE_WITH_MAX_MAGNITUDE, or train through the "
-                    "coordinate-descent path."
+                    f"random-effect coordinate '{s.re_type}': normalization "
+                    "with shifts (STANDARDIZATION) requires the spec's "
+                    "intercept_index (the intercept absorbs each entity's "
+                    "margin shift in model space)"
                 )
             if ctx is not None and s.projector != ProjectorType.IDENTITY:
                 raise ValueError(
@@ -639,8 +646,9 @@ class GameTrainProgram:
     def _re_coordinate_score(self, data, k: str, table: Array,
                              shard_id: str) -> Array:
         """Tables hold normalized-space coefficients when the coordinate is
-        normalized; score through the effective-coefficient algebra
-        (factors only — shifts are rejected at construction)."""
+        normalized; score through the full effective-coefficient algebra
+        (factor scaling, and the per-entity margin-shift term for
+        standardized coordinates)."""
         sp = data.get("re_sparse", {}).get(k)
         if sp is not None:
             # compact [E, K] table over per-entity active columns
@@ -651,10 +659,19 @@ class GameTrainProgram:
                 table, sp["ent"], sp["pos"], sp["rows"], sp["vals"],
                 data["labels"].shape[0],
             )
-        eff = self._re_objectives[k].normalization.effective_coefficients(table)
-        return score_random_effect(
+        norm = self._re_objectives[k].normalization
+        eff = norm.effective_coefficients(table)
+        scores = score_random_effect(
             eff, data["features"][shard_id], data["entity_idx"][k]
         )
+        if norm.shifts is not None:
+            # per-entity margin-shift scalar: (w_e ⊙ f) · shifts
+            idx = data["entity_idx"][k]
+            ent_shift = eff @ norm.shifts
+            scores = scores - jnp.where(
+                idx >= 0, ent_shift[jnp.maximum(idx, 0)], 0.0
+            )
+        return scores
 
     def _fe_margin_score(self, data, fe_w: Array) -> Array:
         """The FE coordinate's pure margin (no offsets) from normalized-space
@@ -837,8 +854,14 @@ def compute_state_variances(
     re_datasets: Mapping[str, RandomEffectDataset] | None = None,
     *,
     variance_mode: str = "auto",
+    re_types: "set[str] | None" = None,
 ) -> tuple[Array, dict[str, Array]]:
     """Post-hoc coefficient variances for a fused-trained state.
+
+    ``re_types`` selects which random-effect coordinates get variances
+    (None = all) — only SELECTED coordinates must satisfy the
+    no-projection rule, matching the CD path's per-coordinate
+    compute_variance semantics.
 
     The reference computes variances inside each optimization problem at
     the optimum (DistributedOptimizationProblem.computeVariances for the
@@ -865,6 +888,10 @@ def compute_state_variances(
 
     # fail configuration errors BEFORE any device work (CD-path convention)
     validate_variance_mode(variance_mode)
+    selected = [
+        s for s in program.re_specs
+        if re_types is None or s.re_type in re_types
+    ]
     if program.re_specs:
         missing = [
             s.re_type for s in program.re_specs
@@ -875,17 +902,20 @@ def compute_state_variances(
                 "compute_state_variances needs re_datasets entries for the "
                 f"program's random-effect coordinates; missing: {missing}"
             )
-        for spec in program.re_specs:
+        for spec in selected:
             if spec.projector != ProjectorType.IDENTITY:
                 raise ValueError(
                     f"random-effect coordinate '{spec.re_type}': variance "
-                    "computation is not supported with projected coordinates "
-                    "(same rule as the coordinate-descent path)"
+                    "computation is not supported with projected/compact "
+                    "coordinates (same rule as the coordinate-descent path)"
                 )
 
     data = _data_pytree(
         dataset, program.re_specs, program.fe.feature_shard_id, program.mf_specs
     )
+    # compact RE coordinates score through their entry mappings even here
+    # (their scores are residual offsets for the other coordinates' Hessians)
+    data = program._attach_re_sparse(data, dataset, re_datasets or {})
     base_offsets = data["offsets"]
     labels, weights = data["labels"], data["weights"]
     fe_sparse = data.get("fe_sparse_batch")
@@ -922,7 +952,7 @@ def compute_state_variances(
     )
 
     re_variances: dict[str, Array] = {}
-    for spec in program.re_specs:
+    for spec in selected:
         ds = re_datasets[spec.re_type]
         objective = program._re_objectives[spec.re_type]
         table = state.re_tables[spec.re_type]
@@ -955,6 +985,7 @@ def state_to_game_model(
     compute_variance: bool = False,
     variance_mode: str = "auto",
     re_datasets: Mapping[str, RandomEffectDataset] | None = None,
+    variance_re_types: "set[str] | None" = None,
 ):
     """Convert a fused-step ``GameTrainState`` into a ``GameModel`` so
     multi-chip-trained models flow into the standard persistence/scoring
@@ -984,7 +1015,8 @@ def state_to_game_model(
     re_variances: dict[str, Array] = {}
     if compute_variance:
         fe_variances, re_variances = compute_state_variances(
-            program, state, dataset, re_datasets, variance_mode=variance_mode
+            program, state, dataset, re_datasets, variance_mode=variance_mode,
+            re_types=variance_re_types,
         )
 
     models: dict[str, object] = {}
@@ -1011,7 +1043,9 @@ def state_to_game_model(
                 "so the compact model keeps its active-column lists"
             )
         models[spec.re_type] = RandomEffectModel(
-            coefficients=re_norm.to_model_space(state.re_tables[spec.re_type]),
+            coefficients=re_norm.to_model_space(
+                state.re_tables[spec.re_type], spec.intercept_index
+            ),
             entity_keys=dataset.entity_vocabs[spec.re_type],
             random_effect_type=spec.re_type,
             feature_shard_id=spec.feature_shard_id,
@@ -1045,20 +1079,20 @@ def _remap_compact_rows(
     [E, dim] dense (model_cols None). target_cols: [E, Kt] sorted pad=dim.
     Returns [E, Kt]; columns absent from the source row are 0.
     """
+    from photon_ml_tpu.models.game import match_active_positions
+
     e, kt = target_cols.shape
     if model_cols is None:  # dense source: plain per-row gather
         safe = np.minimum(target_cols, dim - 1)
         out = values[np.arange(e)[:, None], safe]
         return (out * (target_cols < dim)).astype(values.dtype)
     km = model_cols.shape[1]
-    dimp = dim + 1
-    base = (np.arange(e, dtype=np.int64) * dimp)[:, None]
-    flat = (base + model_cols).ravel()
-    keys = (base + target_cols).ravel()
-    idx = np.clip(np.searchsorted(flat, keys), 0, max(e * km - 1, 0))
-    hit = (flat[idx] == keys) & (keys % dimp < dim)
-    out = np.where(hit, values.ravel()[idx], 0.0).reshape(e, kt)
-    return out.astype(values.dtype)
+    ent = np.repeat(np.arange(e, dtype=np.int64), kt)
+    pos = match_active_positions(ent, target_cols.ravel(), model_cols, dim)
+    vals_ext = np.concatenate(
+        [values, np.zeros((e, 1), values.dtype)], axis=1
+    )
+    return vals_ext[ent, pos].reshape(e, kt).astype(values.dtype)
 
 
 def game_model_to_state(
@@ -1148,16 +1182,28 @@ def game_model_to_state(
             m.coefficients, m.entity_keys,
             dataset.entity_vocabs[spec.re_type], spec.re_type,
         )
-        if ds_compact or getattr(m, "active_cols", None) is not None:
+        model_compact = getattr(m, "active_cols", None) is not None
+        if model_compact and not ds_compact:
+            # compact model warm-starting a DENSE dataset: expand each
+            # entity's active columns into a dense row (the dataset being
+            # dense means dim is materializable by definition)
+            mc = np.asarray(align(
+                m.active_cols, m.entity_keys,
+                dataset.entity_vocabs[spec.re_type], spec.re_type,
+            )).astype(np.int64)
+            vals = np.asarray(aligned)
+            e_rows = np.repeat(np.arange(vals.shape[0]), mc.shape[1])
+            flat_cols = mc.ravel()
+            dim = int(dataset.feature_shards[spec.feature_shard_id].shape[1])
+            live = flat_cols < dim
+            dense = np.zeros((vals.shape[0], dim), dtype=vals.dtype)
+            dense[e_rows[live], flat_cols[live]] = vals.ravel()[live]
+            aligned = jnp.asarray(dense)
+        elif ds_compact or model_compact:
             # compact-layout warm starts re-key per entity from the model's
             # active columns to the dataset's (a grid re-fit on the same
             # data keeps identical lists; cross-dataset fits remap, columns
             # absent from the new list are dropped, new ones start at 0)
-            if ds is None or ds.active_cols is None:
-                raise ValueError(
-                    f"warm-start model for '{spec.re_type}' is compact but "
-                    "the program's dataset is dense — incompatible layouts"
-                )
             model_cols = None
             if getattr(m, "active_cols", None) is not None:
                 # align the model's column lists to the dataset vocab order
@@ -1177,7 +1223,9 @@ def game_model_to_state(
                 np.asarray(ds.active_cols, dtype=np.int64), ds.dim,
             ))
         re_norm = program._re_objectives[spec.re_type].normalization
-        re_tables[spec.re_type] = re_norm.from_model_space(aligned)
+        re_tables[spec.re_type] = re_norm.from_model_space(
+            aligned, spec.intercept_index
+        )
     mf_rows, mf_cols = {}, {}
     for spec in program.mf_specs:
         m = coordinate_model(spec.name)

@@ -28,11 +28,16 @@ from photon_ml_tpu.parallel.mesh import make_mesh
 from photon_ml_tpu.types import TaskType
 
 
-def _toy_game_data(rng, n=64, d_fe=16, d_re=4, n_users=8, n_items=8):
+def _toy_game_data(rng, n=64, d_fe=16, d_re=4, n_users=8, n_items=8,
+                   re_intercept=False):
     users = np.array([f"u{i}" for i in rng.integers(0, n_users, size=n)])
     items = np.array([f"i{i}" for i in rng.integers(0, n_items, size=n)])
     x_fe = rng.normal(size=(n, d_fe)).astype(np.float64)
     x_re = rng.normal(size=(n, d_re)).astype(np.float64)
+    if re_intercept:
+        # a true constant-1 intercept column (index 0): standardization's
+        # shift absorption is score-equivalent only with a real intercept
+        x_re[:, 0] = 1.0
     y = (rng.uniform(size=n) < 0.5).astype(np.float64)
     dataset = build_game_dataset(
         labels=y,
@@ -456,9 +461,11 @@ def test_projected_re_fused_matches_cd_path(rng):
     )
 
 
-def test_normalized_re_fused_matches_cd_path(rng):
-    """VERDICT r1 #9: RE normalization must mean the same thing in the fused
-    step as in the CD path (factor scaling; shifts rejected loudly)."""
+@pytest.mark.parametrize("standardized", [False, True])
+def test_normalized_re_fused_matches_cd_path(rng, standardized):
+    """VERDICT r1 #9 / r2 #7: RE normalization must mean the same thing in
+    the fused step as in the CD path — factor scaling AND full
+    standardization (shifts absorbed into the intercept on conversion)."""
     from photon_ml_tpu.algorithm.coordinates import (
         CoordinateOptimizationConfig,
         RandomEffectCoordinate,
@@ -466,18 +473,26 @@ def test_normalized_re_fused_matches_cd_path(rng):
     from photon_ml_tpu.ops.normalization import NormalizationContext
     from photon_ml_tpu.parallel.distributed import state_to_game_model
 
-    dataset, re_datasets = _toy_game_data(rng)
+    dataset, re_datasets = _toy_game_data(rng, re_intercept=standardized)
     opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=8)
-    factors = jnp.asarray(
-        np.random.default_rng(77).uniform(0.5, 2.0, size=4)
-    )
-    norm = NormalizationContext(factors=factors, shifts=None)
+    nrng = np.random.default_rng(77)
+    factors = jnp.asarray(nrng.uniform(0.5, 2.0, size=4))
+    shifts = None
+    intercept = None
+    if standardized:
+        # intercept column (0) exempt from shift/factor, like
+        # build_normalization does
+        factors = factors.at[0].set(1.0)
+        shifts = jnp.asarray(nrng.normal(scale=0.3, size=4)).at[0].set(0.0)
+        intercept = 0
+    norm = NormalizationContext(factors=factors, shifts=shifts)
 
     program = GameTrainProgram(
         TaskType.LOGISTIC_REGRESSION,
         FixedEffectStepSpec("global", OptimizerConfig(
             optimizer_type=OptimizerType.LBFGS, max_iterations=0)),
-        (RandomEffectStepSpec("user", "per_entity", opt, l2_weight=1.0),),
+        (RandomEffectStepSpec("user", "per_entity", opt, l2_weight=1.0,
+                              intercept_index=intercept),),
         re_normalizations={"user": norm},
     )
     re_ds = {"user": re_datasets["user"]}
@@ -491,6 +506,7 @@ def test_normalized_re_fused_matches_cd_path(rng):
         task=TaskType.LOGISTIC_REGRESSION,
         config=CoordinateOptimizationConfig(optimizer=opt, l2_weight=1.0),
         normalization=norm,
+        intercept_index=intercept,
     )
     cd_model, _ = coord.update_model(coord.initial_model())
     np.testing.assert_allclose(
@@ -498,16 +514,33 @@ def test_normalized_re_fused_matches_cd_path(rng):
         np.asarray(cd_model.coefficients),
         rtol=1e-7, atol=1e-9,
     )
+    # the fused residual recursion must also SCORE shifted REs identically
+    from photon_ml_tpu.parallel.distributed import _data_pytree
+
+    data = _data_pytree(dataset, program.re_specs, "global")
+    fused_scores = program._re_coordinate_score(
+        data, "user",
+        norm.from_model_space(
+            jnp.asarray(cd_model.coefficients), intercept
+        ),
+        "per_entity",
+    )
+    cd_scores = coord.score(cd_model)
+    np.testing.assert_allclose(
+        np.asarray(fused_scores), np.asarray(cd_scores), rtol=1e-6, atol=1e-9
+    )
 
 
-def test_fused_step_rejects_shifted_re_normalization(rng):
+def test_fused_step_shifted_re_requires_intercept(rng):
+    """STANDARDIZATION without an intercept to absorb the margin shift is a
+    configuration error, caught at program construction."""
     from photon_ml_tpu.ops.normalization import NormalizationContext
 
     opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=2)
     norm = NormalizationContext(
         factors=jnp.ones(4), shifts=jnp.full((4,), 0.5)
     )
-    with pytest.raises(ValueError, match="factor-scaling"):
+    with pytest.raises(ValueError, match="intercept_index"):
         GameTrainProgram(
             TaskType.LOGISTIC_REGRESSION,
             FixedEffectStepSpec("global", opt),

@@ -216,6 +216,74 @@ class TestCompactTraining:
         assert warm.best_metric < 0.5 * cold.best_metric
 
 
+class TestCompactEdgeCases:
+    def test_variance_on_compact_re_fails_before_training(self):
+        """compute_variance on a compact RE must raise at configuration
+        time, not after a (long) distributed run at model conversion."""
+        ds, _, _ = _make()
+        var_opt = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=30), l2_weight=0.1,
+            compute_variance=True,
+        )
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinate_configs={
+                "fe": FixedEffectCoordinateConfig("global", OPT),
+                "per-user": RandomEffectCoordinateConfig("userId", "re", var_opt),
+            },
+            num_iterations=1, mesh=make_mesh(),
+        )
+        with pytest.raises(ValueError, match="projected/compact"):
+            est.fit(ds)
+
+    def test_fe_variance_with_compact_re_allowed(self):
+        """FE variances + a compact (non-requesting) RE coordinate is a
+        valid config — only REQUESTING coordinates must be unprojected."""
+        ds, _, _ = _make()
+        fe_var = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=20), l2_weight=0.1,
+            compute_variance=True,
+        )
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinate_configs={
+                "fe": FixedEffectCoordinateConfig("global", fe_var),
+                "per-user": RandomEffectCoordinateConfig("userId", "re", OPT),
+            },
+            num_iterations=1, mesh=make_mesh(),
+        )
+        res = est.fit(ds)
+        assert res.model.get("fe").glm.coefficients.variances is not None
+        assert res.model.get("per-user").variances is None
+
+    def test_compact_model_scores_dense_shard(self):
+        """A compact model (e.g. loaded with a low compact threshold) must
+        score a DENSE feature shard via the per-row active-column gather."""
+        ds, _, _ = _make(d_re=300)
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION, coordinate_configs=CONFIGS,
+            num_iterations=1,
+        )
+        res = est.fit(ds)
+        m = res.model.get("per-user")
+        sparse_scores = np.asarray(m.score_dataset(ds))
+        shard = ds.feature_shards["re"]
+        rows, cols, vals = shard.coalesced()
+        x = np.zeros((ds.num_samples, 300))
+        x[np.asarray(rows), np.asarray(cols)] = np.asarray(vals)
+        dense_ds = build_game_dataset(
+            labels=np.asarray(ds.labels),
+            feature_shards={"global": ds.host_array("shard/global"), "re": x},
+            entity_keys={"userId": np.array(
+                [str(k) for k in ds.entity_vocabs["userId"]]
+            )[np.asarray(ds.entity_idx["userId"])]},
+            entity_vocabs=ds.entity_vocabs,
+            dtype=np.float64,
+        )
+        dense_scores = np.asarray(m.score_dataset(dense_ds))
+        np.testing.assert_allclose(dense_scores, sparse_scores, rtol=1e-9)
+
+
 class TestCompactModelIO:
     def test_save_load_round_trip(self, tmp_path):
         from photon_ml_tpu.io.index_map import IndexMap
