@@ -129,7 +129,11 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
     n, d = x.shape
     xbytes = n * d * 4
     batch = LabeledPointBatch.create(jax.device_put(x), jax.device_put(y))
-    k_lo, k_hi = 16, 96
+    # wide K spread: per-call tunnel dispatch jitters by tens of ms, so the
+    # K_hi-K_lo device-time delta must dwarf it (240 extra evals = 90-180 ms
+    # of device time; BENCH_r03 saw a 80-eval spread produce a NEGATIVE
+    # marginal under dispatch noise)
+    k_lo, k_hi = 16, 256
     rng = np.random.default_rng(7)
 
     def marginal_of(step_fn):
@@ -143,7 +147,7 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
 
             float(run(jnp.zeros(d, jnp.float32), batch))  # compile+sync
             best = None
-            for _ in range(3):
+            for _ in range(4):
                 w0 = jnp.asarray(rng.normal(size=d).astype(np.float32)) * 0.01
                 t0 = time.perf_counter()
                 float(run(w0, batch))
@@ -171,11 +175,12 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
             "hot-loop fractions below are vs THIS number)"
         ),
     }]
-    # X passes per eval: autodiff reads X twice (margin matvec + transpose
-    # matvec — XLA does not fuse them into one read); the Pallas kernel's
-    # whole point is ONE fused pass (ops/pallas_glm.py)
-    for label, use_pallas, x_passes in (
-        ("autodiff_xla", False, 2), ("pallas_kernel", True, 1)
+    # X passes per eval: autodiff reads X roughly twice (margin matvec +
+    # transpose matvec, partially overlapped by XLA); the Pallas kernel
+    # makes ONE fused pass (ops/pallas_glm.py)
+    for label, use_pallas, passes_note in (
+        ("autodiff_xla", False, "~2 X passes/eval, so per-pass bandwidth is ~2x this"),
+        ("pallas_kernel", True, "1 fused X pass/eval"),
     ):
         obj = GLMObjective(LogisticLoss(), l2_weight=0.5, use_pallas=use_pallas)
 
@@ -190,9 +195,9 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
             "value": round(gbps, 1),
             "unit": (
                 f"achieved GB/s per value+grad eval counting ONE X read "
-                f"({x_passes} actual X pass(es) per eval), marginal over "
-                f"{k_hi - k_lo} extra evals; actual-traffic fraction of the "
-                f"same-run stream rate: {x_passes * gbps / stream_gbps:.2f}"
+                f"({passes_note}), marginal over {k_hi - k_lo} extra evals; "
+                f"one-read fraction of the same-run stream rate: "
+                f"{gbps / stream_gbps:.2f}"
             ),
         })
     return out

@@ -186,8 +186,38 @@ class GameTrainingParams:
 def run(params: GameTrainingParams) -> dict:
     """Execute the training pipeline; returns a result summary dict."""
     params.validate()
+    import jax
+
+    if jax.process_count() > 1:
+        # Multi-process pods: every process executes the same SPMD program
+        # (reads the same inputs, joins every collective), but filesystem
+        # outputs belong to process 0 — workers write into a scratch
+        # subdirectory. The checkpoint directory stays SHARED: all processes
+        # restore from it, train_distributed writes it from process 0 only.
+        if not (params.distributed or params.mesh_shape):
+            # the host-loop CD path has no cross-process coordination (every
+            # rank would train redundantly and race on the shared
+            # checkpoint directory)
+            raise ValueError(
+                "multi-process runs require --distributed or --mesh "
+                "(the fused SPMD training path)"
+            )
+        if jax.process_index() > 0:
+            params = dataclasses.replace(
+                params,
+                root_output_dir=os.path.join(
+                    params.root_output_dir, f".worker-{jax.process_index()}"
+                ),
+                override_output=True,
+            )
     out = params.root_output_dir
-    if os.path.isdir(out) and os.listdir(out) and not params.override_output:
+    # ignore worker scratch dirs: a faster rank may create out/.worker-N
+    # before rank 0's emptiness check runs
+    existing = (
+        [e for e in os.listdir(out) if not e.startswith(".worker-")]
+        if os.path.isdir(out) else []
+    )
+    if existing and not params.override_output:
         raise ValueError(
             f"output dir {out!r} is non-empty (pass --override-output to replace)"
         )

@@ -99,13 +99,23 @@ class RandomEffectModel(DatumScoringModel):
             from photon_ml_tpu.data.sparse_batch import SparseShard
 
             if not isinstance(features, SparseShard):
-                # dense shard, compact model (e.g. a giant model loaded
-                # compact scoring a small dense dataset): gather each
-                # sample's entity's active columns — O(n·K), no [E, d]
+                # dense shard, compact model (e.g. a model loaded compact
+                # via the size threshold scoring a dense dataset): gather
+                # each sample's entity's active columns — O(n·K), no [E, d]
+                dim = int(self.feature_dim)
+                if int(features.shape[1]) != dim:
+                    # a clamped gather on a narrower shard would silently
+                    # read the wrong column for every active col >= width
+                    raise ValueError(
+                        f"compact random-effect model "
+                        f"'{self.random_effect_type}' lives in a "
+                        f"{dim}-column feature space but the dense shard "
+                        f"'{self.feature_shard_id}' has "
+                        f"{int(features.shape[1])} columns"
+                    )
                 idx = jnp.asarray(entity_idx)
                 safe = jnp.maximum(idx, 0)
                 cols = jnp.asarray(self.active_cols, dtype=jnp.int32)[safe]
-                dim = int(self.feature_dim)
                 x = jnp.take_along_axis(
                     jnp.asarray(features),
                     jnp.minimum(cols, dim - 1), axis=1,

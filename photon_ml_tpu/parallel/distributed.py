@@ -1506,16 +1506,26 @@ def train_distributed(
                 put_fn=put_fn,
             )
 
+    def to_host(v):
+        """Host copy of a (possibly multi-process sharded) array. The
+        allgather is a COLLECTIVE — every process must call it, even those
+        that discard the result (rank-0-only writes)."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(v, tiled=True))
+        return jax.device_get(v)
+
     def state_arrays(state_: GameTrainState, prefix: str = "") -> dict:
         clean = unpadded(state_)
-        arrays = {prefix + "fe_coefficients": jax.device_get(clean.fe_coefficients)}
+        arrays = {prefix + "fe_coefficients": to_host(clean.fe_coefficients)}
         for sub, tables in (
             ("re_tables/", clean.re_tables),
             ("mf_rows/", clean.mf_rows),
             ("mf_cols/", clean.mf_cols),
         ):
             for k, v in tables.items():
-                arrays[prefix + sub + k] = jax.device_get(v)
+                arrays[prefix + sub + k] = to_host(v)
         return arrays
 
     losses = list(prior_losses)
@@ -1562,22 +1572,42 @@ def train_distributed(
         if checkpointer is not None and (
             (sweep + 1) % max(1, checkpoint_every) == 0 or sweep + 1 == num_iterations
         ):
+            # every process participates in the gathers (collectives);
+            # only process 0 touches the (shared) checkpoint directory
             arrays = state_arrays(state)
             if best_state is not None:
                 arrays.update(state_arrays(best_state, prefix="best/"))
-            checkpointer.save(
-                sweep + 1, arrays,
-                {"losses": losses, "metric_history": history,
-                 "best_metric": best_metric},
+            if jax.process_index() == 0:
+                checkpointer.save(
+                    sweep + 1, arrays,
+                    {"losses": losses, "metric_history": history,
+                     "best_metric": best_metric},
+                )
+    def result_state(state_: GameTrainState) -> GameTrainState:
+        clean = unpadded(state_)
+        if jax.process_count() > 1:
+            # downstream (model conversion, Avro persistence) materializes
+            # host arrays; a multi-process sharded state is not addressable,
+            # so hand back fully-gathered host-backed arrays
+            clean = GameTrainState(
+                fe_coefficients=jnp.asarray(to_host(clean.fe_coefficients)),
+                re_tables={k: jnp.asarray(to_host(v))
+                           for k, v in clean.re_tables.items()},
+                mf_rows={k: jnp.asarray(to_host(v))
+                         for k, v in clean.mf_rows.items()},
+                mf_cols={k: jnp.asarray(to_host(v))
+                         for k, v in clean.mf_cols.items()},
             )
+        return clean
+
     return DistributedTrainResult(
-        state=unpadded(state),
+        state=result_state(state),
         losses=losses,
         # best == final collapses to None ("treat final as best") so callers
         # never convert/variance-compute the same state twice
         best_state=(
             None if best_state is None or best_state is state
-            else unpadded(best_state)
+            else result_state(best_state)
         ),
         best_metric=best_metric,
         metric_history=history,
