@@ -18,10 +18,13 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import itertools
+import logging
 import os
 from typing import Iterable, Iterator, Mapping, Sequence
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from photon_ml_tpu.data.game_data import GameDataset, build_game_dataset
 from photon_ml_tpu.data.sparse_batch import SparseShard
@@ -340,6 +343,24 @@ def read_merged(
             dtype=dtype,
         )
 
+    if fmt == "avro" and os.environ.get("PHOTON_NO_NATIVE_AVRO") != "1":
+        # columnar C++ decode (native/avro_decoder.cpp): ~2 orders of
+        # magnitude over the per-record Python path; falls back below on
+        # unsupported schema shapes or a missing compiler. Equivalence of
+        # the two paths is pinned by tests/test_avro_native.py.
+        try:
+            return _read_merged_avro_native(
+                paths, shard_configs,
+                index_maps=index_maps,
+                random_effect_id_columns=random_effect_id_columns,
+                evaluation_id_columns=evaluation_id_columns,
+                entity_vocabs=entity_vocabs,
+                dtype=dtype,
+            )
+        except _AvroNativeFallback as e:
+            logger.info("native avro path unavailable (%s); using the "
+                        "Python reader", e)
+
     def records():
         if fmt == "avro":
             return itertools.chain.from_iterable(read_avro_records(p) for p in paths)
@@ -361,6 +382,258 @@ def read_merged(
         evaluation_id_columns=evaluation_id_columns,
         entity_vocabs=entity_vocabs,
         dtype=dtype,
+    )
+
+
+class _AvroNativeFallback(Exception):
+    """Internal: native avro path not usable for this input — use Python."""
+
+
+def _read_merged_avro_native(
+    paths: Sequence[str | os.PathLike],
+    shard_configs: Mapping[str, FeatureShardConfiguration],
+    *,
+    index_maps: Mapping[str, IndexMap] | None,
+    random_effect_id_columns: Sequence[str],
+    evaluation_id_columns: Sequence[str],
+    entity_vocabs: Mapping[str, np.ndarray] | None,
+    dtype,
+) -> ReadResult:
+    """Vectorized Avro read over the native columnar decoder.
+
+    Same semantics as ``records_to_game_dataset`` over the Python decode —
+    label/response precedence, offset/weight defaults, uid hashing,
+    metadataMap-then-top-level id lookup, per-shard bag merging with the
+    one shared duplicate-accumulation rule. Equivalence is pinned by
+    tests/test_avro_native.py. Raises :class:`_AvroNativeFallback` whenever
+    any input is outside the native subset.
+    """
+    from photon_ml_tpu.io import avro_native as av
+
+    try:
+        if not av.avro_native_available():
+            raise _AvroNativeFallback("no C++ compiler / build failed")
+        files: list[str] = []
+        for p in paths:
+            p = str(p)
+            if os.path.isdir(p):
+                names = sorted(
+                    f for f in os.listdir(p)
+                    if f.endswith(".avro") and not f.startswith(("_", "."))
+                )
+                if not names:
+                    raise avro_io.AvroError(f"no .avro files under {p}")
+                files += [os.path.join(p, f) for f in names]
+            else:
+                files.append(p)
+        parts = []
+        plan0: "av.AvroPlan | None" = None
+        for f in files:
+            plan = av.compile_plan(avro_io.read_container_schema(f))
+            if plan0 is None:
+                plan0 = plan
+            parts.append(av.decode_columns(f, plan))
+        cols = av.concat_columns(parts)
+    except av.AvroNativeUnsupported as e:
+        raise _AvroNativeFallback(str(e)) from e
+    except avro_io.AvroError as e:
+        # includes runtime-unrenderable values (e.g. a double metadataMap
+        # entry) — the Python reader is authoritative for both the data and
+        # any error message
+        raise _AvroNativeFallback(str(e)) from e
+    except RuntimeError as e:  # compiler missing etc.
+        raise _AvroNativeFallback(str(e)) from e
+    n = cols.n
+
+    # requested bags that exist in the schema but were not bag-shaped have
+    # uncertain record-level semantics — let the Python path decide
+    for cfg in shard_configs.values():
+        for bag in cfg.feature_bags:
+            if bag in plan0.all_fields and bag not in cols.bags:
+                raise _AvroNativeFallback(
+                    f"field '{bag}' is not a feature-bag shape"
+                )
+
+    def numcol(name, default):
+        if name in plan0.all_fields and name not in cols.num:
+            # e.g. a string-typed offset: Python parses/raises; a silent
+            # default would diverge
+            raise _AvroNativeFallback(
+                f"field '{name}' has a non-numeric schema shape"
+            )
+        col = cols.num.get(name)
+        if col is None:
+            return np.full(n, default, dtype=np.float64)
+        if name in plan0.strnum_fields and np.isnan(col).any():
+            # NaN could be an unparseable string (Python raises) rather
+            # than a null (Python defaults) — let Python decide
+            raise _AvroNativeFallback(
+                f"field '{name}' has null-or-unparseable values under a "
+                "string union"
+            )
+        return np.where(np.isnan(col), default, col)
+
+    # Python precedence: label first (whatever its type), then response —
+    # a label field the native path could not collect numerically must not
+    # silently yield to response
+    if "label" in plan0.all_fields and "label" not in cols.num:
+        raise _AvroNativeFallback("label field has an uncollectable shape")
+    if "label" in cols.num:
+        labels = cols.num["label"]
+    elif RESPONSE in cols.num:
+        labels = cols.num[RESPONSE]
+    elif RESPONSE in plan0.all_fields:
+        raise _AvroNativeFallback("response field has an uncollectable shape")
+    else:
+        raise ValueError("record has neither 'label' nor 'response'")
+    if np.isnan(labels).any():
+        # null labels error identically on the Python path; non-numeric
+        # string labels are its call too
+        raise _AvroNativeFallback("null or non-numeric label values")
+    offsets = numcol(OFFSET, 0.0)
+    weights = numcol(WEIGHT, 1.0)
+
+    # uid -> stable int64 ids (same rules as records_to_game_dataset)
+    if UID in cols.num:
+        uid_col = cols.num[UID]
+        uids = np.where(
+            np.isnan(uid_col), np.arange(n, dtype=np.float64), uid_col
+        ).astype(np.int64)
+    elif UID in cols.str_ids:
+        table = cols.str_tables[UID]
+        mapped = np.empty(len(table), dtype=np.int64)
+        for i, s in enumerate(table):
+            try:
+                mapped[i] = int(s)
+            except ValueError:
+                digest = hashlib.blake2b(s.encode(), digest_size=8).digest()
+                hashed = int.from_bytes(digest, "little") & ((1 << 62) - 1)
+                mapped[i] = hashed | (1 << 62)
+        ids = cols.str_ids[UID]
+        uids = np.where(
+            ids == av.NULL_ID,
+            np.arange(n, dtype=np.int64),
+            mapped[np.minimum(ids.astype(np.int64), len(table) - 1)]
+            if table else 0,
+        )
+    else:
+        uids = np.arange(n, dtype=np.int64)
+
+    # id columns: metadataMap first (key PRESENT wins even with null value),
+    # then a top-level field, else ""
+    id_cols: dict[str, np.ndarray] = {}
+    meta = cols.maps.get(META_DATA_MAP)
+    mkeys = cols.map_key_tables.get(META_DATA_MAP, [])
+    mvals = np.asarray(
+        cols.map_val_tables.get(META_DATA_MAP, []) + [""], dtype=object
+    )
+    wanted = set(random_effect_id_columns) | set(evaluation_id_columns)
+    if wanted and META_DATA_MAP in plan0.all_fields and meta is None:
+        raise _AvroNativeFallback(
+            "metadataMap has an uncollectable shape but id columns are "
+            "requested"
+        )
+    for col in wanted:
+        out = np.full(n, "", dtype=object)
+        seen = np.zeros(n, dtype=bool)
+        if meta is not None and col in mkeys:
+            kid = mkeys.index(col)
+            rows, kids, vids = meta
+            sel = kids == kid
+            rsel = rows[sel].astype(np.int64)
+            v = vids[sel].astype(np.int64)
+            v = np.where(v == np.int64(av.NULL_ID), len(mvals) - 1, v)
+            out[rsel] = mvals[v]
+            seen[rsel] = True
+        if col in cols.str_ids:
+            table = np.asarray(cols.str_tables[col] + [""], dtype=object)
+            ids = cols.str_ids[col].astype(np.int64)
+            ids = np.where(ids == np.int64(av.NULL_ID), len(table) - 1, ids)
+            fill = ~seen
+            out[fill] = table[ids[fill]]
+        elif col in cols.num:
+            vals = cols.num[col]
+            fill = ~seen & ~np.isnan(vals)
+            if fill.any() and col in plan0.unfaithful_id_fields:
+                # float/bool-typed id columns can't reproduce Python's
+                # str() rendering from an f64 column
+                raise _AvroNativeFallback(
+                    f"id column '{col}' has a float/bool-typed schema"
+                )
+            # pure int columns render like Python ints (vectorized)
+            out[fill] = vals[fill].astype(np.int64).astype(str)
+        id_cols[col] = out.astype(str)
+
+    # feature bags -> per-shard triples through the index maps
+    if index_maps is None:
+        built: dict[str, IndexMap] = {}
+        for shard, cfg in shard_configs.items():
+            keys: set[str] = set()
+            for bag in cfg.feature_bags:
+                keys.update(cols.bag_tables.get(bag, []))
+            built[shard] = IndexMap.from_keys(
+                keys, add_intercept=cfg.has_intercept
+            )
+        index_maps = built
+
+    feature_shards: dict[str, object] = {}
+    intercept_indices: dict[str, int] = {}
+    for shard, cfg in shard_configs.items():
+        imap = index_maps[shard]
+        rows_l, cols_l, vals_l = [], [], []
+        for bag in cfg.feature_bags:
+            if bag not in cols.bags:
+                continue
+            br, bk, bv = cols.bags[bag]
+            table = cols.bag_tables[bag]
+            idx = np.asarray(
+                [imap.get_index(k) for k in table], dtype=np.int64
+            )
+            j = idx[bk.astype(np.int64)] if len(table) else np.zeros(0, np.int64)
+            keep = j >= 0
+            rows_l.append(br.astype(np.int64)[keep])
+            cols_l.append(j[keep])
+            vals_l.append(bv[keep])
+        if rows_l:
+            triples = np.stack(
+                [
+                    np.concatenate(rows_l).astype(np.float64),
+                    np.concatenate(cols_l).astype(np.float64),
+                    np.concatenate(vals_l),
+                ],
+                axis=1,
+            )
+        else:
+            triples = np.zeros((0, 3))
+        if cfg.sparse:
+            feature_shards[shard] = _assemble_sparse_shard(
+                n, imap, cfg, triples, dtype, shard, intercept_indices
+            )
+            continue
+        x = _scatter_dense(
+            n, imap.size, triples[:, 0], triples[:, 1], triples[:, 2], dtype
+        )
+        if cfg.has_intercept:
+            _apply_intercept(x, imap, shard, intercept_indices)
+        feature_shards[shard] = x
+
+    dataset = build_game_dataset(
+        labels=labels,
+        feature_shards=feature_shards,
+        entity_keys={
+            c: id_cols[c] for c in random_effect_id_columns
+        },
+        offsets=offsets,
+        weights=weights,
+        unique_ids=uids,
+        ids={c: id_cols[c] for c in evaluation_id_columns},
+        entity_vocabs=entity_vocabs,
+        dtype=dtype,
+    )
+    return ReadResult(
+        dataset=dataset,
+        index_maps=dict(index_maps),
+        intercept_indices=intercept_indices,
     )
 
 

@@ -32,6 +32,12 @@ def _lib_path(source: str) -> str:
     return os.path.join(_DIR, f"_{stem}-{tag}.so")
 
 
+#: per-source extra link flags (only the Avro decoder needs zlib; coupling
+#: every native build to libz would let a missing dev link silently degrade
+#: the others to their Python fallbacks)
+_LINK_FLAGS = {"avro_decoder.cpp": ["-lz"]}
+
+
 def _compile(source: str, out_path: str) -> None:
     gxx = shutil.which("g++") or shutil.which("c++")
     if gxx is None:
@@ -41,7 +47,8 @@ def _compile(source: str, out_path: str) -> None:
     os.close(fd)
     try:
         subprocess.run(
-            [gxx, "-O2", "-std=c++17", "-shared", "-fPIC", source, "-o", tmp],
+            [gxx, "-O2", "-std=c++17", "-shared", "-fPIC", source, "-o", tmp]
+            + _LINK_FLAGS.get(os.path.basename(source), []),
             check=True,
             capture_output=True,
             text=True,
@@ -153,6 +160,54 @@ def load_libsvm_library() -> ctypes.CDLL:
 def libsvm_native_available() -> bool:
     try:
         load_libsvm_library()
+        return True
+    except Exception:
+        return False
+
+
+def _configure_avro(lib: ctypes.CDLL) -> None:
+    lib.avdec_open.restype = ctypes.c_void_p
+    lib.avdec_open.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+    ]
+    lib.avdec_num_records.restype = ctypes.c_int64
+    lib.avdec_num_records.argtypes = [ctypes.c_void_p]
+    u32p = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32))
+    f64p = ctypes.POINTER(ctypes.POINTER(ctypes.c_double))
+    chp = ctypes.POINTER(ctypes.c_char_p)
+    u64pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64))
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.avdec_numcol.restype = ctypes.c_int64
+    lib.avdec_numcol.argtypes = [ctypes.c_void_p, ctypes.c_int64, f64p]
+    lib.avdec_strcol.restype = ctypes.c_int64
+    lib.avdec_strcol.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, u32p, chp, u64pp, u64p,
+    ]
+    lib.avdec_bag.restype = ctypes.c_int64
+    lib.avdec_bag.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, u32p, u32p, f64p, chp, u64pp, u64p,
+    ]
+    lib.avdec_map.restype = ctypes.c_int64
+    lib.avdec_map.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, u32p, u32p, u32p,
+        chp, u64pp, u64p, chp, u64pp, u64p,
+    ]
+    lib.avdec_free.restype = None
+    lib.avdec_free.argtypes = [ctypes.c_void_p]
+
+
+def load_avro_library() -> ctypes.CDLL:
+    return load_native_library("avro_decoder.cpp", _configure_avro)
+
+
+def avro_native_available() -> bool:
+    try:
+        load_avro_library()
         return True
     except Exception:
         return False

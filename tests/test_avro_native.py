@@ -1,0 +1,214 @@
+"""Native columnar Avro decoder vs the pure-Python reader.
+
+The fast path (native/avro_decoder.cpp + io/avro_native.py) must be
+indistinguishable from the record-dict path through read_merged — every
+dataset array, index map, id column, and intercept. Measured ~13x the
+Python decode end to end (BASELINE.md r3)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import photon_schemas as schemas
+from photon_ml_tpu.io.avro_native import (
+    AvroNativeUnsupported,
+    avro_native_available,
+    compile_plan,
+    decode_columns,
+)
+from photon_ml_tpu.io.data_reader import FeatureShardConfiguration, read_merged
+
+pytestmark = pytest.mark.skipif(
+    not avro_native_available(), reason="no C++ compiler"
+)
+
+SCHEMA = {
+    "name": "NativeAvroTestRecord",
+    "type": "record",
+    "fields": [
+        {"name": "uid", "type": ["string", "null"]},
+        {"name": "label", "type": "double"},
+        {"name": "features",
+         "type": {"type": "array", "items": schemas.FEATURE_AVRO}},
+        {"name": "otherBag", "type": {"type": "array", "items": "FeatureAvro"}},
+        {"name": "weight", "type": ["double", "null"], "default": None},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "intField", "type": "int"},
+        {"name": "ignored",
+         "type": {"type": "record", "name": "Nested", "fields": [
+             {"name": "a", "type": "string"},
+             {"name": "b", "type": {"type": "array", "items": "long"}},
+         ]}},
+        {"name": "metadataMap",
+         "type": [{"type": "map", "values": ["string", "null"]}, "null"],
+         "default": None},
+    ],
+}
+
+
+def _records(n, seed):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        feats = [
+            {"name": f"f{int(j)}", "term": ["", "t1", "t2"][int(j) % 3],
+             "value": float(rng.normal())}
+            for j in rng.integers(0, 40, size=rng.integers(0, 6))
+        ]
+        other = [
+            {"name": f"o{int(j)}", "term": "", "value": float(rng.normal())}
+            for j in rng.integers(0, 10, size=2)
+        ]
+        meta = None
+        if i % 7 != 0:
+            meta = {"userId": f"u{i % 9}", "queryId": f"q{i % 4}"}
+            if i % 5 == 0:
+                meta["nullv"] = None
+        out.append({
+            "uid": None if i % 11 == 0 else (str(i) if i % 3 else f"uid-{i}"),
+            "label": float(rng.normal()),
+            "features": feats,
+            "otherBag": other,
+            "weight": None if i % 6 == 0 else float(rng.uniform(0.5, 2)),
+            "offset": None if i % 4 == 0 else float(rng.normal()),
+            "intField": int(i),
+            "ignored": {"a": "x" * (i % 3), "b": [int(i), 2]},
+            "metadataMap": meta,
+        })
+    return out
+
+
+@pytest.fixture(scope="module")
+def avro_dir(tmp_path_factory):
+    base = tmp_path_factory.mktemp("native_avro")
+    recs = _records(300, 0)
+    # two part files: exercises table re-interning across parts
+    avro_io.write_container(str(base / "part-00000.avro"), SCHEMA, recs[:170])
+    avro_io.write_container(str(base / "part-00001.avro"), SCHEMA, recs[170:])
+    return base
+
+
+CFGS = {
+    "g": FeatureShardConfiguration(feature_bags=("features",), has_intercept=True),
+    "o": FeatureShardConfiguration(
+        feature_bags=("otherBag", "features"), has_intercept=False
+    ),
+}
+
+
+def _both(path, cfgs, **kw):
+    fast = read_merged(path, cfgs, **kw)
+    os.environ["PHOTON_NO_NATIVE_AVRO"] = "1"
+    try:
+        slow = read_merged(path, cfgs, **kw)
+    finally:
+        del os.environ["PHOTON_NO_NATIVE_AVRO"]
+    return fast, slow
+
+
+def _assert_equal(fast, slow):
+    from photon_ml_tpu.data.sparse_batch import SparseShard
+
+    ds_f, ds_s = fast.dataset, slow.dataset
+    np.testing.assert_array_equal(np.asarray(ds_f.labels), np.asarray(ds_s.labels))
+    np.testing.assert_array_equal(np.asarray(ds_f.offsets), np.asarray(ds_s.offsets))
+    np.testing.assert_array_equal(np.asarray(ds_f.weights), np.asarray(ds_s.weights))
+    np.testing.assert_array_equal(ds_f.unique_ids, ds_s.unique_ids)
+    assert {k: list(v) for k, v in fast.index_maps.items()} == {
+        k: list(v) for k, v in slow.index_maps.items()
+    }
+    for k, v in ds_s.feature_shards.items():
+        fv = ds_f.feature_shards[k]
+        if isinstance(v, SparseShard):
+            # same totals per cell (entry order may differ)
+            dv = np.zeros((v.num_samples, v.feature_dim))
+            np.add.at(dv, (np.asarray(v.rows), np.asarray(v.cols)), np.asarray(v.vals))
+            df = np.zeros((fv.num_samples, fv.feature_dim))
+            np.add.at(df, (np.asarray(fv.rows), np.asarray(fv.cols)), np.asarray(fv.vals))
+            np.testing.assert_allclose(df, dv, rtol=1e-6)
+        else:
+            np.testing.assert_allclose(
+                np.asarray(fv), np.asarray(v), rtol=1e-6, atol=1e-7
+            )
+    for t in ds_s.entity_vocabs:
+        np.testing.assert_array_equal(ds_f.entity_vocabs[t], ds_s.entity_vocabs[t])
+        np.testing.assert_array_equal(
+            np.asarray(ds_f.entity_idx[t]), np.asarray(ds_s.entity_idx[t])
+        )
+    for c, v in ds_s.ids.items():
+        np.testing.assert_array_equal(ds_f.ids[c], v)
+    assert fast.intercept_indices == slow.intercept_indices
+
+
+class TestNativeEquivalence:
+    def test_dense_with_ids_and_nulls(self, avro_dir):
+        fast, slow = _both(
+            avro_dir, CFGS,
+            random_effect_id_columns=("userId",),
+            evaluation_id_columns=("queryId",),
+        )
+        _assert_equal(fast, slow)
+
+    def test_sparse_shard(self, avro_dir):
+        cfgs = {"g": FeatureShardConfiguration(
+            feature_bags=("features",), has_intercept=True, sparse=True
+        )}
+        fast, slow = _both(avro_dir, cfgs, random_effect_id_columns=("userId",))
+        _assert_equal(fast, slow)
+
+    def test_prebuilt_index_maps(self, avro_dir):
+        base = read_merged(avro_dir, CFGS)
+        fast, slow = _both(avro_dir, CFGS, index_maps=base.index_maps)
+        _assert_equal(fast, slow)
+
+    def test_reference_jvm_written_file(self):
+        ref = ("/root/reference/photon-client/src/integTest/resources/"
+               "GameIntegTest/input/duplicateFeatures")
+        if not os.path.isdir(ref):
+            pytest.skip("reference fixtures unavailable")
+        cfgs = {"g": FeatureShardConfiguration(
+            feature_bags=("features",), has_intercept=True
+        )}
+        fast, slow = _both(ref, cfgs, random_effect_id_columns=("userId",))
+        _assert_equal(fast, slow)
+
+    def test_deflate_codec(self, tmp_path):
+        path = tmp_path / "z.avro"
+        avro_io.write_container(
+            str(path), SCHEMA, _records(50, 3), codec="deflate"
+        )
+        fast, slow = _both(tmp_path, CFGS, random_effect_id_columns=("userId",))
+        _assert_equal(fast, slow)
+
+
+class TestPlanCompiler:
+    def test_unsupported_falls_back(self, tmp_path):
+        schema = {
+            "name": "Weird", "type": "record",
+            "fields": [
+                {"name": "label", "type": "double"},
+                {"name": "u3", "type": ["null", "string", "double"]},
+            ],
+        }
+        # 3-way union is skippable, not collectible — still decodes
+        plan = compile_plan(schema)
+        assert "u3" not in plan.str_fields
+
+    def test_bag_detection(self):
+        plan = compile_plan(SCHEMA)
+        assert set(plan.bag_fields) == {"features", "otherBag"}
+        assert "metadataMap" in plan.map_fields
+        assert "intField" in plan.num_fields
+        assert "uid" in plan.str_fields
+        assert "ignored" in plan.all_fields
+
+    def test_columns_shape(self, avro_dir):
+        f = sorted(os.listdir(avro_dir))[0]
+        cols = decode_columns(avro_dir / f)
+        assert cols.n == 170
+        assert cols.num["label"].shape == (170,)
+        rows, keys, vals = cols.bags["features"]
+        assert rows.shape == keys.shape == vals.shape
+        assert all("\x01" in k for k in cols.bag_tables["features"])
