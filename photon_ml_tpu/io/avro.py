@@ -384,18 +384,24 @@ def read_container_schema(path: str | os.PathLike) -> dict:
         return json.loads(meta["avro.schema"].decode("utf-8"))
 
 
-def read_directory(path: str | os.PathLike) -> Iterator[dict]:
-    """Read every ``*.avro`` file under a directory (the reference reads
-    HDFS directories of part files, AvroUtils.scala readAvroFiles)."""
+def list_avro_files(path: str | os.PathLike) -> list[str]:
+    """The ``*.avro`` part files of a directory (sorted, Spark/OS markers
+    skipped), or the path itself when it is a file — the ONE part-file
+    listing rule shared by every reader."""
     p = str(path)
     if os.path.isfile(p):
-        yield from read_container(p)
-        return
+        return [p]
     names = sorted(
         f for f in os.listdir(p)
         if f.endswith(".avro") and not f.startswith(("_", "."))
     )
     if not names:
         raise AvroError(f"no .avro files under {p}")
-    for name in names:
-        yield from read_container(os.path.join(p, name))
+    return [os.path.join(p, name) for name in names]
+
+
+def read_directory(path: str | os.PathLike) -> Iterator[dict]:
+    """Read every ``*.avro`` file under a directory (the reference reads
+    HDFS directories of part files, AvroUtils.scala readAvroFiles)."""
+    for name in list_avro_files(path):
+        yield from read_container(name)

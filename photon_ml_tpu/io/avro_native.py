@@ -46,10 +46,6 @@ OP_MAPVAL_LONGSTR, OP_MAPVAL_BOOLSTR, OP_MAPVAL_BAD = 39, 40, 41
 
 NULL_ID = 0xFFFFFFFF
 
-_NUM_COL_OPS = {
-    "double": OP_COL_DOUBLE, "float": OP_COL_FLOAT,
-    "int": OP_COL_INT, "long": OP_COL_LONG, "boolean": OP_COL_BOOL,
-}
 _NUM_KINDS = {"double": 0, "float": 1, "int": 2, "long": 2, "boolean": 3}
 _SKIP_OPS = {
     "null": OP_NULL, "boolean": OP_BOOL, "int": OP_INT, "long": OP_LONG,
@@ -81,6 +77,23 @@ class AvroPlan:
     #: "unparseable string" (where Python raises) rather than null — callers
     #: must fall back on NaN instead of applying defaults
     strnum_fields: frozenset[str] = frozenset()
+    #: numeric fields with a null branch: their NaNs are (usually) the null
+    #: sentinel, but a genuine NaN double is indistinguishable — callers
+    #: fall back when NaNs appear so Python applies its exact semantics
+    nullable_num_fields: frozenset[str] = frozenset()
+
+    def same_semantics(self, other: "AvroPlan") -> bool:
+        return (
+            np.array_equal(self.ops, other.ops)
+            and self.num_fields == other.num_fields
+            and self.str_fields == other.str_fields
+            and self.bag_fields == other.bag_fields
+            and self.map_fields == other.map_fields
+            and self.all_fields == other.all_fields
+            and self.unfaithful_id_fields == other.unfaithful_id_fields
+            and self.strnum_fields == other.strnum_fields
+            and self.nullable_num_fields == other.nullable_num_fields
+        )
 
 
 def _tname(schema) -> str:
@@ -122,10 +135,6 @@ def _compile_skip(schema, registry, out: list[int], depth: int = 0) -> None:
         out.append(int(schema["size"]))
     else:
         raise AvroNativeUnsupported(f"cannot skip schema type {t!r}")
-
-
-def _string_like(schema) -> bool:
-    return _tname(schema) in ("string", "bytes")
 
 
 def _nullable(schema) -> tuple[bool, int, object]:
@@ -199,6 +208,7 @@ def compile_plan(schema: dict) -> AvroPlan:
 
     unfaithful: set[str] = set()
     strnum_fields: set[str] = set()
+    nullable_num: set[str] = set()
 
     def scalar_branches(ft) -> list | None:
         """The union branch list when every branch is a scalar (or the
@@ -247,6 +257,8 @@ def compile_plan(schema: dict) -> AvroPlan:
                 unfaithful.add(name)
                 if "string" in names:
                     strnum_fields.add(name)
+                if "null" in names:
+                    nullable_num.add(name)
             elif any(nm in ("string", "long") for nm in names):
                 slot = len(str_fields)
                 str_fields[name] = slot
@@ -257,6 +269,8 @@ def compile_plan(schema: dict) -> AvroPlan:
                 table = NUM_BRANCH
                 if "boolean" in names:
                     unfaithful.add(name)
+                if "null" in names:
+                    nullable_num.add(name)
             if len(scalars) == 1:
                 ops += [table[names[0]], slot]
             else:
@@ -337,14 +351,19 @@ def compile_plan(schema: dict) -> AvroPlan:
         all_fields=frozenset(f["name"] for f in top["fields"]),
         unfaithful_id_fields=frozenset(unfaithful),
         strnum_fields=frozenset(strnum_fields),
+        nullable_num_fields=frozenset(nullable_num),
     )
 
 
 def _table(blob: bytes, offsets: np.ndarray) -> list[str]:
-    return [
-        blob[offsets[i]:offsets[i + 1]].decode("utf-8", "replace")
-        for i in range(len(offsets) - 1)
-    ]
+    try:
+        return [
+            blob[offsets[i]:offsets[i + 1]].decode("utf-8")
+            for i in range(len(offsets) - 1)
+        ]
+    except UnicodeDecodeError as e:
+        # the Python reader raises on invalid UTF-8 — it is authoritative
+        raise AvroNativeUnsupported(f"invalid UTF-8 in string table: {e}")
 
 
 @dataclasses.dataclass
@@ -352,7 +371,8 @@ class AvroColumns:
     """Columnar decode of one container file (or a concatenation)."""
 
     n: int
-    num: dict[str, np.ndarray]  # field -> [n] float64 (NaN = null)
+    num: dict[str, np.ndarray]  # field -> [n] float64
+    num_null: dict[str, np.ndarray]  # field -> [n] bool, True where null
     str_ids: dict[str, np.ndarray]  # field -> [n] uint32 (NULL_ID = null)
     str_tables: dict[str, list[str]]
     bags: dict[str, tuple[np.ndarray, np.ndarray, np.ndarray]]  # rows, keys, vals
@@ -387,14 +407,17 @@ def decode_columns(path: str | os.PathLike, plan: AvroPlan | None = None) -> Avr
                 return np.zeros(0, dtype=dtype)
             return np.ctypeslib.as_array(ptr, shape=(count,)).astype(dtype, copy=True)
 
-        num = {}
+        num, num_null = {}, {}
         for name, slot in plan.num_fields.items():
             dp = ctypes.POINTER(ctypes.c_double)()
-            cnt = lib.avdec_numcol(handle, slot, ctypes.byref(dp))
+            mp = ctypes.POINTER(ctypes.c_uint8)()
+            cnt = lib.avdec_numcol(handle, slot, ctypes.byref(dp),
+                                   ctypes.byref(mp))
             col = np_copy(dp, cnt, np.float64)
             if cnt != n:
                 raise AvroError(f"{path}: field '{name}' count {cnt} != {n}")
             num[name] = col
+            num_null[name] = np_copy(mp, cnt, np.uint8).astype(bool)
         str_ids, str_tables = {}, {}
         for name, slot in plan.str_fields.items():
             ip = ctypes.POINTER(ctypes.c_uint32)()
@@ -463,9 +486,9 @@ def decode_columns(path: str | os.PathLike, plan: AvroPlan | None = None) -> Avr
                 ctypes.string_at(vb, int(voffs[-1])) if vn.value else b"", voffs
             )
         return AvroColumns(
-            n=n, num=num, str_ids=str_ids, str_tables=str_tables,
-            bags=bags, bag_tables=bag_tables, maps=maps,
-            map_key_tables=mk_tables, map_val_tables=mv_tables,
+            n=n, num=num, num_null=num_null, str_ids=str_ids,
+            str_tables=str_tables, bags=bags, bag_tables=bag_tables,
+            maps=maps, map_key_tables=mk_tables, map_val_tables=mv_tables,
         )
     finally:
         lib.avdec_free(handle)
@@ -498,6 +521,10 @@ def concat_columns(parts: list[AvroColumns]) -> AvroColumns:
 
     num = {
         k: np.concatenate([p.num[k] for p in parts]) for k in parts[0].num
+    }
+    num_null = {
+        k: np.concatenate([p.num_null[k] for p in parts])
+        for k in parts[0].num_null
     }
     str_ids, str_tables = {}, {}
     for k in parts[0].str_ids:
@@ -542,8 +569,8 @@ def concat_columns(parts: list[AvroColumns]) -> AvroColumns:
         mk_tables[k] = ktable
         mv_tables[k] = vtable
     return AvroColumns(
-        n=n, num=num, str_ids=str_ids, str_tables=str_tables,
-        bags=bags, bag_tables=bag_tables, maps=maps,
+        n=n, num=num, num_null=num_null, str_ids=str_ids,
+        str_tables=str_tables, bags=bags, bag_tables=bag_tables, maps=maps,
         map_key_tables=mk_tables, map_val_tables=mv_tables,
     )
 

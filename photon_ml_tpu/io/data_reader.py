@@ -133,7 +133,7 @@ def build_index_maps(
         for shard, cfg in shard_configs.items():
             for bag in cfg.feature_bags:
                 for feat in bags.get(bag, ()):
-                    keys[shard].add(feature_key(feat["name"], feat.get("term", "")))
+                    keys[shard].add(feature_key(feat["name"], feat.get("term") or ""))
     return {
         shard: IndexMap.from_keys(keys[shard], add_intercept=cfg.has_intercept)
         for shard, cfg in shard_configs.items()
@@ -257,7 +257,7 @@ def records_to_game_dataset(
             imap = index_maps[shard]
             for bag in cfg.feature_bags:
                 for feat in bags.get(bag, ()):
-                    j = imap.get_index(feature_key(feat["name"], feat.get("term", "")))
+                    j = imap.get_index(feature_key(feat["name"], feat.get("term") or ""))
                     if j >= 0:
                         rows[shard].append((n, j, float(feat["value"])))
         n += 1
@@ -415,23 +415,19 @@ def _read_merged_avro_native(
             raise _AvroNativeFallback("no C++ compiler / build failed")
         files: list[str] = []
         for p in paths:
-            p = str(p)
-            if os.path.isdir(p):
-                names = sorted(
-                    f for f in os.listdir(p)
-                    if f.endswith(".avro") and not f.startswith(("_", "."))
-                )
-                if not names:
-                    raise avro_io.AvroError(f"no .avro files under {p}")
-                files += [os.path.join(p, f) for f in names]
-            else:
-                files.append(p)
+            files += avro_io.list_avro_files(p)
         parts = []
         plan0: "av.AvroPlan | None" = None
         for f in files:
             plan = av.compile_plan(avro_io.read_container_schema(f))
             if plan0 is None:
                 plan0 = plan
+            elif not plan.same_semantics(plan0):
+                # schema evolution between part files: the faithfulness
+                # guards are per-plan, so a later part could bypass them
+                raise av.AvroNativeUnsupported(
+                    f"part file {f} has a different schema"
+                )
             parts.append(av.decode_columns(f, plan))
         cols = av.concat_columns(parts)
     except av.AvroNativeUnsupported as e:
@@ -464,14 +460,16 @@ def _read_merged_avro_native(
         col = cols.num.get(name)
         if col is None:
             return np.full(n, default, dtype=np.float64)
-        if name in plan0.strnum_fields and np.isnan(col).any():
-            # NaN could be an unparseable string (Python raises) rather
-            # than a null (Python defaults) — let Python decide
+        null = cols.num_null[name]
+        if name in plan0.strnum_fields and np.isnan(col[~null]).any():
+            # a non-null NaN under a string union is an unparseable string
+            # — Python raises there; let it
             raise _AvroNativeFallback(
-                f"field '{name}' has null-or-unparseable values under a "
-                "string union"
+                f"field '{name}' has unparseable string values"
             )
-        return np.where(np.isnan(col), default, col)
+        # nulls take the default (Python's `if value is None`); genuine NaN
+        # doubles propagate, exactly like float(nan)
+        return np.where(null, default, col)
 
     # Python precedence: label first (whatever its type), then response —
     # a label field the native path could not collect numerically must not
@@ -480,6 +478,8 @@ def _read_merged_avro_native(
         raise _AvroNativeFallback("label field has an uncollectable shape")
     if "label" in cols.num:
         labels = cols.num["label"]
+        if cols.num_null["label"].any():
+            raise _AvroNativeFallback("null label values")
     elif RESPONSE in cols.num:
         labels = cols.num[RESPONSE]
     elif RESPONSE in plan0.all_fields:
@@ -545,6 +545,15 @@ def _read_merged_avro_native(
             v = np.where(v == np.int64(av.NULL_ID), len(mvals) - 1, v)
             out[rsel] = mvals[v]
             seen[rsel] = True
+        if (
+            col not in cols.str_ids and col not in cols.num
+            and col in plan0.all_fields and not seen.all()
+        ):
+            # e.g. an enum-typed id column: Python renders str(value);
+            # silently collapsing every entity into "" would be far worse
+            raise _AvroNativeFallback(
+                f"id column '{col}' has an uncollectable schema shape"
+            )
         if col in cols.str_ids:
             table = np.asarray(cols.str_tables[col] + [""], dtype=object)
             ids = cols.str_ids[col].astype(np.int64)

@@ -153,6 +153,7 @@ struct Decoder {
 
 struct State {
   std::vector<std::vector<double>> numcols;
+  std::vector<std::vector<uint8_t>> numnulls;  // 1 where the null branch fired
   std::vector<std::vector<uint32_t>> strcols;
   std::vector<Pool> strpools;
   std::vector<BagOut> bags;
@@ -252,21 +253,39 @@ struct Exec {
         break;
       }
       case OP_FIXED: d.skip(plan[i++]); break;
-      case OP_COL_DOUBLE: st.numcols[plan[i++]].push_back(d.read_double()); break;
-      case OP_COL_FLOAT: st.numcols[plan[i++]].push_back(d.read_float()); break;
-      case OP_COL_INT: case OP_COL_LONG:
-        st.numcols[plan[i++]].push_back(static_cast<double>(d.read_long()));
+      case OP_COL_DOUBLE: {
+        int64_t slot = plan[i++];
+        st.numcols[slot].push_back(d.read_double());
+        st.numnulls[slot].push_back(0);
         break;
+      }
+      case OP_COL_FLOAT: {
+        int64_t slot = plan[i++];
+        st.numcols[slot].push_back(d.read_float());
+        st.numnulls[slot].push_back(0);
+        break;
+      }
+      case OP_COL_INT: case OP_COL_LONG: {
+        int64_t slot = plan[i++];
+        st.numcols[slot].push_back(static_cast<double>(d.read_long()));
+        st.numnulls[slot].push_back(0);
+        break;
+      }
       case OP_COL_BOOL: {
         double v = (d.p < d.end && *d.p) ? 1.0 : 0.0;
         d.skip(1);
-        st.numcols[plan[i++]].push_back(v);
+        int64_t slot = plan[i++];
+        st.numcols[slot].push_back(v);
+        st.numnulls[slot].push_back(0);
         break;
       }
-      case OP_COL_NULLNUM:
-        st.numcols[plan[i++]].push_back(
+      case OP_COL_NULLNUM: {
+        int64_t slot = plan[i++];
+        st.numcols[slot].push_back(
             std::numeric_limits<double>::quiet_NaN());
+        st.numnulls[slot].push_back(1);
         break;
+      }
       case OP_COL_STR: {
         int64_t len;
         const char* s = d.read_bytes(&len);
@@ -286,6 +305,7 @@ struct Exec {
           if (endp != tmp.c_str() + tmp.size() || tmp.empty())
             v = std::numeric_limits<double>::quiet_NaN();
           st.numcols[slot].push_back(v);
+          st.numnulls[slot].push_back(0);
         }
         break;
       }
@@ -476,6 +496,23 @@ struct Handle {
   std::vector<std::vector<uint64_t>> mapv_offs;
 };
 
+// zigzag varint straight off the FILE stream (header + block framing; the
+// in-block decoder has its own pointer-based reader)
+int64_t file_varint(FILE* f, bool* ok) {
+  uint64_t acc = 0;
+  int shift = 0;
+  int c;
+  while ((c = std::fgetc(f)) != EOF) {
+    acc |= static_cast<uint64_t>(c & 0x7F) << shift;
+    if (!(c & 0x80))
+      return static_cast<int64_t>(acc >> 1) ^ -static_cast<int64_t>(acc & 1);
+    shift += 7;
+    if (shift > 63) break;  // malformed varint; shifting past 64 is UB
+  }
+  *ok = false;
+  return 0;
+}
+
 bool read_header(FILE* f, std::string* codec, uint8_t sync[16], char* err,
                  size_t errlen) {
   uint8_t magic[4];
@@ -484,19 +521,7 @@ bool read_header(FILE* f, std::string* codec, uint8_t sync[16], char* err,
     return false;
   }
   // metadata map: string -> bytes
-  auto rl = [&](bool* ok) -> int64_t {
-    uint64_t acc = 0;
-    int shift = 0;
-    int c;
-    while ((c = std::fgetc(f)) != EOF) {
-      acc |= static_cast<uint64_t>(c & 0x7F) << shift;
-      if (!(c & 0x80))
-        return static_cast<int64_t>(acc >> 1) ^ -static_cast<int64_t>(acc & 1);
-      shift += 7;
-    }
-    *ok = false;
-    return 0;
-  };
+  auto rl = [&](bool* ok2) { return file_varint(f, ok2); };
   bool ok = true;
   *codec = "null";
   for (;;) {
@@ -547,6 +572,7 @@ void* avdec_open(const char* path, const int64_t* plan, int64_t planlen,
   }
   auto* h = new Handle();
   h->st.numcols.resize(n_num);
+  h->st.numnulls.resize(n_num);
   h->st.strcols.resize(n_str);
   h->st.strpools.resize(n_str);
   h->st.bags.resize(n_bag);
@@ -559,26 +585,13 @@ void* avdec_open(const char* path, const int64_t* plan, int64_t planlen,
     delete h;
     return nullptr;
   };
-  auto rl = [&](bool* ok) -> int64_t {
-    uint64_t acc = 0;
-    int shift = 0;
-    int c;
-    while ((c = std::fgetc(f)) != EOF) {
-      acc |= static_cast<uint64_t>(c & 0x7F) << shift;
-      if (!(c & 0x80))
-        return static_cast<int64_t>(acc >> 1) ^ -static_cast<int64_t>(acc & 1);
-      shift += 7;
-    }
-    *ok = false;
-    return 0;
-  };
   for (;;) {
     int c = std::fgetc(f);
     if (c == EOF) break;
     std::ungetc(c, f);
     bool ok = true;
-    int64_t count = rl(&ok);
-    int64_t size = rl(&ok);
+    int64_t count = file_varint(f, &ok);
+    int64_t size = file_varint(f, &ok);
     if (!ok || size < 0) return fail("truncated block header");
     raw.resize(size);
     if (size > 0 && std::fread(raw.data(), 1, size, f) != (size_t)size)
@@ -638,10 +651,12 @@ int64_t avdec_num_records(void* hv) {
   return static_cast<Handle*>(hv)->n_records;
 }
 
-int64_t avdec_numcol(void* hv, int64_t slot, const double** data) {
+int64_t avdec_numcol(void* hv, int64_t slot, const double** data,
+                     const uint8_t** nulls) {
   auto* h = static_cast<Handle*>(hv);
   auto& c = h->st.numcols[slot];
   *data = c.data();
+  *nulls = h->st.numnulls[slot].data();
   return static_cast<int64_t>(c.size());
 }
 

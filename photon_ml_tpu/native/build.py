@@ -183,7 +183,10 @@ def _configure_avro(lib: ctypes.CDLL) -> None:
     u64pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64))
     u64p = ctypes.POINTER(ctypes.c_uint64)
     lib.avdec_numcol.restype = ctypes.c_int64
-    lib.avdec_numcol.argtypes = [ctypes.c_void_p, ctypes.c_int64, f64p]
+    lib.avdec_numcol.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, f64p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+    ]
     lib.avdec_strcol.restype = ctypes.c_int64
     lib.avdec_strcol.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, u32p, chp, u64pp, u64p,

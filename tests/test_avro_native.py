@@ -183,6 +183,24 @@ class TestNativeEquivalence:
         _assert_equal(fast, slow)
 
 
+class TestFastPathCoverage:
+    def test_nullable_offsets_take_fast_path(self, avro_dir):
+        """Null offsets/weights/uids are the COMMON case — they must decode
+        natively (null bitmask), not fall back."""
+        from photon_ml_tpu.io.data_reader import _read_merged_avro_native
+
+        # raises _AvroNativeFallback if the fast path declines
+        out = _read_merged_avro_native(
+            [str(avro_dir)], CFGS,
+            index_maps=None,
+            random_effect_id_columns=("userId",),
+            evaluation_id_columns=(),
+            entity_vocabs=None,
+            dtype=np.float32,
+        )
+        assert out.dataset.num_samples == 300
+
+
 class TestPlanCompiler:
     def test_unsupported_falls_back(self, tmp_path):
         schema = {
