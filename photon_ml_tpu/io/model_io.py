@@ -17,6 +17,7 @@ by this module is directory-compatible with one saved by the reference.
 
 from __future__ import annotations
 
+import io
 import json
 import logging
 import os
@@ -705,14 +706,183 @@ def write_scores(
                 "metadataMap": None,
             }
 
+    encoded = _encode_score_blocks(
+        scores, model_id, uids, labels, weights
+    )
     if records_per_file is not None:
         os.makedirs(str(path), exist_ok=True)
+        if encoded is not None:
+            for part, lo in enumerate(range(0, n, records_per_file)):
+                chunk = encoded[lo:lo + records_per_file]
+                avro_io.write_container_blocks(
+                    os.path.join(str(path), f"part-{part:05d}.avro"),
+                    schemas.SCORING_RESULT_AVRO,
+                    [(len(chunk), chunk.tobytes())],
+                )
+            if n == 0:  # keep the directory readable, like _write_chunked
+                avro_io.write_container(
+                    os.path.join(str(path), "part-00000.avro"),
+                    schemas.SCORING_RESULT_AVRO, [],
+                )
+            return
         _write_chunked(
             str(path), schemas.SCORING_RESULT_AVRO, records(), records_per_file
         )
         return
     os.makedirs(os.path.dirname(str(path)) or ".", exist_ok=True)
+    if encoded is not None:
+        # an empty block list still writes a valid header-only container
+        avro_io.write_container_blocks(
+            path, schemas.SCORING_RESULT_AVRO,
+            [(n, encoded.tobytes())] if n else [],
+        )
+        return
     avro_io.write_container(path, schemas.SCORING_RESULT_AVRO, records())
+
+
+def _encode_score_blocks(
+    scores: np.ndarray,
+    model_id: str,
+    uids: np.ndarray | None,
+    labels: np.ndarray | None,
+    weights: np.ndarray | None,
+):
+    """Vectorized Avro-binary encoding of ScoringResultAvro records.
+
+    The schema is fixed and flat, so the whole record stream assembles as
+    numpy byte scatters (~20x the per-record BinaryEncoder — the write-side
+    analogue of the native reader; pure numpy, no compiler needed). Returns
+    a sliceable per-record object (numpy array of VOID rows is unsuitable
+    because uid lengths vary, so this returns a `_RaggedBytes` with
+    per-record boundaries), or None when the inputs are outside the fast
+    subset (non-ASCII or >8 KB uids).
+    """
+    n = len(scores)
+    if n == 0:
+        return _RaggedBytes(np.zeros(0, np.uint8), np.zeros(1, np.int64))
+    scores = np.ascontiguousarray(scores, dtype="<f8")
+
+    # ---- uid segment (the only variable-width part)
+    if uids is not None:
+        u = np.asarray(uids)
+        if u.dtype.kind in "iu" and (u >= 0).all():
+            # vectorized decimal digits (numpy's int->str astype is the
+            # profile's hot spot): RIGHT-aligned [n, maxlen] digit matrix.
+            # Digit count via exact integer thresholds — float64 log10
+            # overcounts just below powers of ten beyond 2^53
+            pow10 = np.array([10 ** k for k in range(1, 19)], dtype=np.uint64)
+            ulen = (
+                np.searchsorted(pow10, u.astype(np.uint64), side="right") + 1
+            ).astype(np.int64)
+            width = int(ulen.max())
+            ub_bytes = (
+                (u[:, None] // 10 ** np.arange(width - 1, -1, -1, dtype=u.dtype))
+                % 10
+            ).astype(np.uint8) + ord("0")
+            right_aligned = True
+        elif u.dtype.kind == "S" or (
+            u.dtype == object and any(isinstance(x, bytes) for x in u)
+        ):
+            return None  # str(bytes) renders the b'...' repr — generic's job
+        else:
+            ustr = u.astype("U") if u.dtype.kind != "U" else u
+            try:
+                ub = ustr.astype("S")  # ASCII-only fast encode
+            except UnicodeEncodeError:
+                return None
+            ulen = np.char.str_len(ustr).astype(np.int64)
+            ub_bytes = ub.view(np.uint8).reshape(n, -1)
+            right_aligned = False
+        if (ulen >= 8192).any():
+            return None  # >2-byte varint lengths: generic writer's job
+        two = ulen >= 64  # zigzag(len) needs 2 varint bytes
+        uid_seg = 1 + 1 + two.astype(np.int64) + ulen  # tag + varint + bytes
+    else:
+        ulen = np.zeros(n, np.int64)
+        two = np.zeros(n, bool)
+        uid_seg = np.ones(n, np.int64)  # null tag only
+
+    mid = model_id.encode("utf-8")
+    buf = io.BytesIO()
+    avro_io.write_long(buf, len(mid))
+    mid_prefix = buf.getvalue() + mid
+    tail = (
+        (9 if labels is not None else 1)
+        + len(mid_prefix) + 8
+        + (9 if weights is not None else 1)
+        + 1
+    )
+    sizes = uid_seg + tail
+    starts = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(sizes, out=starts[1:])
+    out = np.zeros(int(starts[-1]), dtype=np.uint8)
+
+    # uid union tag + varint + bytes
+    if uids is not None:
+        out[starts[:-1]] = 2  # union branch 1 (string)
+        z = ulen * 2  # zigzag
+        out[starts[:-1] + 1] = np.where(two, (z & 0x7F) | 0x80, z)
+        p2 = starts[:-1][two] + 2
+        out[p2] = (ulen[two] * 2) >> 7
+        # ragged scatter of the uid bytes
+        width = ub_bytes.shape[1]
+        if width:
+            uid_start = starts[:-1] + 2 + two.astype(np.int64)
+            total = int(ulen.sum())
+            rows = np.repeat(np.arange(n), ulen)
+            intra = np.arange(total) - np.repeat(np.cumsum(ulen) - ulen, ulen)
+            src_col = intra + (width - ulen[rows] if right_aligned else 0)
+            out[np.repeat(uid_start, ulen) + intra] = ub_bytes[rows, src_col]
+    # fixed tail as one [n, tail] byte matrix
+    tail_mat = np.zeros((n, tail), dtype=np.uint8)
+    pos = 0
+    if labels is not None:
+        tail_mat[:, 0] = 2
+        tail_mat[:, 1:9] = (
+            np.ascontiguousarray(labels, "<f8").view(np.uint8).reshape(n, 8)
+        )
+        pos = 9
+    else:
+        pos = 1
+    tail_mat[:, pos:pos + len(mid_prefix)] = np.frombuffer(mid_prefix, np.uint8)
+    pos += len(mid_prefix)
+    tail_mat[:, pos:pos + 8] = scores.view(np.uint8).reshape(n, 8)
+    pos += 8
+    if weights is not None:
+        tail_mat[:, pos] = 2
+        tail_mat[:, pos + 1:pos + 9] = (
+            np.ascontiguousarray(weights, "<f8").view(np.uint8).reshape(n, 8)
+        )
+        pos += 9
+    else:
+        pos += 1
+    # metadataMap null tag is the final zero byte — already zeroed
+    tail_start = starts[1:] - tail
+    out[tail_start[:, None] + np.arange(tail)] = tail_mat
+    return _RaggedBytes(out, starts)
+
+
+class _RaggedBytes:
+    """Byte stream with per-record boundaries; slicing yields sub-streams
+    (len() = record count, .tobytes() = the raw payload)."""
+
+    def __init__(self, data: np.ndarray, starts: np.ndarray):
+        self._data = data
+        self._starts = starts
+
+    def __len__(self) -> int:
+        return len(self._starts) - 1
+
+    def __getitem__(self, s: slice) -> "_RaggedBytes":
+        lo, hi, step = s.indices(len(self))
+        assert step == 1
+        return _RaggedBytes(
+            self._data[self._starts[lo]:self._starts[hi]],
+            self._starts[lo:hi + 1] - self._starts[lo],
+        )
+
+    def tobytes(self) -> bytes:
+        return self._data.tobytes()
 
 
 def read_scores(path: str | os.PathLike) -> list[dict]:
