@@ -271,12 +271,15 @@ def compile_plan(schema: dict) -> AvroPlan:
                     unfaithful.add(name)
                 if "null" in names:
                     nullable_num.add(name)
-            if len(scalars) == 1:
-                ops += [table[names[0]], slot]
-            else:
+            # a union stays a union on the wire even with ONE branch (the
+            # branch-index varint is still encoded — seen in the
+            # reference's own bad-weights fixtures, label: ["double"])
+            if isinstance(ft, list):
                 ops += [OP_UNION, len(scalars)]
                 for nm in names:
                     ops += [table[nm], slot]
+            else:
+                ops += [table[names[0]], slot]
         elif t == "array" or (nullable and _tname(inner_res) == "array"):
             arr = ft if t == "array" else inner_res
             probe: list[int] = []
@@ -315,12 +318,12 @@ def compile_plan(schema: dict) -> AvroPlan:
             vnames = [_tname(registry.resolve(b)) for b in vbranches]
             collect: list[int] | None = None
             if all(nm in MV for nm in vnames):
-                if len(vbranches) == 1:
-                    collect = [MV[vnames[0]]]
-                else:
-                    collect = [OP_UNION, len(vbranches)]
+                if isinstance(values, list):  # unions of ANY arity keep
+                    collect = [OP_UNION, len(vbranches)]  # their branch index
                     for nm in vnames:
                         collect.append(MV[nm])
+                else:
+                    collect = [MV[vnames[0]]]
             if collect is not None:
                 slot = len(map_fields)
                 map_fields[name] = slot
