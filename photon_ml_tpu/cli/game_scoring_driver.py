@@ -22,7 +22,7 @@ from photon_ml_tpu.cli.configs import (
 )
 from photon_ml_tpu.io.data_reader import read_merged
 from photon_ml_tpu.io.index_map import IndexMap
-from photon_ml_tpu.io.model_io import load_game_model, write_scores
+from photon_ml_tpu.io.model_io import DEFAULT_COMPACT_RE_THRESHOLD, load_game_model, write_scores
 from photon_ml_tpu.models.game import RandomEffectModel
 from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
 from photon_ml_tpu.transformers import GameTransformer
@@ -41,6 +41,7 @@ def run(
     evaluators: Sequence[str] = (),
     model_id: str = "",
     input_format: str = "avro",
+    compact_random_effect_threshold: int = DEFAULT_COMPACT_RE_THRESHOLD,
 ) -> dict:
     """Score ``input_data_path`` with the model at ``model_input_dir``.
 
@@ -65,7 +66,10 @@ def run(
                 for shard in index_maps
             }
         with Timed("load model"):
-            model = load_game_model(model_input_dir, index_maps)
+            model = load_game_model(
+                model_input_dir, index_maps,
+                compact_random_effect_threshold=compact_random_effect_threshold,
+            )
     else:
         # no saved stores (e.g. a reference-written model whose index maps
         # are JVM-only PalDB): one pass rebuilds maps from the model's own
@@ -81,7 +85,10 @@ def run(
 
         logger.info("no index-map stores found; rebuilding from model records")
         with Timed("load model"):
-            model, index_maps = load_game_model_and_index_maps(model_input_dir)
+            model, index_maps = load_game_model_and_index_maps(
+                model_input_dir,
+                compact_random_effect_threshold=compact_random_effect_threshold,
+            )
     entity_vocabs: dict[str, np.ndarray] = {}
 
     def set_vocab(effect_type: str, keys: np.ndarray) -> None:
@@ -149,6 +156,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--evaluators", default="")
     p.add_argument("--model-id", default="")
     p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
+    p.add_argument("--compact-random-effect-threshold", type=int,
+                   default=DEFAULT_COMPACT_RE_THRESHOLD,
+                   help="random-effect coordinates whose feature space "
+                        "exceeds this load as compact per-entity tables "
+                        "(never materializing [entities, dim])")
     return p
 
 
@@ -169,6 +181,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
         evaluators=tuple(x.strip() for x in args.evaluators.split(",") if x.strip()),
         model_id=args.model_id,
         input_format=args.input_format,
+        compact_random_effect_threshold=args.compact_random_effect_threshold,
     )
 
 

@@ -50,7 +50,12 @@ from photon_ml_tpu.hyperparameter.game_glue import (
 )
 from photon_ml_tpu.io.data_reader import read_merged
 from photon_ml_tpu.io.index_map import IndexMap
-from photon_ml_tpu.io.model_io import load_game_model, save_game_model, write_feature_stats
+from photon_ml_tpu.io.model_io import (
+    DEFAULT_COMPACT_RE_THRESHOLD,
+    load_game_model,
+    save_game_model,
+    write_feature_stats,
+)
 from photon_ml_tpu.ops.normalization import NormalizationType
 from photon_ml_tpu.types import TaskType
 from photon_ml_tpu.util import (
@@ -110,6 +115,8 @@ class GameTrainingParams:
     resume: bool = True
     #: jax.profiler trace output dir (TensorBoard); empty = disabled
     profile_dir: str | None = None
+    #: warm-start models whose RE feature space exceeds this load compact
+    compact_random_effect_threshold: int = DEFAULT_COMPACT_RE_THRESHOLD
     #: train through the fused mesh-sharded SPMD program
     #: (parallel/distributed.py) instead of the host-loop CD path — the
     #: cluster-scale mode of the reference driver
@@ -322,7 +329,12 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
     initial_model = None
     if params.model_input_dir:
         with Timed("load warm-start model"):
-            initial_model = load_game_model(params.model_input_dir, train.index_maps)
+            initial_model = load_game_model(
+                params.model_input_dir, train.index_maps,
+                compact_random_effect_threshold=(
+                    params.compact_random_effect_threshold
+                ),
+            )
 
     # save index maps next to the models so scoring is self-contained;
     # plain maps (built here OR prebuilt .keys) are cheap to copy, while
@@ -585,6 +597,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="ignore existing checkpoints (fresh run)")
     p.add_argument("--profile-dir",
                    help="write a jax.profiler (TensorBoard) trace here")
+    p.add_argument("--compact-random-effect-threshold", type=int,
+                   default=DEFAULT_COMPACT_RE_THRESHOLD,
+                   help="warm-start RE models over this feature-space size "
+                        "load as compact per-entity tables")
     p.add_argument("--distributed", action="store_true",
                    help="train through the fused mesh-sharded SPMD program "
                         "over all devices (multi-chip/multi-host path)")
@@ -639,6 +655,7 @@ def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
         checkpoint_every=args.checkpoint_every,
         resume=not args.no_resume,
         profile_dir=args.profile_dir,
+        compact_random_effect_threshold=args.compact_random_effect_threshold,
         distributed=args.distributed or bool(args.mesh),
         mesh_shape=_parse_mesh_shape(args.mesh),
     )

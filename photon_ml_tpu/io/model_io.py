@@ -50,6 +50,11 @@ METADATA_FILE = "model-metadata.json"
 #: (reference VectorUtils.DEFAULT_SPARSITY_THRESHOLD).
 DEFAULT_SPARSITY_THRESHOLD = 1e-4
 
+#: Random-effect coordinates whose feature space exceeds this load as
+#: compact per-entity tables (the ONE default shared by the library loaders
+#: and both CLI drivers — keep them from drifting).
+DEFAULT_COMPACT_RE_THRESHOLD = 1_000_000
+
 #: JVM class names used in the modelClass field, for interchange with the
 #: reference's loader (supervised/model hierarchy).
 _MODEL_CLASS = {
@@ -411,7 +416,7 @@ def load_game_model(
     *,
     coordinates_to_load: set[str] | None = None,
     dtype=np.float32,
-    compact_random_effect_threshold: int = 1_000_000,
+    compact_random_effect_threshold: int = DEFAULT_COMPACT_RE_THRESHOLD,
 ) -> GameModel:
     """Load a GAME model saved in the reference layout.
 
@@ -434,7 +439,7 @@ def load_game_model_and_index_maps(
     *,
     coordinates_to_load: set[str] | None = None,
     dtype=np.float32,
-    compact_random_effect_threshold: int = 1_000_000,
+    compact_random_effect_threshold: int = DEFAULT_COMPACT_RE_THRESHOLD,
 ) -> tuple[GameModel, dict[str, IndexMap]]:
     """Like :func:`load_game_model` but also returns the index maps in use —
     callers that need the maps afterwards (e.g. to read scoring data in the
