@@ -231,6 +231,67 @@ class TestFitDistributed:
         assert warm.best_metric < 0.5 * cold.best_metric
 
 
+class TestDistributedProjectorsAndMF:
+    def test_random_projected_re_through_estimator(self, data):
+        """RANDOM-projected RE coordinates flow through the distributed
+        estimator (library-level fused coverage exists; this pins the
+        config-to-spec projector coercion end to end)."""
+        from photon_ml_tpu.projector.projectors import ProjectorType
+
+        train, val = data
+        configs = {
+            "fe": CONFIGS["fe"],
+            "per-user": RandomEffectCoordinateConfig(
+                "userId", "per", OPT,
+                projector_type=ProjectorType.RANDOM, projected_dim=3,
+            ),
+        }
+        res = _fit(train, val, make_mesh(), configs=configs, num_iterations=2)
+        cd = _fit(train, val, None, configs=configs, num_iterations=2)
+        assert np.isclose(res.best_metric, cd.best_metric, rtol=5e-3)
+        # tables persist in ORIGINAL space (projector-agnostic scoring)
+        assert res.model.get("per-user").coefficients.shape[1] == 4
+
+    def test_mf_coordinate_through_estimator(self, data):
+        """A matrix-factorization coordinate trains inside the distributed
+        estimator alongside FE + RE."""
+        from photon_ml_tpu.estimators import MatrixFactorizationCoordinateConfig
+
+        train, val = data
+        rng = np.random.default_rng(5)
+        items = np.array([f"i{i}" for i in rng.integers(0, 10, size=train.num_samples)])
+        import dataclasses as dc
+
+        from photon_ml_tpu.data.game_data import build_game_dataset
+
+        ds = build_game_dataset(
+            labels=train.host_array("labels"),
+            feature_shards={
+                "global": train.host_array("shard/global"),
+                "per": train.host_array("shard/per"),
+            },
+            entity_keys={
+                "userId": np.array([str(k) for k in train.entity_vocabs["userId"]])[
+                    np.asarray(train.entity_idx["userId"])
+                ],
+                "itemId": items,
+            },
+            dtype=np.float64,
+        )
+        configs = {
+            "fe": CONFIGS["fe"],
+            "per-user": CONFIGS["per-user"],
+            "mf": MatrixFactorizationCoordinateConfig(
+                "userId", "itemId", num_latent_factors=2, optimization=OPT
+            ),
+        }
+        res = _fit(ds, ds, make_mesh(), configs=configs, num_iterations=2)
+        assert list(res.model.models) == ["fe", "per-user", "mf"]
+        assert np.isfinite(res.best_metric)
+        mf = res.model.get("mf")
+        assert mf.row_factors.shape[1] == 2
+
+
 class TestDistributedDivergence:
     def test_non_finite_loss_raises_before_checkpoint(self, data, tmp_path):
         """A NaN label must raise DivergenceError at the offending sweep
