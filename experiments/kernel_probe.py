@@ -1,0 +1,184 @@
+"""Hardware probe: GLM value+grad Pallas kernel variants vs stream rate.
+
+VERDICT r3 item 1: the r3 kernel achieved 0.45x the same-run stream rate
+despite being single-pass. This probe measures, IN ONE PROCESS on one chip
+assignment, a same-run stream calibration plus kernel variants that move the
+margin matvec and the gradient accumulation onto the MXU, sweep row-tile
+sizes, and try bf16 X storage.
+
+Run from repo root on the TPU (no PYTHONPATH):  python experiments/kernel_probe.py
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, D = 1 << 17, 512
+K_LO, K_HI = 16, 512
+
+
+def _round_up(x, m):
+    return ((x + m - 1) // m) * m
+
+
+def loss_and_dz(margins, y):
+    # logistic: log(1+e^m) - y*m ; dz = sigmoid(m) - y
+    l = jnp.logaddexp(0.0, margins) - y * margins
+    dz = jax.nn.sigmoid(margins) - y
+    return l, dz
+
+
+def make_kernel(margin_mode, grad_mode):
+    def kernel(x_ref, y_ref, ws_ref, w_ref, val_ref, grad_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            val_ref[0, 0] = jnp.float32(0.0)
+            grad_ref[:] = jnp.zeros_like(grad_ref)
+
+        x = x_ref[:]
+        w = w_ref[:]
+        if margin_mode == "vpu":
+            margins = jnp.sum(x.astype(jnp.float32) * w, axis=1, keepdims=True)
+        else:  # mxu
+            margins = jax.lax.dot_general(
+                x, w, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        l, dz = loss_and_dz(margins, y_ref[:])
+        r = ws_ref[:] * dz
+        val_ref[0, 0] += jnp.sum(ws_ref[:] * l)
+        if grad_mode == "vpu":
+            g = jnp.sum(r * x.astype(jnp.float32), axis=0, keepdims=True)
+        else:  # mxu
+            g = jax.lax.dot_general(
+                r.astype(x.dtype), x, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        grad_ref[:] = grad_ref[:] + g
+
+    return kernel
+
+
+def fused(margin_mode, grad_mode, tile, x, y, ws, w, semantics=None):
+    n_pad, d_pad = x.shape
+    grid = (n_pad // tile,)
+    params = {}
+    if semantics is not None:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=(semantics,))
+    value, grad = pl.pallas_call(
+        make_kernel(margin_mode, grad_mode),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, d_pad), jnp.float32),
+        ],
+        **params,
+    )(x, y, ws, w.reshape(1, d_pad))
+    return value[0, 0], grad[0]
+
+
+def measure(step_fn, d, batch, reps=4):
+    """Marginal seconds per step via K_hi-vs-K_lo scan differencing."""
+    def timed(k):
+        @jax.jit
+        def run(w0, b):
+            w, vs = jax.lax.scan(lambda w, _: step_fn(w, b), w0, None, length=k)
+            return vs.sum() + w.sum()
+
+        float(run(jnp.zeros(d, jnp.float32), batch))  # compile+sync
+        best = None
+        rng = np.random.default_rng(0)
+        for _ in range(reps):
+            w0 = jnp.asarray(rng.normal(size=d).astype(np.float32)) * 0.01
+            t0 = time.perf_counter()
+            float(run(w0, batch))
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    return max((timed(K_HI) - timed(K_LO)) / (K_HI - K_LO), 1e-9)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(N, D)).astype(np.float32)
+    w_true = rng.normal(size=D).astype(np.float32) / np.sqrt(D)
+    y = (rng.uniform(size=N) < 1 / (1 + np.exp(-(x @ w_true)))).astype(np.float32)
+    xbytes = N * D * 4
+
+    xd = jax.device_put(jnp.asarray(x))
+    xbf = jax.device_put(jnp.asarray(x, jnp.bfloat16))
+    yc = jax.device_put(jnp.asarray(y).reshape(-1, 1))
+    wsc = jax.device_put(jnp.ones((N, 1), jnp.float32))
+    batch = {"x": xd, "xbf": xbf, "y": yc, "ws": wsc}
+
+    # stream calibration: one X read per step, consumes carry
+    def stream_step(w, b):
+        return w + jnp.sum(b["x"] @ w) * 1e-30, jnp.float32(0)
+
+    m = measure(stream_step, D, batch)
+    stream = xbytes / m / 1e9
+    print(f"stream: {m*1e3:.3f} ms/step  {stream:.1f} GB/s", flush=True)
+
+    # autodiff 2-pass for reference
+    def autodiff_step(w, b):
+        def val(w):
+            margins = b["x"] @ w
+            l, _ = loss_and_dz(margins[:, None], b["y"])
+            return jnp.sum(b["ws"] * l)
+        v, g = jax.value_and_grad(val)(w)
+        return w - 1e-4 * g, v
+
+    m = measure(autodiff_step, D, batch)
+    print(f"autodiff: {m*1e3:.3f} ms/step  {xbytes/m/1e9:.1f} GB/s(1-read)  "
+          f"frac={xbytes/m/1e9/stream:.2f}", flush=True)
+
+    variants = [
+        ("vpu/vpu t1024 f32", "vpu", "vpu", 1024, "x", None),
+        ("mxu/vpu t1024 f32", "mxu", "vpu", 1024, "x", None),
+        ("vpu/mxu t1024 f32", "vpu", "mxu", 1024, "x", None),
+        ("mxu/mxu t1024 f32", "mxu", "mxu", 1024, "x", None),
+        ("mxu/mxu t512  f32", "mxu", "mxu", 512, "x", None),
+        ("mxu/mxu t2048 f32", "mxu", "mxu", 2048, "x", None),
+        ("mxu/mxu t256  f32", "mxu", "mxu", 256, "x", None),
+        ("mxu/mxu t1024 f32 arb", "mxu", "mxu", 1024, "x", "arbitrary"),
+        ("mxu/mxu t1024 bf16", "mxu", "mxu", 1024, "xbf", None),
+        ("mxu/mxu t2048 bf16", "mxu", "mxu", 2048, "xbf", None),
+        ("vpu/vpu t1024 bf16", "vpu", "vpu", 1024, "xbf", None),
+    ]
+    for name, mm, gm, tile, xkey, sem in variants:
+        nb = (2 if xkey == "xbf" else 4) * N * D
+
+        def kstep(w, b, _mm=mm, _gm=gm, _tile=tile, _xk=xkey, _sem=sem):
+            v, g = fused(_mm, _gm, _tile, b[_xk], b["y"], b["ws"], w, _sem)
+            return w - 1e-4 * g, v
+
+        try:
+            m = measure(kstep, D, batch)
+        except Exception as e:  # noqa: BLE001
+            print(f"{name}: FAILED {type(e).__name__}: {str(e)[:200]}", flush=True)
+            continue
+        gbps = nb / m / 1e9
+        print(f"{name}: {m*1e3:.3f} ms/step  {gbps:.1f} GB/s(actual)  "
+              f"eff-frac-of-stream={xbytes/m/1e9/stream:.2f} "
+              f"actual-frac={gbps/stream:.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,207 @@
+"""Probe 2: bf16 MXU workarounds + the repo's own kernel path.
+
+The direct bf16 dot_general with [1,d]/[tile,1] operands trips a Mosaic
+verification bug ('vector.broadcast'). Workarounds tried here:
+  - standard-layout [tile,d]@[d,1] matmul for margins
+  - 128-replicated-column dots (W128 / R128) so M/N are MXU-native
+Also measures the repo's fused_value_and_gradient (objective path,
+use_pallas=True) to explain BENCH_r03's 0.45 frac.
+
+Run from repo root:  python experiments/kernel_probe2.py
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, D = 1 << 17, 512
+K_LO, K_HI = 16, 512
+
+
+def loss_and_dz(margins, y):
+    l = jnp.logaddexp(0.0, margins) - y * margins
+    dz = jax.nn.sigmoid(margins) - y
+    return l, dz
+
+
+def make_kernel(margin_mode, grad_mode):
+    def kernel(x_ref, y_ref, ws_ref, w_ref, val_ref, grad_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            val_ref[0, 0] = jnp.float32(0.0)
+            grad_ref[:] = jnp.zeros_like(grad_ref)
+
+        x = x_ref[:]          # [tile, d] (maybe bf16)
+        w = w_ref[:]          # [1, d] f32 or bf16 (same dtype as x)
+        if margin_mode == "vpu":
+            margins = jnp.sum(x.astype(jnp.float32) * w.astype(jnp.float32),
+                              axis=1, keepdims=True)
+        elif margin_mode == "mxu_col":  # [tile,d]@[d,1] standard layout
+            margins = jnp.dot(x, w.reshape(-1, 1),
+                              preferred_element_type=jnp.float32)
+        elif margin_mode == "mxu_w128":  # replicate w into 128 columns
+            w128 = jnp.broadcast_to(w.reshape(-1, 1), (w.shape[1], 128))
+            margins = jnp.dot(x, w128,
+                              preferred_element_type=jnp.float32)[:, :1]
+        l, dz = loss_and_dz(margins, y_ref[:])
+        r = ws_ref[:] * dz    # [tile, 1] f32
+        val_ref[0, 0] += jnp.sum(ws_ref[:] * l)
+        if grad_mode == "vpu":
+            g = jnp.sum(r * x.astype(jnp.float32), axis=0, keepdims=True)
+        elif grad_mode == "mxu":
+            g = jax.lax.dot_general(
+                r.astype(x.dtype), x, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        elif grad_mode == "mxu_r128":
+            r128 = jnp.broadcast_to(r.astype(x.dtype), (r.shape[0], 128))
+            g = jax.lax.dot_general(
+                r128, x, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)[:1]
+        grad_ref[:] = grad_ref[:] + g
+
+    return kernel
+
+
+def fused(margin_mode, grad_mode, tile, x, y, ws, w):
+    n_pad, d_pad = x.shape
+    value, grad = pl.pallas_call(
+        make_kernel(margin_mode, grad_mode),
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, d_pad), jnp.float32),
+        ],
+    )(x, y, ws, w.reshape(1, d_pad).astype(x.dtype))
+    return value[0, 0], grad[0]
+
+
+def measure(step_fn, d, batch, reps=4):
+    def timed(k):
+        @jax.jit
+        def run(w0, b):
+            w, vs = jax.lax.scan(lambda w, _: step_fn(w, b), w0, None, length=k)
+            return vs.sum() + w.sum()
+
+        float(run(jnp.zeros(d, jnp.float32), batch))
+        best = None
+        rng = np.random.default_rng(0)
+        for _ in range(reps):
+            w0 = jnp.asarray(rng.normal(size=d).astype(np.float32)) * 0.01
+            t0 = time.perf_counter()
+            float(run(w0, batch))
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    return max((timed(K_HI) - timed(K_LO)) / (K_HI - K_LO), 1e-9)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(N, D)).astype(np.float32)
+    w_true = rng.normal(size=D).astype(np.float32) / np.sqrt(D)
+    y = (rng.uniform(size=N) < 1 / (1 + np.exp(-(x @ w_true)))).astype(np.float32)
+    xbytes = N * D * 4
+
+    xd = jax.device_put(jnp.asarray(x))
+    xbf = jax.device_put(jnp.asarray(x, jnp.bfloat16))
+    yc = jax.device_put(jnp.asarray(y).reshape(-1, 1))
+    wsc = jax.device_put(jnp.ones((N, 1), jnp.float32))
+    batch = {"x": xd, "xbf": xbf, "y": yc, "ws": wsc}
+
+    def stream_step(w, b):
+        return w + jnp.sum(b["x"] @ w) * 1e-30, jnp.float32(0)
+
+    m = measure(stream_step, D, batch)
+    stream = xbytes / m / 1e9
+    print(f"stream: {m*1e3:.3f} ms/step  {stream:.1f} GB/s", flush=True)
+
+    # correctness reference
+    def ref_vg(w, xk):
+        margins = (np.asarray(batch[xk], np.float32) @ np.asarray(w))[:, None]
+        l, dz = (np.logaddexp(0.0, margins) - y[:, None] * margins,
+                 1 / (1 + np.exp(-margins)) - y[:, None])
+        return l.sum(), (dz * np.asarray(batch[xk], np.float32)).sum(axis=0)
+
+    variants = [
+        ("mxu_col/mxu  t1024 f32", "mxu_col", "mxu", 1024, "x"),
+        ("mxu_col/mxu  t1024 bf16", "mxu_col", "mxu", 1024, "xbf"),
+        ("mxu_w128/vpu t1024 bf16", "mxu_w128", "vpu", 1024, "xbf"),
+        ("mxu_w128/mxu_r128 t1024 bf16", "mxu_w128", "mxu_r128", 1024, "xbf"),
+        ("vpu/mxu_r128 t1024 bf16", "vpu", "mxu_r128", 1024, "xbf"),
+        ("mxu_w128/mxu_r128 t2048 bf16", "mxu_w128", "mxu_r128", 2048, "xbf"),
+        ("vpu/vpu t512 bf16", "vpu", "vpu", 512, "xbf"),
+        ("vpu/vpu t2048 bf16", "vpu", "vpu", 2048, "xbf"),
+    ]
+    w0 = (rng.normal(size=D) * 0.01).astype(np.float32)
+    for name, mm, gm, tile, xkey in variants:
+        nb = (2 if xkey == "xbf" else 4) * N * D
+
+        # correctness first
+        try:
+            v, g = jax.jit(lambda w, b: fused(mm, gm, tile, b[xkey], b["y"],
+                                              b["ws"], w))(jnp.asarray(w0), batch)
+            rv, rg = ref_vg(w0, xkey)
+            verr = abs(float(v) - rv) / max(abs(rv), 1)
+            gerr = float(np.max(np.abs(np.asarray(g) - rg)) /
+                         max(np.max(np.abs(rg)), 1))
+        except Exception as e:  # noqa: BLE001
+            print(f"{name}: FAILED {type(e).__name__}: {str(e)[:160]}", flush=True)
+            continue
+
+        def kstep(w, b, _mm=mm, _gm=gm, _tile=tile, _xk=xkey):
+            v, g = fused(_mm, _gm, _tile, b[_xk], b["y"], b["ws"], w)
+            return w - 1e-4 * g, v
+
+        m = measure(kstep, D, batch)
+        gbps = nb / m / 1e9
+        print(f"{name}: {m*1e3:.3f} ms/step  {gbps:.1f} GB/s(actual)  "
+              f"eff={xbytes/m/1e9/stream:.2f} actual={gbps/stream:.2f} "
+              f"verr={verr:.1e} gerr={gerr:.1e}", flush=True)
+
+    # the repo's own kernel path (objective-level, use_pallas=True) — does it
+    # reproduce BENCH_r03's 0.45 or probe 1's 0.91?
+    from photon_ml_tpu.data.batch import LabeledPointBatch
+    from photon_ml_tpu.ops.losses import LogisticLoss
+    from photon_ml_tpu.ops.objective import GLMObjective
+
+    lb = LabeledPointBatch.create(xd, jnp.asarray(y))
+    obj = GLMObjective(LogisticLoss(), l2_weight=0.5, use_pallas=True)
+
+    def repo_step(w, b):
+        v, g = obj.value_and_gradient(w, b)
+        return w - 1e-4 * g, v
+
+    m = measure(repo_step, D, lb)
+    print(f"repo use_pallas=True: {m*1e3:.3f} ms/step  "
+          f"{xbytes/m/1e9:.1f} GB/s  frac={xbytes/m/1e9/stream:.2f}", flush=True)
+
+    obj2 = GLMObjective(LogisticLoss(), l2_weight=0.5, use_pallas=False)
+
+    def repo_auto(w, b):
+        v, g = obj2.value_and_gradient(w, b)
+        return w - 1e-4 * g, v
+
+    m = measure(repo_auto, D, lb)
+    print(f"repo autodiff:        {m*1e3:.3f} ms/step  "
+          f"{xbytes/m/1e9:.1f} GB/s  frac={xbytes/m/1e9/stream:.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

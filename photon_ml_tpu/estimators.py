@@ -282,17 +282,19 @@ class GameEstimator:
     ) -> CoordinateDescentResult:
         """fit() over the fused mesh-sharded SPMD program.
 
-        One jitted step per sweep covers the full coordinate sequence
-        (FE → REs → MFs, the fused step's fixed internal order), with
-        per-sweep validation scoring and best-model tracking — the
-        distributed analogue of run_coordinate_descent. Returns the same
-        CoordinateDescentResult shape, so drivers/tuners work unchanged.
+        One jitted step per sweep covers the full coordinate sequence in
+        the CONFIGURED ``update_sequence`` order (the fused analogue of
+        CoordinateDescent.scala:198-255 — order determines which residuals
+        each solve sees), with per-sweep validation scoring and best-model
+        tracking — the distributed analogue of run_coordinate_descent.
+        Returns the same CoordinateDescentResult shape, so drivers/tuners
+        work unchanged.
 
         Differences from the CD path, by design:
-        - coordinate update order inside a sweep is FE → REs → MFs
-          regardless of ``update_sequence`` (the sequence still selects
-          WHICH coordinates train);
-        - exactly one trainable fixed-effect coordinate is required;
+        - the FIRST trainable fixed-effect coordinate in the sequence is
+          the primary (the only one that may be sparse / feature-sharded
+          over the mesh "model" axis); additional FE coordinates train as
+          dense replicated solves inside the same fused step;
         - locked coordinates contribute fixed score offsets (their models
           pass through to the output untouched);
         - variances are computed post-hoc at the final (and best) state:
@@ -326,12 +328,11 @@ class GameEstimator:
             if cid not in locked
             and isinstance(self.coordinate_configs[cid], FixedEffectCoordinateConfig)
         ]
-        if len(fe_ids) > 1:
-            raise ValueError(
-                "distributed (mesh) training supports at most one trainable "
-                f"fixed-effect coordinate; got {fe_ids}. Train through the "
-                "coordinate-descent path (mesh=None) for multi-FE layouts."
-            )
+        # first trainable FE in the sequence is the PRIMARY (the only one
+        # that may be sparse / feature-sharded); the rest become dense
+        # replicated extra-FE coordinates inside the same fused step
+        # (reference GameEstimator.scala:746-828 iterates arbitrary
+        # coordinate sets).
         if fe_ids:
             fe_cid = fe_ids[0]
             fe_cfg: FixedEffectCoordinateConfig = self.coordinate_configs[fe_cid]
@@ -402,12 +403,33 @@ class GameEstimator:
         mf_specs: list[MatrixFactorizationStepSpec] = []
         mf_datasets = {}
         re_normalizations: dict[str, NormalizationContext] = {}
+        extra_fe_specs: list[FixedEffectStepSpec] = []
+        extra_fe_cid_of_shard: dict[str, str] = {}
         for cid in sequence:
             if cid in locked or cid == fe_cid:
                 continue
             cfg = self.coordinate_configs[cid]
             if isinstance(cfg, FixedEffectCoordinateConfig):
-                raise AssertionError("unreachable: multiple FE checked above")
+                if cfg.feature_shard_id in extra_fe_cid_of_shard or (
+                    cfg.feature_shard_id == fe_shard
+                ):
+                    raise ValueError(
+                        f"distributed training: fixed-effect coordinates "
+                        f"'{cid}' and another share feature shard "
+                        f"'{cfg.feature_shard_id}' — the fused step keys FE "
+                        "coordinates by feature shard; merge or rename"
+                    )
+                extra_fe_cid_of_shard[cfg.feature_shard_id] = cid
+                extra_fe_specs.append(FixedEffectStepSpec(
+                    feature_shard_id=cfg.feature_shard_id,
+                    optimizer=_solve_config(cfg.optimization),
+                    l2_weight=cfg.optimization.l2_weight,
+                    down_sampling_rate=cfg.optimization.down_sampling_rate,
+                    intercept_index=self.intercept_indices.get(
+                        cfg.feature_shard_id
+                    ),
+                ))
+                continue
             if isinstance(cfg, MatrixFactorizationCoordinateConfig):
                 mf_datasets[cid] = build_mf_dataset(
                     dataset, cfg.row_effect_type, cfg.col_effect_type,
@@ -471,6 +493,21 @@ class GameEstimator:
                     "(same rule as the coordinate-descent path)"
                 )
 
+        # the fused sweep trains coordinates in the CONFIGURED sequence
+        # order (CoordinateDescent.scala:198-255 — order determines which
+        # residuals each solve sees); the synthetic zero-width FE (if any)
+        # goes first, where it is a no-op
+        cid_to_name: dict[str, str] = {}
+        if fe_cid is not None:
+            cid_to_name[fe_cid] = fe_shard
+        cid_to_name.update({cid: sh for sh, cid in extra_fe_cid_of_shard.items()})
+        cid_to_name.update({cid: t for t, cid in re_cid_of_type.items()})
+        cid_to_name.update({m.name: m.name for m in mf_specs})
+        update_order = [cid_to_name[cid] for cid in sequence
+                        if cid not in locked]
+        if fe_cid is None:
+            update_order = [fe_shard] + update_order
+
         program = GameTrainProgram(
             self.task,
             FixedEffectStepSpec(
@@ -481,8 +518,13 @@ class GameEstimator:
             ),
             tuple(re_specs),
             mf_specs=tuple(mf_specs),
+            extra_fes=tuple(extra_fe_specs),
+            update_order=update_order,
             normalization=norms.get(fe_shard),
             re_normalizations=re_normalizations,
+            extra_fe_normalizations={
+                sh: norms[sh] for sh in extra_fe_cid_of_shard if sh in norms
+            },
         )
 
         # locked coordinates: fixed residual offsets + pass-through models
@@ -522,6 +564,9 @@ class GameEstimator:
             program_key: dict[str, str] = {}
             if fe_cid is not None:
                 program_key[fe_cid] = fe_shard
+            program_key.update(
+                {cid: sh for sh, cid in extra_fe_cid_of_shard.items()}
+            )
             program_key.update({cid: t for t, cid in re_cid_of_type.items()})
             remapped = {
                 program_key.get(cid, cid): m
@@ -583,6 +628,7 @@ class GameEstimator:
         )
 
         trainable_cids = {} if fe_cid is None else {fe_shard: fe_cid}
+        trainable_cids.update(extra_fe_cid_of_shard)
         trainable_cids.update(
             {t: cid for t, cid in re_cid_of_type.items()}
         )

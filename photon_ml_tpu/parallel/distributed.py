@@ -69,12 +69,18 @@ class GameTrainState:
         axis shards over "data".
     mf_rows / mf_cols: MF coordinate name -> [num_entities, k] latent-factor
         tables (row / col side); entity axes shard over "data".
+    extra_fe: feature shard id -> [d] coefficient vector for ADDITIONAL
+        fixed-effect coordinates beyond the primary (reference
+        GameEstimator.scala:746-828 trains arbitrary coordinate sets; the
+        fused step keeps one primary FE — the only one that may be sparse
+        or feature-sharded — and any number of dense replicated extras).
     """
 
     fe_coefficients: Array
     re_tables: dict[str, Array]
     mf_rows: dict[str, Array] = flax.struct.field(default_factory=dict)
     mf_cols: dict[str, Array] = flax.struct.field(default_factory=dict)
+    extra_fe: dict[str, Array] = flax.struct.field(default_factory=dict)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,6 +120,11 @@ class FixedEffectStepSpec:
     optimizer: OptimizerConfig
     l2_weight: float = 0.0
     down_sampling_rate: float = 1.0
+    #: intercept column of the feature shard — consulted for NON-primary
+    #: (extra) FE coordinates whose normalization carries shifts; the
+    #: primary FE's intercept rides the state_to_game_model /
+    #: game_model_to_state ``intercept_index`` argument (historical API).
+    intercept_index: int | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,8 +145,9 @@ class MatrixFactorizationStepSpec:
 
 def _data_pytree(dataset: GameDataset, re_specs: Sequence[RandomEffectStepSpec],
                  fe_shard: str,
-                 mf_specs: Sequence[MatrixFactorizationStepSpec] = ()) -> dict:
-    shards = {fe_shard} | {s.feature_shard_id for s in re_specs}
+                 mf_specs: Sequence[MatrixFactorizationStepSpec] = (),
+                 extra_fe_shards: Sequence[str] = ()) -> dict:
+    shards = {fe_shard} | {s.feature_shard_id for s in re_specs} | set(extra_fe_shards)
     id_types = {s.re_type for s in re_specs}
     for m in mf_specs:
         id_types |= {m.row_effect_type, m.col_effect_type}
@@ -149,10 +161,17 @@ def _data_pytree(dataset: GameDataset, re_specs: Sequence[RandomEffectStepSpec],
     # prepare_inputs), never as dense blocks
     for k in shards:
         if isinstance(dataset.feature_shards[k], SparseShard) and k != fe_shard:
-            if k not in {s.feature_shard_id for s in re_specs}:
+            # extra-FE shards are dense-only even when the same shard also
+            # feeds a random-effect coordinate (sparse shards never enter
+            # data["features"], which the extra-FE solve reads from)
+            if k in extra_fe_shards or k not in {
+                s.feature_shard_id for s in re_specs
+            }:
                 raise ValueError(
                     f"feature shard '{k}' is sparse (giant-d) but is not "
-                    "the fixed-effect shard or a random-effect shard"
+                    "the PRIMARY fixed-effect shard or a random-effect "
+                    "shard (additional fixed effects are dense-only; make "
+                    "the sparse one the primary)"
                 )
     labels = jnp.asarray(dataset.labels)
     weights = jnp.asarray(dataset.weights)
@@ -237,18 +256,23 @@ class GameTrainProgram:
         re_specs: Sequence[RandomEffectStepSpec] = (),
         *,
         mf_specs: Sequence[MatrixFactorizationStepSpec] = (),
+        extra_fes: Sequence[FixedEffectStepSpec] = (),
+        update_order: Sequence[str] | None = None,
         normalization: NormalizationContext | None = None,
         re_normalizations: Mapping[str, NormalizationContext] | None = None,
+        extra_fe_normalizations: Mapping[str, NormalizationContext] | None = None,
     ):
         self.task = task
         self.fe = fe
         self.re_specs = tuple(re_specs)
         self.mf_specs = tuple(mf_specs)
+        self.extra_fes = tuple(extra_fes)
         # coordinate names share one namespace: residual skip keys and the
-        # GameModel coordinate ids of state_to_game_model (where the FE
+        # GameModel coordinate ids of state_to_game_model (where each FE
         # coordinate is named after its feature shard)
         names = (
             [fe.feature_shard_id]
+            + [s.feature_shard_id for s in self.extra_fes]
             + [s.re_type for s in self.re_specs]
             + [m.name for m in self.mf_specs]
         )
@@ -256,8 +280,28 @@ class GameTrainProgram:
         if dupes:
             raise ValueError(
                 f"coordinate names must be unique across the FE feature "
-                f"shard, RE types, and MF names (duplicates: {sorted(dupes)})"
+                f"shards, RE types, and MF names (duplicates: {sorted(dupes)})"
             )
+        # sweep order inside one fused step (reference
+        # CoordinateDescent.scala:198-255 trains coordinates in the
+        # CONFIGURED order — order changes what residuals each solve sees).
+        # Default: primary FE, extra FEs, REs, MFs (the historical order).
+        if update_order is None:
+            self.update_order: tuple[str, ...] = tuple(names)
+        else:
+            if sorted(update_order) != sorted(names):
+                raise ValueError(
+                    f"update_order must be a permutation of the coordinate "
+                    f"names {sorted(names)}; got {list(update_order)}"
+                )
+            self.update_order = tuple(update_order)
+        self._kind = {fe.feature_shard_id: "fe"}
+        self._kind.update({s.feature_shard_id: "extra_fe" for s in self.extra_fes})
+        self._kind.update({s.re_type: "re" for s in self.re_specs})
+        self._kind.update({m.name: "mf" for m in self.mf_specs})
+        self._extra_fe_by_name = {s.feature_shard_id: s for s in self.extra_fes}
+        self._re_by_name = {s.re_type: s for s in self.re_specs}
+        self._mf_by_name = {m.name: m for m in self.mf_specs}
         reserved = {"__mf__", "__projections__"} & set(names)
         if reserved:
             raise ValueError(
@@ -275,6 +319,26 @@ class GameTrainProgram:
         self._fe_sparse_objective = SparseGLMObjective(
             loss, l2_weight=fe.l2_weight, normalization=normalization
         )
+        # additional (dense, replicated) FE coordinates
+        extra_fe_normalizations = dict(extra_fe_normalizations or {})
+        for s in self.extra_fes:
+            ctx = extra_fe_normalizations.get(s.feature_shard_id)
+            if (
+                ctx is not None and ctx.shifts is not None
+                and s.intercept_index is None
+            ):
+                raise ValueError(
+                    f"fixed-effect coordinate '{s.feature_shard_id}': "
+                    "normalization with shifts (STANDARDIZATION) requires "
+                    "the spec's intercept_index"
+                )
+        self._extra_fe_objectives = {
+            s.feature_shard_id: GLMObjective(
+                loss, l2_weight=s.l2_weight,
+                normalization=extra_fe_normalizations.get(s.feature_shard_id),
+            )
+            for s in self.extra_fes
+        }
         # RE normalization: the full factor+shift algebra. Factors scale the
         # effective coefficients; shifts subtract each entity's margin-shift
         # scalar in scoring (_re_coordinate_score) and are absorbed into the
@@ -358,6 +422,13 @@ class GameTrainProgram:
             re_tables=tables,
             mf_rows=mf_rows,
             mf_cols=mf_cols,
+            extra_fe={
+                s.feature_shard_id: jnp.zeros(
+                    (dataset.feature_shards[s.feature_shard_id].shape[1],),
+                    dtype=dtype,
+                )
+                for s in self.extra_fes
+            },
         )
 
     def _attach_re_sparse(self, data: dict, dataset: GameDataset,
@@ -396,7 +467,8 @@ class GameTrainProgram:
                        re_datasets: Mapping[str, RandomEffectDataset],
                        mf_datasets: Mapping[str, "MFDataset"] | None = None):
         data = _data_pytree(
-            dataset, self.re_specs, self.fe.feature_shard_id, self.mf_specs
+            dataset, self.re_specs, self.fe.feature_shard_id, self.mf_specs,
+            extra_fe_shards=tuple(self._extra_fe_by_name),
         )
         data = self._attach_re_sparse(data, dataset, re_datasets)
         buckets = _buckets_pytree(
@@ -593,6 +665,8 @@ class GameTrainProgram:
             re_tables={k: put_table(v) for k, v in state.re_tables.items()},
             mf_rows={k: put_table(v) for k, v in state.mf_rows.items()},
             mf_cols={k: put_table(v) for k, v in state.mf_cols.items()},
+            # extra FE vectors replicate (only the primary may feature-shard)
+            extra_fe={k: put(v, rep) for k, v in state.extra_fe.items()},
         )
         return data, sharded_buckets, state
 
@@ -614,7 +688,8 @@ class GameTrainProgram:
         ``re_datasets`` (the TRAINING datasets: their active-column lists
         define the table layout being scored)."""
         data = _data_pytree(
-            dataset, self.re_specs, self.fe.feature_shard_id, self.mf_specs
+            dataset, self.re_specs, self.fe.feature_shard_id, self.mf_specs,
+            extra_fe_shards=tuple(self._extra_fe_by_name),
         )
         return self._attach_re_sparse(data, dataset, re_datasets or {})
 
@@ -633,11 +708,8 @@ class GameTrainProgram:
         return self._score(data, state)
 
     def _score_impl(self, data, state: GameTrainState) -> Array:
-        re_scores, mf_scores = self._state_scores(data, state)
-        total = data["offsets"] + self._fe_margin_score(data, state.fe_coefficients)
-        for v in re_scores.values():
-            total = total + v
-        for v in mf_scores.values():
+        total = data["offsets"]
+        for v in self._coordinate_scores(data, state).values():
             total = total + v
         return total
 
@@ -691,160 +763,213 @@ class GameTrainProgram:
             - norm.margin_shift(eff)
         )
 
-    def _state_scores(self, data, state: GameTrainState) -> tuple[dict, dict]:
-        """(re_scores, mf_scores) of every non-FE coordinate at the state's
-        current tables — the residual terms of the CD recursion."""
-        re_scores = {
-            s.re_type: self._re_coordinate_score(
+    def _extra_fe_margin(self, data, shard_id: str, w: Array) -> Array:
+        """Pure margin of a non-primary (dense, replicated) FE coordinate."""
+        norm = self._extra_fe_objectives[shard_id].normalization
+        eff = norm.effective_coefficients(w)
+        return data["features"][shard_id] @ eff - norm.margin_shift(eff)
+
+    def _coordinate_scores(self, data, state: GameTrainState) -> dict[str, Array]:
+        """name -> score of EVERY coordinate at the state (primary FE
+        margin, extra FE margins, RE scores, MF scores) — the residual
+        terms of the CD recursion, in canonical name order (FEs, REs, MFs)
+        so residual sums accumulate in a deterministic order."""
+        scores = {
+            self.fe.feature_shard_id:
+                self._fe_margin_score(data, state.fe_coefficients)
+        }
+        for s in self.extra_fes:
+            scores[s.feature_shard_id] = self._extra_fe_margin(
+                data, s.feature_shard_id, state.extra_fe[s.feature_shard_id]
+            )
+        for s in self.re_specs:
+            scores[s.re_type] = self._re_coordinate_score(
                 data, s.re_type, state.re_tables[s.re_type], s.feature_shard_id
             )
-            for s in self.re_specs
-        }
-        mf_scores = {
-            m.name: score_matrix_factorization(
+        for m in self.mf_specs:
+            scores[m.name] = score_matrix_factorization(
                 state.mf_rows[m.name],
                 state.mf_cols[m.name],
                 data["entity_idx"][m.row_effect_type],
                 data["entity_idx"][m.col_effect_type],
             )
-            for m in self.mf_specs
-        }
-        return re_scores, mf_scores
+        return scores
 
     def _step_impl(self, data, buckets, state: GameTrainState):
-        feats = data["features"]
         labels, weights = data["labels"], data["weights"]
         base_offsets = data["offsets"]
-        fe_sparse = data.get("fe_sparse_batch")
-        fe_x = None if fe_sparse is not None else feats[self.fe.feature_shard_id]
 
-        re_scores, mf_scores = self._state_scores(data, state)
+        # Gauss-Seidel recursion over self.update_order: `scores` always
+        # holds each coordinate's score at its LATEST coefficients, so a
+        # coordinate solved later in the sweep sees the residuals of the
+        # ones already updated (reference CoordinateDescent.scala:198-255 —
+        # the configured order is semantic, not cosmetic).
+        scores = self._coordinate_scores(data, state)
 
-        def sum_scores(skip=None):
-            total = jnp.zeros_like(base_offsets)
-            for k, v in re_scores.items():
-                if k != skip:
-                    total = total + v
-            for k, v in mf_scores.items():
+        def offsets_excluding(skip=None):
+            total = base_offsets
+            for k, v in scores.items():
                 if k != skip:
                     total = total + v
             return total
 
-        # ---- fixed-effect coordinate (samples sharded; grads psum over mesh)
-        # optional down-sampling: train the FE solve on multiplied weights
-        # (0 = dropped, 1/rate = kept negative); every other use of
-        # ``weights`` — RE solves, the training loss — stays full-sample
-        fe_mult = data.get("fe_weight_multiplier")
-        fe_weights = weights if fe_mult is None else weights * fe_mult
-        if fe_sparse is not None:
-            fe_batch = fe_sparse.replace(
-                offsets=base_offsets + sum_scores(), weights=fe_weights
-            )
-            fe_objective = self._fe_sparse_objective
-        else:
-            fe_batch = LabeledPointBatch(
-                features=fe_x,
-                labels=labels,
-                offsets=base_offsets + sum_scores(),
-                weights=fe_weights,
-            )
-            fe_objective = self._fe_objective
-        fe_result = solve(
-            self.fe.optimizer, fe_objective.bind(fe_batch), state.fe_coefficients
-        )
-        fe_w = fe_result.coefficients
-        # fe_w lives in normalized space (warm starts stay there across steps);
-        # score through the same effective-coefficient algebra the objective
-        # uses so residuals and the loss are in original data space.
-        fe_score = self._fe_margin_score(data, fe_w)
-
-        # ---- random-effect coordinates (entities sharded, vmapped solves)
+        fe_w = state.fe_coefficients
+        extra_fe = dict(state.extra_fe)
         tables = dict(state.re_tables)
-        for spec in self.re_specs:
-            k = spec.re_type
-            full_offsets = base_offsets + fe_score + sum_scores(skip=k)
-            table = tables[k]
-            objective = self._re_objectives[k]
-            if spec.projector == ProjectorType.INDEX_MAP:
-                # scratch-column solve in each entity's observed columns
-                # (ports algorithm/coordinates.py's single-chip path into
-                # the SPMD program; IndexMapProjectorRDD.scala:218-257)
-                table_ext = jnp.concatenate(
-                    [table, jnp.zeros((table.shape[0], 1), table.dtype)],
-                    axis=1,
-                )
-                for b in buckets[k]:
-                    table_ext = solve_entity_bucket_indexmap(
-                        objective, spec.optimizer,
-                        b["features"], b["labels"], b["weights"],
-                        b["sample_rows"], b["entity_rows"], b["col_index"],
-                        full_offsets, table_ext,
-                    )
-                table = table_ext[:, :-1]
-            elif spec.projector == ProjectorType.RANDOM:
-                matrix = buckets["__projections__"][k]
-                for b in buckets[k]:
-                    table = solve_entity_bucket_random(
-                        objective, spec.optimizer,
-                        b["features"], b["labels"], b["weights"],
-                        b["sample_rows"], b["entity_rows"], matrix,
-                        full_offsets, table,
-                    )
-            else:
-                for b in buckets[k]:
-                    table = solve_entity_bucket(
-                        objective,
-                        spec.optimizer,
-                        b["features"],
-                        b["labels"],
-                        b["weights"],
-                        b["sample_rows"],
-                        b["entity_rows"],
-                        full_offsets,
-                        table,
-                    )
-            tables[k] = table
-            re_scores[k] = self._re_coordinate_score(
-                data, k, table, spec.feature_shard_id
-            )
-
-        # ---- matrix-factorization coordinates (alternating vmapped solves)
         mf_rows = dict(state.mf_rows)
         mf_cols = dict(state.mf_cols)
-        for m in self.mf_specs:
-            full_offsets = base_offsets + fe_score + sum_scores(skip=m.name)
-            row_idx = data["entity_idx"][m.row_effect_type]
-            col_idx = data["entity_idx"][m.col_effect_type]
-            objective = self._mf_objectives[m.name]
-            rows, cols = mf_rows[m.name], mf_cols[m.name]
-            mf_buckets = buckets["__mf__"][m.name]
-            for _ in range(m.num_alternations):
-                for b in mf_buckets["row"]:
-                    rows = solve_mf_side_bucket(
-                        objective, m.optimizer, b["labels"], b["weights"],
-                        b["entity_rows"], b["sample_rows"], col_idx, cols,
-                        full_offsets, rows,
-                    )
-                for b in mf_buckets["col"]:
-                    cols = solve_mf_side_bucket(
-                        objective, m.optimizer, b["labels"], b["weights"],
-                        b["entity_rows"], b["sample_rows"], row_idx, rows,
-                        full_offsets, cols,
-                    )
-            mf_rows[m.name], mf_cols[m.name] = rows, cols
-            mf_scores[m.name] = score_matrix_factorization(
-                rows, cols, row_idx, col_idx
-            )
 
-        total_margin = base_offsets + fe_score + sum_scores()
+        for name in self.update_order:
+            kind = self._kind[name]
+            if kind == "fe":
+                fe_w = self._solve_primary_fe(
+                    data, offsets_excluding(name), weights, fe_w
+                )
+                scores[name] = self._fe_margin_score(data, fe_w)
+            elif kind == "extra_fe":
+                extra_fe[name] = self._solve_extra_fe(
+                    data, name, offsets_excluding(name), labels, weights,
+                    extra_fe[name],
+                )
+                scores[name] = self._extra_fe_margin(data, name, extra_fe[name])
+            elif kind == "re":
+                tables[name] = self._solve_re(
+                    data, buckets, name, offsets_excluding(name), tables[name]
+                )
+                scores[name] = self._re_coordinate_score(
+                    data, name, tables[name],
+                    self._re_by_name[name].feature_shard_id,
+                )
+            else:  # mf
+                mf_rows[name], mf_cols[name], scores[name] = self._solve_mf(
+                    data, buckets, name, offsets_excluding(name),
+                    mf_rows[name], mf_cols[name],
+                )
+
+        total_margin = offsets_excluding()
         losses = self._loss.loss(total_margin, labels)
         wsum = jnp.maximum(jnp.sum(weights), 1.0)
         train_loss = jnp.sum(weights * losses) / wsum
         new_state = GameTrainState(
             fe_coefficients=fe_w, re_tables=tables,
-            mf_rows=mf_rows, mf_cols=mf_cols,
+            mf_rows=mf_rows, mf_cols=mf_cols, extra_fe=extra_fe,
         )
         return new_state, train_loss
+
+    def _solve_primary_fe(self, data, fe_offsets, weights, fe_w0):
+        """Primary fixed-effect solve (samples sharded; grads psum over the
+        mesh; the only coordinate that may be sparse / feature-sharded).
+
+        Optional down-sampling trains the FE solve on multiplied weights
+        (0 = dropped, 1/rate = kept negative); every other use of
+        ``weights`` — other solves, the training loss — stays full-sample.
+        The returned vector lives in normalized space (warm starts stay
+        there across steps); callers score through the same effective-
+        coefficient algebra the objective uses, so residuals stay in data
+        space.
+        """
+        fe_sparse = data.get("fe_sparse_batch")
+        fe_mult = data.get("fe_weight_multiplier")
+        fe_weights = weights if fe_mult is None else weights * fe_mult
+        if fe_sparse is not None:
+            fe_batch = fe_sparse.replace(offsets=fe_offsets, weights=fe_weights)
+            fe_objective = self._fe_sparse_objective
+        else:
+            fe_batch = LabeledPointBatch(
+                features=data["features"][self.fe.feature_shard_id],
+                labels=data["labels"],
+                offsets=fe_offsets,
+                weights=fe_weights,
+            )
+            fe_objective = self._fe_objective
+        return solve(
+            self.fe.optimizer, fe_objective.bind(fe_batch), fe_w0
+        ).coefficients
+
+    def _solve_extra_fe(self, data, name, full_offsets, labels, weights, w0):
+        """A non-primary FE coordinate: dense replicated solve, same
+        residual + down-sampling contract as the primary."""
+        mult = data.get("extra_fe_weight_multipliers", {}).get(name)
+        fe_weights = weights if mult is None else weights * mult
+        batch = LabeledPointBatch(
+            features=data["features"][name],
+            labels=labels,
+            offsets=full_offsets,
+            weights=fe_weights,
+        )
+        spec = self._extra_fe_by_name[name]
+        return solve(
+            spec.optimizer, self._extra_fe_objectives[name].bind(batch), w0
+        ).coefficients
+
+    def _solve_re(self, data, buckets, k, full_offsets, table):
+        """One random-effect coordinate (entities sharded, vmapped solves)."""
+        spec = self._re_by_name[k]
+        objective = self._re_objectives[k]
+        if spec.projector == ProjectorType.INDEX_MAP:
+            # scratch-column solve in each entity's observed columns
+            # (ports algorithm/coordinates.py's single-chip path into
+            # the SPMD program; IndexMapProjectorRDD.scala:218-257)
+            table_ext = jnp.concatenate(
+                [table, jnp.zeros((table.shape[0], 1), table.dtype)],
+                axis=1,
+            )
+            for b in buckets[k]:
+                table_ext = solve_entity_bucket_indexmap(
+                    objective, spec.optimizer,
+                    b["features"], b["labels"], b["weights"],
+                    b["sample_rows"], b["entity_rows"], b["col_index"],
+                    full_offsets, table_ext,
+                )
+            return table_ext[:, :-1]
+        if spec.projector == ProjectorType.RANDOM:
+            matrix = buckets["__projections__"][k]
+            for b in buckets[k]:
+                table = solve_entity_bucket_random(
+                    objective, spec.optimizer,
+                    b["features"], b["labels"], b["weights"],
+                    b["sample_rows"], b["entity_rows"], matrix,
+                    full_offsets, table,
+                )
+            return table
+        for b in buckets[k]:
+            table = solve_entity_bucket(
+                objective,
+                spec.optimizer,
+                b["features"],
+                b["labels"],
+                b["weights"],
+                b["sample_rows"],
+                b["entity_rows"],
+                full_offsets,
+                table,
+            )
+        return table
+
+    def _solve_mf(self, data, buckets, name, full_offsets, rows, cols):
+        """One matrix-factorization coordinate (alternating vmapped solves).
+        Returns (rows, cols, score)."""
+        m = self._mf_by_name[name]
+        row_idx = data["entity_idx"][m.row_effect_type]
+        col_idx = data["entity_idx"][m.col_effect_type]
+        objective = self._mf_objectives[name]
+        mf_buckets = buckets["__mf__"][name]
+        for _ in range(m.num_alternations):
+            for b in mf_buckets["row"]:
+                rows = solve_mf_side_bucket(
+                    objective, m.optimizer, b["labels"], b["weights"],
+                    b["entity_rows"], b["sample_rows"], col_idx, cols,
+                    full_offsets, rows,
+                )
+            for b in mf_buckets["col"]:
+                cols = solve_mf_side_bucket(
+                    objective, m.optimizer, b["labels"], b["weights"],
+                    b["entity_rows"], b["sample_rows"], row_idx, rows,
+                    full_offsets, cols,
+                )
+        return rows, cols, score_matrix_factorization(
+            rows, cols, row_idx, col_idx
+        )
 
 
 def compute_state_variances(
@@ -869,8 +994,9 @@ def compute_state_variances(
     skips them (they are pure output, not part of the training recursion).
     This recomputes each coordinate's residual offsets from the final
     state — the same Hessians the reference evaluates — and returns
-    (fe_variances, {re_type: [E, d] variance table}), both mapped to
-    original model space. NaN rows mark entities no bucket trained.
+    (fe_variances, {re_type: [E, d] variance table},
+    {extra_fe_shard: [d] variances}), all mapped to original model space.
+    NaN rows mark entities no bucket trained.
 
     Requires ``re_datasets`` when the program has RE coordinates (their
     buckets carry the per-entity training views). Projected RE coordinates
@@ -911,7 +1037,8 @@ def compute_state_variances(
                 )
 
     data = _data_pytree(
-        dataset, program.re_specs, program.fe.feature_shard_id, program.mf_specs
+        dataset, program.re_specs, program.fe.feature_shard_id, program.mf_specs,
+        extra_fe_shards=tuple(program._extra_fe_by_name),
     )
     # compact RE coordinates score through their entry mappings even here
     # (their scores are residual offsets for the other coordinates' Hessians)
@@ -921,10 +1048,9 @@ def compute_state_variances(
     fe_sparse = data.get("fe_sparse_batch")
 
     # the exact residual-offset algebra of the fused step, via its own
-    # scoring helpers (one definition for both the recursion and this path)
-    re_scores, mf_scores = program._state_scores(data, state)
-    scores = {**re_scores, **mf_scores}
-    fe_score = program._fe_margin_score(data, state.fe_coefficients)
+    # scoring helpers (one definition for both the recursion and this path);
+    # includes every FE coordinate's margin
+    scores = program._coordinate_scores(data, state)
 
     def offsets_excluding(skip=None):
         total = base_offsets
@@ -933,9 +1059,9 @@ def compute_state_variances(
                 total = total + v
         return total
 
-    # fixed effect: Hessian at the final coefficients with every other
+    # fixed effects: Hessian at the final coefficients with every other
     # coordinate's score as residual offset
-    fe_offsets = offsets_excluding()
+    fe_offsets = offsets_excluding(program.fe.feature_shard_id)
     if fe_sparse is not None:
         fe_batch = fe_sparse.replace(offsets=fe_offsets)
         fe_objective = program._fe_sparse_objective
@@ -950,13 +1076,26 @@ def compute_state_variances(
             fe_objective, state.fe_coefficients, fe_batch, mode=variance_mode
         )
     )
+    extra_fe_variances: dict[str, Array] = {}
+    for s in program.extra_fes:
+        k = s.feature_shard_id
+        objective = program._extra_fe_objectives[k]
+        batch = LabeledPointBatch(
+            features=data["features"][k], labels=labels,
+            offsets=offsets_excluding(k), weights=weights,
+        )
+        extra_fe_variances[k] = objective.normalization.variances_to_model_space(
+            coefficient_variances(
+                objective, state.extra_fe[k], batch, mode=variance_mode
+            )
+        )
 
     re_variances: dict[str, Array] = {}
     for spec in selected:
         ds = re_datasets[spec.re_type]
         objective = program._re_objectives[spec.re_type]
         table = state.re_tables[spec.re_type]
-        full_offsets = offsets_excluding(skip=spec.re_type) + fe_score
+        full_offsets = offsets_excluding(skip=spec.re_type)
         max_bucket = max((b.entity_rows.shape[0] for b in ds.buckets), default=1)
         resolved = resolve_variance_mode(variance_mode, ds.dim,
                                          num_problems=max_bucket)
@@ -973,7 +1112,7 @@ def compute_state_variances(
         re_variances[spec.re_type] = (
             objective.normalization.variances_to_model_space(var_table)
         )
-    return fe_variances, re_variances
+    return fe_variances, re_variances, extra_fe_variances
 
 
 def state_to_game_model(
@@ -1013,8 +1152,9 @@ def state_to_game_model(
 
     fe_variances = None
     re_variances: dict[str, Array] = {}
+    extra_fe_variances: dict[str, Array] = {}
     if compute_variance:
-        fe_variances, re_variances = compute_state_variances(
+        fe_variances, re_variances, extra_fe_variances = compute_state_variances(
             program, state, dataset, re_datasets, variance_mode=variance_mode,
             re_types=variance_re_types,
         )
@@ -1027,6 +1167,21 @@ def state_to_game_model(
         ),
         feature_shard_id=program.fe.feature_shard_id,
     )
+    for s in program.extra_fes:
+        k = s.feature_shard_id
+        norm = program._extra_fe_objectives[k].normalization
+        models[k] = FixedEffectModel(
+            glm=GeneralizedLinearModel(
+                Coefficients(
+                    means=norm.to_model_space(
+                        state.extra_fe[k], s.intercept_index
+                    ),
+                    variances=extra_fe_variances.get(k),
+                ),
+                program.task,
+            ),
+            feature_shard_id=k,
+        )
     for spec in program.re_specs:
         # normalized coordinates hold normalized-space tables in the state;
         # models are always persisted in original space (factors only, so
@@ -1138,6 +1293,18 @@ def game_model_to_state(
         fe_w = norm.from_model_space(
             jnp.asarray(fe_model.glm.coefficients.means), intercept_index
         )
+    extra_fe: dict[str, Array] = {}
+    for s in program.extra_fes:
+        k = s.feature_shard_id
+        m = coordinate_model(k)
+        if m is None:
+            extra_fe[k] = jnp.zeros(
+                (dataset.feature_shards[k].shape[1],), dtype=fe_w.dtype
+            )
+        else:
+            extra_fe[k] = program._extra_fe_objectives[k].normalization.from_model_space(
+                jnp.asarray(m.glm.coefficients.means), s.intercept_index
+            )
 
     def align(table, model_keys, vocab, coordinate: str) -> Array:
         table = np.asarray(table)
@@ -1269,7 +1436,7 @@ def game_model_to_state(
         )
     return GameTrainState(
         fe_coefficients=fe_w, re_tables=re_tables,
-        mf_rows=mf_rows, mf_cols=mf_cols,
+        mf_rows=mf_rows, mf_cols=mf_cols, extra_fe=extra_fe,
     )
 
 
@@ -1387,16 +1554,19 @@ def train_distributed(
                 re_tables=by_prefix("re_tables/"),
                 mf_rows=by_prefix("mf_rows/"),
                 mf_cols=by_prefix("mf_cols/"),
+                extra_fe=by_prefix("extra_fe/"),
             )
             expected = {
                 "re_tables": {s.re_type for s in program.re_specs},
                 "mf_rows": {m.name for m in program.mf_specs},
                 "mf_cols": {m.name for m in program.mf_specs},
+                "extra_fe": {s.feature_shard_id for s in program.extra_fes},
             }
             found = {
                 "re_tables": set(state.re_tables),
                 "mf_rows": set(state.mf_rows),
                 "mf_cols": set(state.mf_cols),
+                "extra_fe": set(state.extra_fe),
             }
             if expected != found:
                 raise ValueError(
@@ -1411,6 +1581,7 @@ def train_distributed(
                     re_tables=by_prefix("best/re_tables/"),
                     mf_rows=by_prefix("best/mf_rows/"),
                     mf_cols=by_prefix("best/mf_cols/"),
+                    extra_fe=by_prefix("best/extra_fe/"),
                 )
             best_metric = float(ckpt.meta.get("best_metric", float("nan")))
             start_sweep = min(int(ckpt.step), num_iterations)
@@ -1439,20 +1610,25 @@ def train_distributed(
         state = program.init_state(dataset, re_datasets, mf_datasets)
 
     # per-sweep FE down-sampling multipliers (stable-id splitmix64, identical
-    # to the CD path's FixedEffectCoordinate seed rotation)
-    sampler = None
-    if program.fe.down_sampling_rate < 1.0:
-        from photon_ml_tpu.sampling import down_sampler_for_task
+    # to the CD path's FixedEffectCoordinate seed rotation); keyed per FE
+    # coordinate ("" = primary)
+    samplers: dict[str, object] = {}
+    from photon_ml_tpu.sampling import down_sampler_for_task
 
-        sampler = down_sampler_for_task(
-            program.task, program.fe.down_sampling_rate
-        )
+    for key, fe_spec in [("", program.fe)] + [
+        (s.feature_shard_id, s) for s in program.extra_fes
+    ]:
+        if fe_spec.down_sampling_rate < 1.0:
+            samplers[key] = down_sampler_for_task(
+                program.task, fe_spec.down_sampling_rate
+            )
+    if samplers:
         samp_labels = dataset.host_array("labels")
         samp_weights = dataset.host_array("weights")
         samp_uids = np.asarray(dataset.unique_ids)
         samp_dtype = np.asarray(samp_weights).dtype
 
-    def sweep_multiplier(sweep: int):
+    def sweep_multiplier(sampler, sweep: int):
         new_w = sampler.down_sample_weights(
             samp_labels, samp_weights, samp_uids,
             seed=down_sampling_seed + sweep,
@@ -1490,6 +1666,7 @@ def train_distributed(
             re_tables=trim(state_.re_tables, table_sizes["re_tables"]),
             mf_rows=trim(state_.mf_rows, table_sizes["mf_rows"]),
             mf_cols=trim(state_.mf_cols, table_sizes["mf_cols"]),
+            extra_fe=dict(state_.extra_fe),
         )
     if mesh is not None:
         if put_fn is None and jax.process_count() > 1:
@@ -1523,6 +1700,7 @@ def train_distributed(
             ("re_tables/", clean.re_tables),
             ("mf_rows/", clean.mf_rows),
             ("mf_cols/", clean.mf_cols),
+            ("extra_fe/", clean.extra_fe),
         ):
             for k, v in tables.items():
                 arrays[prefix + sub + k] = to_host(v)
@@ -1530,8 +1708,12 @@ def train_distributed(
 
     losses = list(prior_losses)
     for sweep in range(start_sweep, num_iterations):
-        if sampler is not None:
-            data["fe_weight_multiplier"] = sweep_multiplier(sweep)
+        for key, sampler in samplers.items():
+            mult = sweep_multiplier(sampler, sweep)
+            if key == "":
+                data["fe_weight_multiplier"] = mult
+            else:
+                data.setdefault("extra_fe_weight_multipliers", {})[key] = mult
         state, loss = program.step(data, buckets, state)
         losses.append(float(loss))
         if check_finite and not np.isfinite(losses[-1]):
@@ -1597,6 +1779,8 @@ def train_distributed(
                          for k, v in clean.mf_rows.items()},
                 mf_cols={k: jnp.asarray(to_host(v))
                          for k, v in clean.mf_cols.items()},
+                extra_fe={k: jnp.asarray(to_host(v))
+                          for k, v in clean.extra_fe.items()},
             )
         return clean
 
