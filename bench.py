@@ -43,6 +43,19 @@ import numpy as np
 N, D, MAX_ITER, GRID = 1 << 18, 512, 30, 32
 CPU_SUBSAMPLE = 1 << 15
 HBM_ROOFLINE_GBPS = 819.0  # v5e
+GATE_REPS = 3  # median-of-K for every gate metric (chip-lottery pool:
+               # single-shot numbers swing ~2x between back-to-back reps —
+               # BASELINE.md tenancy study; VERDICT r3 #8)
+
+
+def median_spread(measure_once, reps: int = GATE_REPS):
+    """Run a marginal measurement ``reps`` times; return
+    (median, [min, max]). The spread is the honest error bar for
+    round-over-round comparisons on the shared-chip pool."""
+    import statistics
+
+    vals = [measure_once() for _ in range(reps)]
+    return statistics.median(vals), [min(vals), max(vals)]
 
 
 def _make_data(n: int, d: int, seed: int = 0):
@@ -58,8 +71,8 @@ def _grid(k: int) -> np.ndarray:
     return np.logspace(-2, 2, k)
 
 
-def bench_tpu(x, y) -> tuple[float, int]:
-    """Returns (grid_wall_clock_sec, total_lane_iters) for one 32-λ grid."""
+def bench_tpu(x, y):
+    """Returns (median_grid_sec, [min, max], lane_iters) for one 32-λ grid."""
     import jax
     import jax.numpy as jnp
 
@@ -70,7 +83,10 @@ def bench_tpu(x, y) -> tuple[float, int]:
 
     n, d = x.shape
     batch = LabeledPointBatch.create(jax.device_put(x), jax.device_put(y))
-    objective = GLMObjective(LogisticLoss(), l2_weight=0.0)
+    # use_pallas=False: the grid vmaps 32 solver lanes over one X read — a
+    # Pallas call inside the vmapped while_loop would batch into a serial
+    # per-lane loop (measured 40x slower; see ops/objective.py docstring)
+    objective = GLMObjective(LogisticLoss(), l2_weight=0.0, use_pallas=False)
 
     # The same vmapped-lane program train_glm_grid compiles, inlined so the
     # bench can read per-lane iteration counts and sync on a scalar.
@@ -104,20 +120,31 @@ def bench_tpu(x, y) -> tuple[float, int]:
         elapsed = time.perf_counter() - t0
         return elapsed, sum(int(it) for it, _ in results)
 
-    lo = min(timed(1, s)[0] for s in (1, 2))
-    hi_t, hi_iters = min((timed(3, s) for s in (10, 20)), key=lambda r: r[0])
-    marginal = max((hi_t - lo) / 2, 1e-6)
-    return marginal, hi_iters // 3
+    state = {"iters": 0, "seed": [0]}
+
+    def once():
+        s0 = state["seed"][0]
+        state["seed"][0] += 100
+        lo = min(timed(1, s0 + s)[0] for s in (1, 2))
+        hi_t, hi_iters = min(
+            (timed(3, s0 + s) for s in (10, 20)), key=lambda r: r[0]
+        )
+        state["iters"] = hi_iters // 3
+        return max((hi_t - lo) / 2, 1e-6)
+
+    marginal, spread = median_spread(once)
+    return marginal, spread, state["iters"]
 
 
 def bench_hot_loop_bandwidth(x, y) -> list[dict]:
-    """Marginal per-eval cost of the FE value+gradient hot loop, autodiff vs
-    the Pallas kernel, as achieved HBM GB/s vs roofline.
+    """Marginal per-eval cost of the FE value+gradient hot loop: the
+    single-pass Pallas kernel (the TPU DEFAULT since r4 — f32 and bf16
+    feature blocks) vs autodiff/XLA (2 X passes), as achieved HBM GB/s
+    against a same-run stream calibration.
 
     K-step ``lax.scan`` differencing (K_hi vs K_lo evals in one jit call)
-    cancels the ~100 ms fixed tunnel dispatch. Autodiff/XLA compiles to ONE
-    pass over X (the fusion the reference hand-wrote aggregators for), so
-    achieved bandwidth = |X| bytes / marginal-eval-time for both paths.
+    cancels the ~100 ms fixed tunnel dispatch; every figure is a
+    median-of-GATE_REPS marginal with [min, max] spread.
     """
     import jax
     import jax.numpy as jnp
@@ -129,75 +156,89 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
     n, d = x.shape
     xbytes = n * d * 4
     batch = LabeledPointBatch.create(jax.device_put(x), jax.device_put(y))
+    batch_bf16 = LabeledPointBatch.create(
+        jax.device_put(jnp.asarray(x, jnp.bfloat16)), jax.device_put(y)
+    )
     # wide K spread: per-call tunnel dispatch jitters by tens of ms, so the
-    # K_hi-K_lo device-time delta must dwarf it (240 extra evals = 90-180 ms
-    # of device time; BENCH_r03 saw a 80-eval spread produce a NEGATIVE
-    # marginal under dispatch noise)
+    # K_hi-K_lo device-time delta must dwarf it (BENCH_r03 saw a 80-eval
+    # spread produce a NEGATIVE marginal under dispatch noise)
     k_lo, k_hi = 16, 256
     rng = np.random.default_rng(7)
 
-    def marginal_of(step_fn):
+    def marginal_of(step_fn, b):
         def timed(k):
             @jax.jit
-            def run(w0, b):
+            def run(w0, bb):
                 w, vs = jax.lax.scan(
-                    lambda w, _: step_fn(w, b), w0, None, length=k
+                    lambda w, _: step_fn(w, bb), w0, None, length=k
                 )
                 return vs.sum() + w.sum()
 
-            float(run(jnp.zeros(d, jnp.float32), batch))  # compile+sync
+            float(run(jnp.zeros(d, jnp.float32), b))  # compile+sync
             best = None
             for _ in range(4):
                 w0 = jnp.asarray(rng.normal(size=d).astype(np.float32)) * 0.01
                 t0 = time.perf_counter()
-                float(run(w0, batch))
+                float(run(w0, b))
                 el = time.perf_counter() - t0
                 best = el if best is None or el < best else best
             return best
 
-        return max((timed(k_hi) - timed(k_lo)) / (k_hi - k_lo), 1e-6)
+        return median_spread(
+            lambda: max((timed(k_hi) - timed(k_lo)) / (k_hi - k_lo), 1e-6)
+        )
 
     # Same-run stream calibration (one X read per step): the tunnel pool's
-    # chips vary run to run (r3 study measured the SAME stream probe at
-    # 567-747 GB/s across rounds of one process), so fractions are only
-    # meaningful against a bandwidth measured on THIS run's chip — the r2
-    # "221 vs 750 GB/s" contradiction was exactly this tenancy variance.
-    stream_marginal = marginal_of(
-        lambda w, b: (w + jnp.sum(b.features @ w) * 1e-30, jnp.float32(0))
+    # chips vary run to run (567-747 GB/s across rounds of one process), so
+    # fractions are only meaningful against THIS run's chip. Note the probe
+    # is an XLA matvec and slightly UNDERESTIMATES peak (the r4 kernel
+    # sustains ~1.1x it), so fractions >1.0 are real.
+    stream_m, stream_sp = marginal_of(
+        lambda w, b: (w + jnp.sum(b.features @ w) * 1e-30, jnp.float32(0)),
+        batch,
     )
-    stream_gbps = xbytes / stream_marginal / 1e9
+    stream_gbps = xbytes / stream_m / 1e9
     out = [{
         "metric": "fe_hot_loop_stream_gbps",
         "value": round(stream_gbps, 1),
+        "spread": [round(xbytes / s / 1e9, 1) for s in stream_sp[::-1]],
         "unit": (
             f"same-run calibration: one [n, d]-matvec X read per step "
             f"(n={n}, d={d}; nominal v5e roofline {HBM_ROOFLINE_GBPS} GB/s; "
-            "hot-loop fractions below are vs THIS number)"
+            "hot-loop fractions below are vs THIS number; "
+            f"median-of-{GATE_REPS}, spread=[min,max])"
         ),
     }]
-    # X passes per eval: autodiff reads X roughly twice (margin matvec +
-    # transpose matvec, partially overlapped by XLA); the Pallas kernel
-    # makes ONE fused pass (ops/pallas_glm.py)
-    for label, use_pallas, passes_note in (
-        ("autodiff_xla", False, "~2 X passes/eval, so per-pass bandwidth is ~2x this"),
-        ("pallas_kernel", True, "1 fused X pass/eval"),
+    for label, obj, b, nbytes, note in (
+        ("autodiff_xla",
+         GLMObjective(LogisticLoss(), l2_weight=0.5, use_pallas=False),
+         batch, xbytes,
+         "~2 X passes/eval at bandwidth — the pre-r4 default"),
+        ("pallas_kernel",
+         GLMObjective(LogisticLoss(), l2_weight=0.5, use_pallas=True),
+         batch, xbytes,
+         "1 fused f32 X pass/eval on the MXU — the r4 TPU default"),
+        ("pallas_bf16",
+         GLMObjective(LogisticLoss(), l2_weight=0.5, use_pallas=True),
+         batch_bf16, xbytes // 2,
+         "1 fused bf16 X pass/eval (half the bytes), f32 accumulation"),
     ):
-        obj = GLMObjective(LogisticLoss(), l2_weight=0.5, use_pallas=use_pallas)
-
-        def step(w, b, _obj=obj):
-            v, g = _obj.value_and_gradient(w, b)
+        def step(w, bb, _obj=obj):
+            v, g = _obj.value_and_gradient(w, bb)
             return w - 1e-4 * g, v
 
-        marginal = marginal_of(step)
-        gbps = xbytes / marginal / 1e9
+        m, sp = marginal_of(step, b)
+        gbps = nbytes / m / 1e9
         out.append({
             "metric": f"fe_hot_loop_hbm_gbps_{label}",
             "value": round(gbps, 1),
+            "spread": [round(nbytes / s / 1e9, 1) for s in sp[::-1]],
             "unit": (
-                f"achieved GB/s per value+grad eval counting ONE X read "
-                f"({passes_note}), marginal over {k_hi - k_lo} extra evals; "
-                f"one-read fraction of the same-run stream rate: "
-                f"{gbps / stream_gbps:.2f}"
+                f"achieved GB/s of ACTUAL bytes per value+grad eval "
+                f"({note}; {m*1e3:.3f} ms/eval), marginal over "
+                f"{k_hi - k_lo} extra evals, median-of-{GATE_REPS}; "
+                f"one-f32-pass-equivalent fraction of the same-run stream "
+                f"rate: {xbytes / m / 1e9 / stream_gbps:.2f}"
             ),
         })
     return out
@@ -283,16 +324,25 @@ def bench_game_sweep() -> dict:
         return time.perf_counter() - t0
 
     timed(1, 0)  # compile + sync
-    lo = min(timed(1, s) for s in (1, 2))
-    hi = min(timed(5, s) for s in (3, 4))
-    per_sweep = max((hi - lo) / 4, 1e-6)
+    seed = [0]
+
+    def once():
+        s0 = seed[0]
+        seed[0] += 10
+        lo = min(timed(1, s0 + s) for s in (1, 2))
+        hi = min(timed(5, s0 + s) for s in (3, 4))
+        return max((hi - lo) / 4, 1e-6)
+
+    per_sweep, sp = median_spread(once)
     return {
         "metric": "fused_game_sweep_ms",
         "value": round(per_sweep * 1e3, 1),
+        "spread": [round(s * 1e3, 1) for s in sp],
         "unit": (
             f"marginal ms per fused GAME CD sweep (FE d={d_fe} + "
             f"{n_users}+{n_items}-entity REs d={d_re} + rescoring, "
-            f"n={n}, 10 LBFGS iters/coordinate; sweep-count differencing)"
+            f"n={n}, 10 LBFGS iters/coordinate; sweep-count differencing; "
+            f"median-of-{GATE_REPS}, spread=[min,max])"
         ),
     }
 
@@ -359,15 +409,26 @@ def bench_sparse_fe() -> dict:
         return best
 
     k_lo, k_hi = 4, 16
-    marginal = max((timed(k_hi, 0) - timed(k_lo, 100)) / (k_hi - k_lo), 1e-6)
+    seed = [0]
+
+    def once():
+        s0 = seed[0]
+        seed[0] += 1000
+        return max(
+            (timed(k_hi, s0) - timed(k_lo, s0 + 100)) / (k_hi - k_lo), 1e-6
+        )
+
+    marginal, sp = median_spread(once)
     return {
         "metric": "sparse_giant_fe_entry_iters_per_sec",
         "value": round(nnz / marginal, 1),
+        "spread": [round(nnz / s, 1) for s in sp[::-1]],
         "unit": (
             f"nonzero-entries x L-BFGS-iters/sec, sparse FE d={d:.0e} "
             f"(n={n}, nnz={nnz}, logistic, ELL padded-row layout; "
             f"marginal over {k_hi - k_lo} extra iterations, "
-            f"{marginal*1e3:.2f} ms/iter; was 733 ms/iter flat-COO in r2)"
+            f"{marginal*1e3:.2f} ms/iter, median-of-{GATE_REPS}; "
+            "was 733 ms/iter flat-COO in r2)"
         ),
     }
 
@@ -420,14 +481,25 @@ def bench_sparse_fe_1e8() -> dict:
         return best
 
     k_lo, k_hi = 2, 8
-    marginal = max((timed(k_hi, 0) - timed(k_lo, 100)) / (k_hi - k_lo), 1e-6)
+    seed = [0]
+
+    def once():
+        s0 = seed[0]
+        seed[0] += 1000
+        return max(
+            (timed(k_hi, s0) - timed(k_lo, s0 + 100)) / (k_hi - k_lo), 1e-6
+        )
+
+    marginal, sp = median_spread(once)
     return {
         "metric": "sparse_1e8_fe_tron_ms_per_iter",
         "value": round(marginal * 1e3, 1),
+        "spread": [round(s * 1e3, 1) for s in sp],
         "unit": (
             f"marginal ms per TRON outer iteration (2 CG steps), sparse FE "
             f"d={d:.0e} (n={n}, nnz={nnz}, logistic, ELL layout; "
-            f"{nnz / marginal / 1e6:.1f}M entry-iters/sec)"
+            f"{nnz / marginal / 1e6:.1f}M entry-iters/sec; "
+            f"median-of-{GATE_REPS})"
         ),
     }
 
@@ -463,7 +535,7 @@ def bench_cpu_scipy(x, y) -> float:
 def main():
     x, y = _make_data(N, D)
 
-    tpu_time, lane_iters = bench_tpu(x, y)
+    tpu_time, tpu_spread, lane_iters = bench_tpu(x, y)
     extra = bench_hot_loop_bandwidth(x[: 1 << 17], y[: 1 << 17])
     extra.append(bench_game_sweep())
     extra.append(bench_sparse_fe())
@@ -474,12 +546,14 @@ def main():
     print(json.dumps({
         "metric": "glm_lambda_grid_example_iters_per_sec",
         "value": round(rate, 1),
+        "spread": [round(N * lane_iters / s, 1) for s in tpu_spread[::-1]],
         "unit": (
             f"examples x L-BFGS-iters/sec over a {GRID}-lane vmapped "
             f"lambda grid (n={N}, d={D}, logistic, {lane_iters} lane-iters "
             f"per grid, marginal {tpu_time:.3f}s/grid via pipelined 3-vs-1 "
             "differencing — dispatch overlaps device time; vs_baseline is "
-            "iteration-normalized against scipy L-BFGS-B on the same grid)"
+            "iteration-normalized against scipy L-BFGS-B on the same grid; "
+            f"median-of-{GATE_REPS}, spread=[min,max])"
         ),
         "vs_baseline": round(rate / cpu_rate, 2),
         "extra_metrics": extra,

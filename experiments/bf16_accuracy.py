@@ -1,0 +1,46 @@
+"""bf16 feature-block accuracy study (VERDICT r4 item 2 done-criterion).
+
+Trains the same logistic problem with f32 vs bf16 X through train_glm
+(sequential path -> Pallas kernel on TPU) across a λ grid; reports frozen
+train-loss / AUC / coefficient deltas. Run on the TPU from repo root.
+"""
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.estimators import train_glm
+from photon_ml_tpu.evaluation.local_metrics import area_under_roc_curve
+from photon_ml_tpu.types import TaskType
+
+rng = np.random.default_rng(0)
+n, d = 1 << 16, 512
+w_true = rng.normal(size=d).astype(np.float32) / np.sqrt(d)
+x = rng.normal(size=(n, d)).astype(np.float32)
+y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(x @ w_true)))).astype(np.float32)
+xh, yh = x[: n // 2], y[: n // 2]
+xv, yv = x[n // 2:], y[n // 2:]
+
+for lam in (0.1, 1.0, 10.0):
+    out = {}
+    for tag, xd in (("f32", xh), ("bf16", jnp.asarray(xh, jnp.bfloat16))):
+        b = LabeledPointBatch.create(jax.device_put(jnp.asarray(xd)),
+                                     jax.device_put(jnp.asarray(yh)))
+        m = train_glm(b, TaskType.LOGISTIC_REGRESSION,
+                      regularization_weights=[lam])[lam]
+        w = np.asarray(m.coefficients.means, np.float32)
+        margins = xv @ w
+        loss = float(np.mean(np.logaddexp(0, margins) - yv * margins))
+        auc = float(area_under_roc_curve(margins, yv, np.ones_like(yv)))
+        out[tag] = (w, loss, auc)
+    wf, lf, af = out["f32"]
+    wb, lb, ab = out["bf16"]
+    print(f"lam={lam}: f32 loss={lf:.6f} auc={af:.6f} | "
+          f"bf16 loss={lb:.6f} auc={ab:.6f} | "
+          f"dloss={abs(lb-lf):.2e} dauc={abs(ab-af):.2e} "
+          f"max|dw|={np.max(np.abs(wb-wf)):.2e} "
+          f"rel|dw|={np.linalg.norm(wb-wf)/np.linalg.norm(wf):.2e}",
+          flush=True)

@@ -1,0 +1,160 @@
+"""Verification drive: r4 batch 2 (distributed scoring, INDEX_MAP
+normalization+variances, bf16 batch creation) through the product surface.
+
+Run: PYTHONPATH=/root/repo PALLAS_AXON_POOL_IPS= python experiments/drive_r4_batch2.py
+"""
+import os
+import tempfile
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io import photon_schemas as schemas
+
+# --- 1. train via the CLI driver, then score via the CLI scoring driver in
+# BOTH modes; distributed scores must match single-device bit-for-bit-ish.
+schema = {
+    "name": "DriveExampleAvro", "type": "record",
+    "fields": [
+        {"name": "uid", "type": ["string", "null"]},
+        {"name": "label", "type": "double"},
+        {"name": "features", "type": {"type": "array", "items": schemas.FEATURE_AVRO}},
+        {"name": "userFeatures", "type": {"type": "array", "items": "FeatureAvro"}},
+        {"name": "weight", "type": ["double", "null"], "default": None},
+        {"name": "offset", "type": ["double", "null"], "default": None},
+        {"name": "metadataMap",
+         "type": [{"type": "map", "values": "string"}, "null"], "default": None},
+    ],
+}
+
+def records(n, seed):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        xg, xu = rng.normal(size=5), rng.normal(size=3)
+        out.append({
+            "uid": str(i),
+            "label": float(xg.sum() + 0.5 * xu.sum() + 0.1 * rng.normal()),
+            "features": [{"name": f"g{j}", "term": "", "value": float(xg[j])} for j in range(5)],
+            "userFeatures": [{"name": f"u{j}", "term": "", "value": float(xu[j])} for j in range(3)],
+            "weight": 1.0, "offset": 0.0,
+            "metadataMap": {"userId": f"user{int(rng.integers(0, 9))}"},
+        })
+    return out
+
+from photon_ml_tpu.cli.game_training_driver import parse_args, run as train_run
+from photon_ml_tpu.cli import game_scoring_driver
+
+with tempfile.TemporaryDirectory() as tmp:
+    for split, n, seed in (("train", 400, 1), ("score", 175, 2)):
+        os.makedirs(os.path.join(tmp, split), exist_ok=True)
+        avro_io.write_container(
+            os.path.join(tmp, split, "part-00000.avro"), schema, records(n, seed)
+        )
+    train_run(parse_args([
+        "--input-data-path", os.path.join(tmp, "train"),
+        "--root-output-dir", os.path.join(tmp, "out"),
+        "--task-type", "LINEAR_REGRESSION",
+        "--feature-shard-configurations", "name=global,feature.bags=features,intercept=true",
+        "--feature-shard-configurations", "name=perUser,feature.bags=userFeatures,intercept=false",
+        "--coordinate-configurations", "name=fe,feature.shard=global,reg.weights=1,max.iter=20",
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=perUser,random.effect.type=userId,reg.weights=1,max.iter=20",
+        "--coordinate-descent-iterations", "2",
+    ]))
+    model_dir = os.path.join(tmp, "out", "best")
+    outs = {}
+    for mode, extra in (("single", []), ("dist", ["--mesh", "data=4,model=2"])):
+        summary = game_scoring_driver.main([
+            "--input-data-path", os.path.join(tmp, "score"),
+            "--model-input-dir", model_dir,
+            "--output-dir", os.path.join(tmp, f"scored-{mode}"),
+            "--evaluators", "RMSE",
+            "--feature-shard-configurations", "name=global,feature.bags=features,intercept=true",
+            "--feature-shard-configurations", "name=perUser,feature.bags=userFeatures,intercept=false",
+        ] + extra)
+        outs[mode] = summary
+        # scores written to disk
+        from photon_ml_tpu.io.model_io import read_scores
+        recs = read_scores(os.path.join(tmp, f"scored-{mode}", "scores"))
+        recs.sort(key=lambda r: int(r["uid"]))
+        outs[mode + "_scores"] = np.asarray([r["predictionScore"] for r in recs])
+    print("single RMSE:", outs["single"]["evaluations"]["RMSE"])
+    print("dist   RMSE:", outs["dist"]["evaluations"]["RMSE"])
+    np.testing.assert_allclose(
+        outs["dist_scores"], outs["single_scores"], rtol=1e-5, atol=1e-5
+    )
+    assert abs(outs["dist"]["evaluations"]["RMSE"] - outs["single"]["evaluations"]["RMSE"]) < 1e-6
+    assert outs["single"]["evaluations"]["RMSE"] < 0.5
+    print("CLI distributed scoring drive OK")
+
+# --- 2. INDEX_MAP + normalization + variances through GameEstimator
+from photon_ml_tpu.algorithm.coordinates import CoordinateOptimizationConfig
+from photon_ml_tpu.data.game_data import build_game_dataset
+from photon_ml_tpu.estimators import GameEstimator, RandomEffectCoordinateConfig
+from photon_ml_tpu.optim.optimizer import OptimizerConfig
+from photon_ml_tpu.ops.normalization import NormalizationType
+from photon_ml_tpu.projector.projectors import ProjectorType
+from photon_ml_tpu.types import TaskType
+
+rng = np.random.default_rng(0)
+n, d, E = 600, 40, 15
+users = np.array([f"u{i}" for i in rng.integers(0, E, size=n)])
+x = np.zeros((n, d), np.float32)
+y = np.zeros(n, np.float32)
+sup = {e: rng.choice(d, 6, replace=False) for e in range(E)}
+wt = {e: rng.normal(size=6) for e in range(E)}
+for i in range(n):
+    e = int(users[i][1:])
+    x[i, sup[e]] = 3.0 * rng.normal(size=6)  # non-unit scale: normalization matters
+    y[i] = x[i, sup[e]] @ wt[e] + 0.05 * rng.normal()
+ds = build_game_dataset(labels=y, feature_shards={"s": x}, entity_keys={"e": users})
+est = GameEstimator(
+    task=TaskType.LINEAR_REGRESSION,
+    coordinate_configs={
+        "re": RandomEffectCoordinateConfig(
+            "e", "s",
+            CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=50), l2_weight=0.1,
+                compute_variance=True,
+            ),
+            projector_type=ProjectorType.INDEX_MAP,
+        )
+    },
+    normalization=NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+    num_iterations=1,
+)
+res = est.fit(ds)
+m = res.model.get("re")
+scores = np.asarray(m.score_dataset(ds))
+rmse = float(np.sqrt(np.mean((scores - y) ** 2)))
+v = np.asarray(m.variances)
+finite = np.isfinite(v)
+print(f"INDEX_MAP+norm+variance: rmse={rmse:.4f} "
+      f"finite-var frac={finite.mean():.3f} min={v[finite].min():.2e}")
+assert rmse < 0.3
+assert finite.any() and (v[finite] > 0).all()
+
+# --- 3. bf16 feature block through the public batch+train path (CPU)
+from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.estimators import train_glm
+
+xb = rng.normal(size=(500, 16)).astype(np.float32)
+yb = (xb.sum(axis=1) + 0.1 * rng.normal(size=500)).astype(np.float32)
+m32 = train_glm(LabeledPointBatch.create(xb, yb), TaskType.LINEAR_REGRESSION,
+                regularization_weights=[1.0])[1.0]
+mbf = train_glm(LabeledPointBatch.create(jnp.asarray(xb, jnp.bfloat16), yb),
+                TaskType.LINEAR_REGRESSION, regularization_weights=[1.0])[1.0]
+w32 = np.asarray(m32.coefficients.means)
+wbf = np.asarray(mbf.coefficients.means)
+assert wbf.dtype == np.float32
+rel = np.linalg.norm(wbf - w32) / np.linalg.norm(w32)
+print(f"bf16 train_glm rel dw = {rel:.2e}")
+assert rel < 0.02
+print("DRIVE OK")

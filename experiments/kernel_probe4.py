@@ -1,0 +1,94 @@
+"""Probe 4: the rewritten ops/pallas_glm.py measured through the repo path.
+
+Run from anywhere: python experiments/kernel_probe4.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N, D = 1 << 17, 512
+K_LO, K_HI = 16, 512
+
+
+def measure(step_fn, d, batch, reps=4):
+    def timed(k):
+        @jax.jit
+        def run(w0, b):
+            w, vs = jax.lax.scan(lambda w, _: step_fn(w, b), w0, None, length=k)
+            return vs.sum() + w.sum()
+
+        float(run(jnp.zeros(d, jnp.float32), batch))
+        best = None
+        rng = np.random.default_rng(0)
+        for _ in range(reps):
+            w0 = jnp.asarray(rng.normal(size=d).astype(np.float32)) * 0.01
+            t0 = time.perf_counter()
+            float(run(w0, batch))
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    return max((timed(K_HI) - timed(K_LO)) / (K_HI - K_LO), 1e-9)
+
+
+def main():
+    from photon_ml_tpu.data.batch import LabeledPointBatch
+    from photon_ml_tpu.ops.losses import LogisticLoss
+    from photon_ml_tpu.ops.objective import GLMObjective
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(N, D)).astype(np.float32)
+    w_true = rng.normal(size=D).astype(np.float32) / np.sqrt(D)
+    y = (rng.uniform(size=N) < 1 / (1 + np.exp(-(x @ w_true)))).astype(np.float32)
+    xbytes = N * D * 4
+
+    b32 = LabeledPointBatch.create(jax.device_put(jnp.asarray(x)),
+                                   jax.device_put(jnp.asarray(y)))
+    bbf = LabeledPointBatch.create(jax.device_put(jnp.asarray(x, jnp.bfloat16)),
+                                   jax.device_put(jnp.asarray(y)))
+
+    def stream_step(w, b):
+        return w + jnp.sum(b.features.astype(jnp.float32) @ w) * 1e-30, jnp.float32(0)
+
+    m = measure(stream_step, D, b32)
+    stream = xbytes / m / 1e9
+    print(f"stream: {m*1e3:.3f} ms/step  {stream:.1f} GB/s", flush=True)
+
+    # correctness cross-check vs autodiff (f32)
+    obj_k = GLMObjective(LogisticLoss(), l2_weight=0.5, use_pallas=True)
+    obj_a = GLMObjective(LogisticLoss(), l2_weight=0.5, use_pallas=False)
+    w0 = jnp.asarray((rng.normal(size=D) * 0.01).astype(np.float32))
+    vk, gk = jax.jit(obj_k.value_and_gradient)(w0, b32)
+    va, ga = jax.jit(obj_a.value_and_gradient)(w0, b32)
+    print(f"f32 parity: dv={abs(float(vk)-float(va))/abs(float(va)):.1e} "
+          f"dg={float(jnp.max(jnp.abs(gk-ga))/jnp.max(jnp.abs(ga))):.1e}",
+          flush=True)
+    vb, gb = jax.jit(obj_k.value_and_gradient)(w0, bbf)
+    print(f"bf16 parity: dv={abs(float(vb)-float(va))/abs(float(va)):.1e} "
+          f"dg={float(jnp.max(jnp.abs(gb-ga))/jnp.max(jnp.abs(ga))):.1e}",
+          flush=True)
+
+    for label, obj, batch, nbytes in (
+        ("kernel f32", obj_k, b32, xbytes),
+        ("kernel bf16", obj_k, bbf, xbytes // 2),
+        ("autodiff f32", obj_a, b32, xbytes),
+    ):
+        def step(w, b, _o=obj):
+            v, g = _o.value_and_gradient(w, b)
+            return w - 1e-4 * g, v
+
+        m = measure(step, D, batch)
+        print(f"{label}: {m*1e3:.3f} ms/step  {nbytes/m/1e9:.1f} GB/s(actual)  "
+              f"eff-vs-one-f32-pass={xbytes/m/1e9/stream:.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

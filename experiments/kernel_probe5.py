@@ -1,0 +1,198 @@
+"""Probe 5: bisect the repo-wrapper slowdown.
+
+probe2 kernel (no offsets/rsum, direct operands): 0.373 ms
+repo path (offsets+rsum, col()/pad wrapper, nested jit): 0.777 ms
+
+Variants:
+  a) repo _fused_padded called directly on prepadded operands (keeps the
+     nested jit + offsets + rsum)
+  b) same kernel via a LOCAL pallas_call (no nested jit), same operands
+  c) b) without the offsets input
+  d) b) without the rsum output
+  e) full fused_value_and_gradient (reference point)
+
+Run: python experiments/kernel_probe5.py
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, D = 1 << 17, 512
+K_LO, K_HI = 16, 512
+
+
+def measure(step_fn, d, batch, reps=4):
+    def timed(k):
+        @jax.jit
+        def run(w0, b):
+            w, vs = jax.lax.scan(lambda w, _: step_fn(w, b), w0, None, length=k)
+            return vs.sum() + w.sum()
+
+        float(run(jnp.zeros(d, jnp.float32), batch))
+        best = None
+        rng = np.random.default_rng(0)
+        for _ in range(reps):
+            w0 = jnp.asarray(rng.normal(size=d).astype(np.float32)) * 0.01
+            t0 = time.perf_counter()
+            float(run(w0, batch))
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    return max((timed(K_HI) - timed(K_LO)) / (K_HI - K_LO), 1e-9)
+
+
+def local_kernel(with_o, with_rsum, x_ref, y_ref, *rest):
+    if with_o:
+        o_ref, ws_ref, w_ref = rest[0], rest[1], rest[2]
+        outs = rest[3:]
+    else:
+        ws_ref, w_ref = rest[0], rest[1]
+        o_ref = None
+        outs = rest[2:]
+    if with_rsum:
+        val_ref, grad_ref, rsum_ref = outs
+    else:
+        val_ref, grad_ref = outs
+        rsum_ref = None
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        val_ref[0, 0] = jnp.float32(0.0)
+        grad_ref[:] = jnp.zeros_like(grad_ref)
+        if rsum_ref is not None:
+            rsum_ref[0, 0] = jnp.float32(0.0)
+
+    x = x_ref[:]
+    w = w_ref[:]
+    margins = jnp.dot(x, w.reshape(-1, 1), preferred_element_type=jnp.float32)
+    if o_ref is not None:
+        margins = margins + o_ref[:]
+    l = jnp.logaddexp(0.0, margins) - y_ref[:] * margins
+    dz = jax.nn.sigmoid(margins) - y_ref[:]
+    ws = ws_ref[:]
+    r = ws * dz
+    val_ref[0, 0] += jnp.sum(ws * l)
+    if rsum_ref is not None:
+        rsum_ref[0, 0] += jnp.sum(r)
+    g = jax.lax.dot_general(r, x, (((0,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    grad_ref[:] = grad_ref[:] + g
+
+
+def local_fused(with_o, with_rsum, tile, x, y, o, ws, w):
+    n_pad, d_pad = x.shape
+    vmem = dict(memory_space=pltpu.VMEM)
+    smem = dict(memory_space=pltpu.SMEM)
+    in_specs = [
+        pl.BlockSpec((tile, d_pad), lambda i: (i, 0), **vmem),
+        pl.BlockSpec((tile, 1), lambda i: (i, 0), **vmem),
+    ]
+    args = [x, y]
+    if with_o:
+        in_specs.append(pl.BlockSpec((tile, 1), lambda i: (i, 0), **vmem))
+        args.append(o)
+    in_specs.append(pl.BlockSpec((tile, 1), lambda i: (i, 0), **vmem))
+    args.append(ws)
+    in_specs.append(pl.BlockSpec((1, d_pad), lambda i: (0, 0), **vmem))
+    args.append(w.reshape(1, d_pad))
+    out_specs = [
+        pl.BlockSpec((1, 1), lambda i: (0, 0), **smem),
+        pl.BlockSpec((1, d_pad), lambda i: (0, 0), **vmem),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        jax.ShapeDtypeStruct((1, d_pad), jnp.float32),
+    ]
+    if with_rsum:
+        out_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0), **smem))
+        out_shape.append(jax.ShapeDtypeStruct((1, 1), jnp.float32))
+    outs = pl.pallas_call(
+        functools.partial(local_kernel, with_o, with_rsum),
+        grid=(n_pad // tile,),
+        in_specs=in_specs, out_specs=out_specs, out_shape=out_shape,
+    )(*args)
+    return outs[0][0, 0], outs[1][0]
+
+
+def main():
+    from photon_ml_tpu.data.batch import LabeledPointBatch
+    from photon_ml_tpu.ops.losses import LogisticLoss
+    from photon_ml_tpu.ops import pallas_glm
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(N, D)).astype(np.float32)
+    w_true = rng.normal(size=D).astype(np.float32) / np.sqrt(D)
+    y = (rng.uniform(size=N) < 1 / (1 + np.exp(-(x @ w_true)))).astype(np.float32)
+    xbytes = N * D * 4
+
+    xd = jax.device_put(jnp.asarray(x))
+    col = lambda v: jax.device_put(jnp.asarray(v, jnp.float32).reshape(-1, 1))
+    batch = {
+        "x": xd, "y": col(y), "o": col(np.zeros(N)), "ws": col(np.ones(N)),
+    }
+    lb = LabeledPointBatch.create(xd, jnp.asarray(y))
+    loss = LogisticLoss()
+
+    def stream_step(w, b):
+        return w + jnp.sum(b["x"] @ w) * 1e-30, jnp.float32(0)
+
+    m = measure(stream_step, D, batch)
+    stream = xbytes / m / 1e9
+    print(f"stream: {m*1e3:.3f} ms/step  {stream:.1f} GB/s", flush=True)
+
+    def report(name, m):
+        print(f"{name}: {m*1e3:.3f} ms/step  {xbytes/m/1e9:.1f} GB/s  "
+              f"frac={xbytes/m/1e9/stream:.2f}", flush=True)
+
+    # a) repo _fused_padded directly (nested jit + o + rsum)
+    def step_a(w, b):
+        v, g, _ = pallas_glm._fused_padded(
+            loss, b["x"], b["y"], b["o"], b["ws"], False, w
+        )
+        return w - 1e-4 * g[:D], v
+
+    report("a) repo _fused_padded direct", measure(step_a, D, batch))
+
+    # b) local pallas_call, o + rsum, no nested jit
+    def step_b(w, b):
+        v, g = local_fused(True, True, 1024, b["x"], b["y"], b["o"], b["ws"], w)
+        return w - 1e-4 * g[:D], v
+
+    report("b) local o+rsum", measure(step_b, D, batch))
+
+    # c) local, no offsets input
+    def step_c(w, b):
+        v, g = local_fused(False, True, 1024, b["x"], b["y"], None, b["ws"], w)
+        return w - 1e-4 * g[:D], v
+
+    report("c) local rsum only", measure(step_c, D, batch))
+
+    # d) local, no rsum output
+    def step_d(w, b):
+        v, g = local_fused(True, False, 1024, b["x"], b["y"], b["o"], b["ws"], w)
+        return w - 1e-4 * g[:D], v
+
+    report("d) local o only", measure(step_d, D, batch))
+
+    # e) full wrapper (reference point)
+    def step_e(w, b):
+        v, g = pallas_glm.fused_value_and_gradient(loss, w, b)
+        return w - 1e-4 * g, v
+
+    report("e) full wrapper", measure(step_e, D, lb))
+
+
+if __name__ == "__main__":
+    main()

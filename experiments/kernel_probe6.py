@@ -1,0 +1,176 @@
+"""Probe 6: aux-column packing layouts for the GLM kernel.
+
+probe5 showed each separate [n,1] input stream costs ~0.07 ms/eval
+(narrow DMA) and the wrapper's in-jit col() construction costs ~0.25 ms.
+Variants:
+  v1) aux packed [n, 3] (y,o,ws), single input, prebuilt on device
+  v2) aux packed [n, 3] built IN-JIT from three [n] args via jnp.stack
+  v3) x passed through an in-jit zero-amount jnp.pad (elision check)
+  v4) aux [n, 3] + x zero-pad (full wrapper realism)
+  v5) v1 without rsum
+Run: python experiments/kernel_probe6.py
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, D = 1 << 17, 512
+K_LO, K_HI = 16, 512
+
+
+def measure(step_fn, d, batch, reps=4):
+    def timed(k):
+        @jax.jit
+        def run(w0, b):
+            w, vs = jax.lax.scan(lambda w, _: step_fn(w, b), w0, None, length=k)
+            return vs.sum() + w.sum()
+
+        float(run(jnp.zeros(d, jnp.float32), batch))
+        best = None
+        rng = np.random.default_rng(0)
+        for _ in range(reps):
+            w0 = jnp.asarray(rng.normal(size=d).astype(np.float32)) * 0.01
+            t0 = time.perf_counter()
+            float(run(w0, batch))
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    return max((timed(K_HI) - timed(K_LO)) / (K_HI - K_LO), 1e-9)
+
+
+def kernel(with_rsum, x_ref, aux_ref, w_ref, *outs):
+    if with_rsum:
+        val_ref, grad_ref, rsum_ref = outs
+    else:
+        val_ref, grad_ref = outs
+        rsum_ref = None
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        val_ref[0, 0] = jnp.float32(0.0)
+        grad_ref[:] = jnp.zeros_like(grad_ref)
+        if rsum_ref is not None:
+            rsum_ref[0, 0] = jnp.float32(0.0)
+
+    x = x_ref[:]
+    w = w_ref[:]
+    aux = aux_ref[:]  # [tile, 3]: y | o | ws
+    y, o, ws = aux[:, 0:1], aux[:, 1:2], aux[:, 2:3]
+    margins = jnp.dot(x, w.reshape(-1, 1), preferred_element_type=jnp.float32)
+    margins = margins + o
+    l = jnp.logaddexp(0.0, margins) - y * margins
+    dz = jax.nn.sigmoid(margins) - y
+    r = ws * dz
+    val_ref[0, 0] += jnp.sum(ws * l)
+    if rsum_ref is not None:
+        rsum_ref[0, 0] += jnp.sum(r)
+    g = jax.lax.dot_general(r, x, (((0,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    grad_ref[:] = grad_ref[:] + g
+
+
+def fused(with_rsum, tile, x, aux, w):
+    n_pad, d_pad = x.shape
+    vmem = dict(memory_space=pltpu.VMEM)
+    smem = dict(memory_space=pltpu.SMEM)
+    out_specs = [
+        pl.BlockSpec((1, 1), lambda i: (0, 0), **smem),
+        pl.BlockSpec((1, d_pad), lambda i: (0, 0), **vmem),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        jax.ShapeDtypeStruct((1, d_pad), jnp.float32),
+    ]
+    if with_rsum:
+        out_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0), **smem))
+        out_shape.append(jax.ShapeDtypeStruct((1, 1), jnp.float32))
+    outs = pl.pallas_call(
+        functools.partial(kernel, with_rsum),
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, d_pad), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((tile, 3), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((1, d_pad), lambda i: (0, 0), **vmem),
+        ],
+        out_specs=out_specs, out_shape=out_shape,
+    )(x, aux, w.reshape(1, d_pad))
+    return outs[0][0, 0], outs[1][0]
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(N, D)).astype(np.float32)
+    w_true = rng.normal(size=D).astype(np.float32) / np.sqrt(D)
+    y = (rng.uniform(size=N) < 1 / (1 + np.exp(-(x @ w_true)))).astype(np.float32)
+    xbytes = N * D * 4
+
+    xd = jax.device_put(jnp.asarray(x))
+    aux = jax.device_put(jnp.stack(
+        [jnp.asarray(y), jnp.zeros(N), jnp.ones(N)], axis=1).astype(jnp.float32))
+    batch = {
+        "x": xd, "aux": aux,
+        "y": jax.device_put(jnp.asarray(y)),
+        "o": jax.device_put(jnp.zeros(N, jnp.float32)),
+        "ws": jax.device_put(jnp.ones(N, jnp.float32)),
+    }
+
+    def stream_step(w, b):
+        return w + jnp.sum(b["x"] @ w) * 1e-30, jnp.float32(0)
+
+    m = measure(stream_step, D, batch)
+    stream = xbytes / m / 1e9
+    print(f"stream: {m*1e3:.3f} ms/step  {stream:.1f} GB/s", flush=True)
+
+    def report(name, m):
+        print(f"{name}: {m*1e3:.3f} ms/step  {xbytes/m/1e9:.1f} GB/s  "
+              f"frac={xbytes/m/1e9/stream:.2f}", flush=True)
+
+    def step_v1(w, b):
+        v, g = fused(True, 1024, b["x"], b["aux"], w)
+        return w - 1e-4 * g[:D], v
+
+    report("v1 packed aux prebuilt", measure(step_v1, D, batch))
+
+    def step_v2(w, b):
+        a = jnp.stack([b["y"], b["o"], b["ws"]], axis=1)
+        v, g = fused(True, 1024, b["x"], a, w)
+        return w - 1e-4 * g[:D], v
+
+    report("v2 packed aux in-jit stack", measure(step_v2, D, batch))
+
+    def step_v3(w, b):
+        xp = jnp.pad(b["x"], ((0, 0), (0, 0)))
+        v, g = fused(True, 1024, xp, b["aux"], w)
+        return w - 1e-4 * g[:D], v
+
+    report("v3 x zero-pad in-jit", measure(step_v3, D, batch))
+
+    def step_v4(w, b):
+        xp = jnp.pad(b["x"], ((0, 0), (0, 0)))
+        a = jnp.stack([b["y"], b["o"], b["ws"]], axis=1)
+        v, g = fused(True, 1024, xp, a, w)
+        return w - 1e-4 * g[:D], v
+
+    report("v4 both in-jit", measure(step_v4, D, batch))
+
+    def step_v5(w, b):
+        v, g = fused(False, 1024, b["x"], b["aux"], w)
+        return w - 1e-4 * g[:D], v
+
+    report("v5 packed aux no rsum", measure(step_v5, D, batch))
+
+
+if __name__ == "__main__":
+    main()

@@ -96,7 +96,14 @@ class Coordinate:
 
 def _make_objective(task: TaskType, cfg: CoordinateOptimizationConfig,
                     normalization: NormalizationContext | None,
-                    sparse: bool = False) -> GLMObjective | SparseGLMObjective:
+                    sparse: bool = False,
+                    use_pallas: bool | None = False) -> GLMObjective | SparseGLMObjective:
+    """use_pallas MUST stay False for any objective whose solve is vmapped
+    (per-entity RE/MF buckets, λ-grid lanes): `lax.while_loop` bodies trace
+    with UNBATCHED tracers, so runtime batch-tracer detection cannot see the
+    vmap — a Pallas call baked into the loop body then gets batched into a
+    serial per-lane loop (~lanes× slower; the r4 bench regression). Only
+    un-vmapped solve paths (the FE coordinate) pass None (= auto/on-TPU)."""
     if sparse:
         return SparseGLMObjective(
             loss_for_task(task),
@@ -107,7 +114,7 @@ def _make_objective(task: TaskType, cfg: CoordinateOptimizationConfig,
         loss_for_task(task),
         l2_weight=cfg.l2_weight,
         normalization=normalization,
-        use_pallas=False,
+        use_pallas=use_pallas,
     )
 
 
@@ -166,12 +173,14 @@ class FixedEffectCoordinate(Coordinate):
             )
             self._update_count += 1
             batch = batch.replace(weights=jnp.asarray(new_w, dtype=batch.weights.dtype))
-        # use_pallas=False: measured on v5e (BASELINE.md), XLA already fuses
-        # the FE value+gradient into ONE pass over X at ~750 GB/s; the
-        # hand-written kernel streams at ~270 GB/s. Autodiff IS the fast path.
+        # use_pallas=None (auto): the FE solve is the one UN-vmapped dense
+        # hot loop, where the single-pass Pallas kernel measures ~2x the
+        # autodiff path on TPU (BASELINE.md r4 study; harmless no-op for
+        # sparse batches, whose objective has no kernel)
         objective = _make_objective(
             self.task, self.config, self.normalization,
             sparse=isinstance(batch, SparseLabeledPointBatch),
+            use_pallas=None,
         )
         if self.config.compute_variance:
             # fail a full-variance-on-sparse config BEFORE the (possibly
@@ -239,26 +248,64 @@ class RandomEffectCoordinate(Coordinate):
 
     def update_model(self, model: RandomEffectModel, extra_offsets: Array | None = None):
         projector = self.re_dataset.projector_type
-        if projector != ProjectorType.IDENTITY and self.normalization is not None:
+        if projector == ProjectorType.RANDOM and self.normalization is not None:
+            # the reference's ProjectionMatrixBroadcast.projectNormalizationContext
+            # maps factors/shifts through the Gaussian sketch, which does not
+            # commute with per-feature scaling — rejected loudly here
             raise ValueError(
-                "feature normalization is not supported with projected "
-                "random-effect coordinates (normalize upstream or use "
-                "ProjectorType.IDENTITY)"
+                "feature normalization is not supported with RANDOM-projected "
+                "random-effect coordinates (use INDEX_MAP or IDENTITY)"
             )
-        if projector != ProjectorType.IDENTITY and self.config.compute_variance:
-            # the reference computes projected-space variances and un-projects
-            # them with the model; supporting that here means threading the
-            # per-entity column maps through a second scatter — not wired yet
+        if projector == ProjectorType.RANDOM and self.config.compute_variance:
+            # the reference back-projects means but passes the PROJECTED-space
+            # variance vector through unchanged (ProjectionMatrixBroadcast.
+            # scala:76) — a length-k vector on a length-d model; rejected
+            # loudly instead of reproducing that
             raise ValueError(
-                "variance computation is not supported with projected "
-                "random-effect coordinates (use ProjectorType.IDENTITY)"
+                "variance computation is not supported with RANDOM-projected "
+                "random-effect coordinates (use INDEX_MAP or IDENTITY)"
             )
-        objective = _make_objective(self.task, self.config, self.normalization)
+        if self.re_dataset.is_compact and self.normalization is not None:
+            raise ValueError(
+                "feature normalization is not supported on sparse (compact) "
+                "random-effect coordinates — normalize upstream or use a "
+                "dense shard"
+            )
+        if (
+            projector == ProjectorType.INDEX_MAP
+            and self.normalization is not None
+            and not self.re_dataset.pre_normalized
+        ):
+            raise ValueError(
+                "INDEX_MAP coordinate with normalization: the "
+                "RandomEffectDataset must be built with the same "
+                "normalization (build_random_effect_dataset(normalization=...)) "
+                "so entity blocks are pre-normalized"
+            )
+        if self.re_dataset.pre_normalized and self.normalization is None:
+            raise ValueError(
+                "this RandomEffectDataset was built pre-normalized but the "
+                "coordinate has no normalization context — its solved "
+                "tables would be emitted as model-space coefficients while "
+                "actually living in normalized space"
+            )
+        # pre-normalized INDEX_MAP blocks already hold x' = (x-shift)*factor,
+        # so the SOLVE runs on a plain objective; table/model conversions and
+        # variance post-processing still use the context
+        solve_norm = (
+            None if projector == ProjectorType.INDEX_MAP else self.normalization
+        )
+        objective = _make_objective(self.task, self.config, solve_norm)
         opt = _solve_config(self.config)
         full_offsets = self.dataset.offsets
         if extra_offsets is not None:
             full_offsets = full_offsets + extra_offsets
-        norm = objective.normalization
+        from photon_ml_tpu.ops.normalization import no_normalization
+
+        norm = (
+            self.normalization if self.normalization is not None
+            else no_normalization()
+        )
         table = norm.from_model_space(model.coefficients, self.intercept_index)
 
         if projector == ProjectorType.INDEX_MAP:
@@ -305,22 +352,54 @@ class RandomEffectCoordinate(Coordinate):
                 (b.entity_rows.shape[0] for b in self.re_dataset.buckets),
                 default=1,
             )
-            resolved = resolve_variance_mode(
-                self.config.variance_mode, self.re_dataset.dim,
-                num_problems=max_bucket,
-            )
-            kernel = (
-                _jitted_re_bucket_variances if resolved == "full"
-                else _jitted_re_bucket_variances_diagonal
-            )
-            var_table = jnp.full_like(table, jnp.nan)
-            for bucket in self.re_dataset.buckets:
-                var_table = kernel(
-                    objective,
-                    bucket.features, bucket.labels, bucket.weights,
-                    bucket.sample_rows, bucket.entity_rows,
-                    full_offsets, table, var_table,
+            if projector == ProjectorType.INDEX_MAP:
+                # solve-space diag(H⁻¹) over each entity's active columns,
+                # scattered back through the same index maps as the means —
+                # the reference's IndexMapProjectorRDD.scala:103 contract.
+                # Inactive columns keep NaN ("no variance computed": the
+                # reference's projected model simply has no entry there).
+                width = max(
+                    (int(b.features.shape[2]) for b in self.re_dataset.buckets),
+                    default=1,
                 )
+                resolved = resolve_variance_mode(
+                    self.config.variance_mode, width, num_problems=max_bucket
+                )
+                kernel = (
+                    _jitted_re_bucket_variances_indexmap
+                    if resolved == "full"
+                    else _jitted_re_bucket_variances_indexmap_diagonal
+                )
+                table_ext = jnp.concatenate(
+                    [table, jnp.zeros((table.shape[0], 1), table.dtype)],
+                    axis=1,
+                )
+                var_ext = jnp.full_like(table_ext, jnp.nan)
+                for bucket in self.re_dataset.buckets:
+                    var_ext = kernel(
+                        objective,
+                        bucket.features, bucket.labels, bucket.weights,
+                        bucket.sample_rows, bucket.entity_rows,
+                        bucket.col_index, full_offsets, table_ext, var_ext,
+                    )
+                var_table = var_ext[:, :-1]
+            else:
+                resolved = resolve_variance_mode(
+                    self.config.variance_mode, self.re_dataset.dim,
+                    num_problems=max_bucket,
+                )
+                kernel = (
+                    _jitted_re_bucket_variances if resolved == "full"
+                    else _jitted_re_bucket_variances_diagonal
+                )
+                var_table = jnp.full_like(table, jnp.nan)
+                for bucket in self.re_dataset.buckets:
+                    var_table = kernel(
+                        objective,
+                        bucket.features, bucket.labels, bucket.weights,
+                        bucket.sample_rows, bucket.entity_rows,
+                        full_offsets, table, var_table,
+                    )
             variances = norm.variances_to_model_space(var_table)
         table = norm.to_model_space(table, self.intercept_index)
         return dataclasses.replace(model, coefficients=table, variances=variances), None
@@ -440,6 +519,60 @@ def _jitted_re_bucket_variances_diagonal(
 
     vs = jax.vmap(one)(features, labels, offsets, weights, table[entity_rows])
     return var_table.at[entity_rows].set(vs)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jitted_re_bucket_variances_indexmap(
+    objective: GLMObjective,
+    features: Array,  # [e, cap, k] index-projected (possibly pre-normalized)
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    col_index: Array,  # [e, k], pad slots hold the scratch column
+    full_offsets: Array,
+    table_ext: Array,  # [E, d+1] solved coefficients + scratch
+    var_ext: Array,  # [E, d+1] accumulator (NaN = not computed)
+):
+    """Per-entity diag(H⁻¹) in the PROJECTED space (H over the entity's
+    active columns only), scattered back through the entity's index map —
+    variances travel with the means exactly as in the reference
+    (IndexMapProjectorRDD.scala:103)."""
+    offsets = _bucket_offsets(sample_rows, full_offsets)
+    w0s = table_ext[entity_rows[:, None], col_index]
+
+    def one(f, l, o, wt, w):
+        batch = LabeledPointBatch(features=f, labels=l, offsets=o, weights=wt)
+        return diag_inverse_from_hessian(objective.hessian_matrix(w, batch))
+
+    vs = jax.vmap(one)(features, labels, offsets, weights, w0s)
+    return var_ext.at[entity_rows[:, None], col_index].set(vs)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jitted_re_bucket_variances_indexmap_diagonal(
+    objective: GLMObjective,
+    features: Array,
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    col_index: Array,
+    full_offsets: Array,
+    table_ext: Array,
+    var_ext: Array,
+):
+    """Diagonal-approximation twin of
+    :func:`_jitted_re_bucket_variances_indexmap`."""
+    offsets = _bucket_offsets(sample_rows, full_offsets)
+    w0s = table_ext[entity_rows[:, None], col_index]
+
+    def one(f, l, o, wt, w):
+        batch = LabeledPointBatch(features=f, labels=l, offsets=o, weights=wt)
+        return inverse_of_diagonal(objective.hessian_diagonal(w, batch))
+
+    vs = jax.vmap(one)(features, labels, offsets, weights, w0s)
+    return var_ext.at[entity_rows[:, None], col_index].set(vs)
 
 
 def solve_entity_bucket_indexmap(

@@ -82,10 +82,18 @@ def parse_feature_shard_config(spec: str) -> tuple[str, FeatureShardConfiguratio
         raise ValueError(f"feature shard config missing {e} in {spec!r}") from None
     intercept = _bool(kv.pop("intercept", "true"))
     sparse = _bool(kv.pop("sparse", "false"))
+    pre_indexed = _bool(kv.pop("pre.indexed", "false"))
+    dimension = kv.pop("dimension", None)
     if kv:
         raise ValueError(f"unknown feature shard keys {sorted(kv)} in {spec!r}")
+    if pre_indexed and dimension is None:
+        raise ValueError(
+            f"pre.indexed=true requires dimension=N in {spec!r}"
+        )
     return name, FeatureShardConfiguration(
-        feature_bags=bags, has_intercept=intercept, sparse=sparse
+        feature_bags=bags, has_intercept=intercept, sparse=sparse,
+        pre_indexed=pre_indexed,
+        dimension=None if dimension is None else int(dimension),
     )
 
 
@@ -99,6 +107,9 @@ class CoordinateCliConfig:
     optimizer: OptimizerType = OptimizerType.LBFGS
     max_iterations: int = 100
     tolerance: float = 1e-7
+    #: TRON inner CG cap (giant-d solves budget device time with a short
+    #: CG ladder; ignored by other optimizers)
+    max_cg_iterations: int = 20
     reg_weights: tuple[float, ...] = (0.0,)
     reg_alpha: float = 0.0  # elastic-net: fraction of λ on L1
     down_sampling_rate: float = 1.0
@@ -134,6 +145,7 @@ class CoordinateCliConfig:
                 optimizer_type=self.optimizer,
                 max_iterations=self.max_iterations,
                 tolerance=self.tolerance,
+                max_cg_iterations=self.max_cg_iterations,
             ),
             l2_weight=l2,
             l1_weight=l1,
@@ -189,6 +201,8 @@ def format_coordinate_config(cfg: CoordinateCliConfig) -> str:
         parts.append(f"max.iter={cfg.max_iterations}")
     if cfg.tolerance != d["tolerance"]:
         parts.append(f"tolerance={cfg.tolerance!r}")
+    if cfg.max_cg_iterations != d["max_cg_iterations"]:
+        parts.append(f"max.cg.iter={cfg.max_cg_iterations}")
     if cfg.reg_weights != d["reg_weights"]:
         parts.append(
             "reg.weights=" + LIST_SEP.join(repr(w) for w in cfg.reg_weights)
@@ -249,6 +263,7 @@ def parse_coordinate_config(spec: str) -> CoordinateCliConfig:
         optimizer=OptimizerType(pop("optimizer", "LBFGS").upper()),
         max_iterations=int(pop("max.iter", "100")),
         tolerance=float(pop("tolerance", "1e-7")),
+        max_cg_iterations=int(pop("max.cg.iter", "20")),
         reg_weights=tuple(
             float(w) for w in pop("reg.weights", "0").split(LIST_SEP) if w
         ),

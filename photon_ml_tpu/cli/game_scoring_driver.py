@@ -20,6 +20,7 @@ from photon_ml_tpu.cli.configs import (
     evaluation_id_columns,
     parse_feature_shard_config,
 )
+from photon_ml_tpu.cli.game_training_driver import _parse_mesh_shape
 from photon_ml_tpu.io.data_reader import read_merged
 from photon_ml_tpu.io.index_map import IndexMap
 from photon_ml_tpu.io.model_io import DEFAULT_COMPACT_RE_THRESHOLD, load_game_model, write_scores
@@ -42,12 +43,23 @@ def run(
     model_id: str = "",
     input_format: str = "avro",
     compact_random_effect_threshold: int = DEFAULT_COMPACT_RE_THRESHOLD,
+    distributed: bool = False,
+    mesh_shape: dict | None = None,
+    fe_feature_sharded: bool = False,
 ) -> dict:
     """Score ``input_data_path`` with the model at ``model_input_dir``.
 
     Index maps default to the ones the training driver saved next to the
     model (<root>/index-maps); feature shard configs default to one shard
     per saved index map using the bag of the same name.
+
+    distributed/mesh_shape: score through the jitted mesh-sharded SPMD
+    program (parallel/scoring.DistributedScorer) over a ("data", "model")
+    mesh — the analogue of the reference's executor-distributed scoring
+    (GameTransformer.scala:156-203). fe_feature_sharded additionally
+    shards the FE coordinate's feature/coefficient axis over "model"
+    (mesh model>1 implies it), so column-sharded giant-d models score
+    without replicating the coefficient vector.
     """
     os.makedirs(output_dir, exist_ok=True)
     if index_maps_dir is None:
@@ -123,26 +135,46 @@ def run(
             fmt=input_format,
         )
 
+    mesh = None
+    if distributed or mesh_shape:
+        from photon_ml_tpu.parallel.multihost import make_hybrid_mesh
+
+        shape = dict(mesh_shape or {})
+        mesh = make_hybrid_mesh(shape.get("data"), shape.get("model", 1))
+        if shape.get("model", 1) > 1:
+            fe_feature_sharded = True
+        logger.info(
+            "distributed scoring: mesh %s over %d devices",
+            dict(zip(mesh.axis_names, mesh.devices.shape)), mesh.devices.size,
+        )
+
     with Timed("score"):
-        scored = GameTransformer(model=model, evaluator_specs=tuple(evaluators)).transform(
-            data.dataset
-        )
+        scored = GameTransformer(
+            model=model, evaluator_specs=tuple(evaluators),
+            mesh=mesh, fe_feature_sharded=fe_feature_sharded,
+        ).transform(data.dataset)
 
-    with Timed("save scores"):
-        write_scores(
-            os.path.join(output_dir, "scores"),
-            scored.scores,
-            records_per_file=1 << 20,
-            model_id=model_id,
-            uids=scored.unique_ids,
-            labels=np.asarray(data.dataset.labels),
-            weights=np.asarray(data.dataset.weights),
-        )
     summary = {"num_scored": int(len(scored.scores)), "evaluations": scored.evaluations}
-    with open(os.path.join(output_dir, "scoring-summary.json"), "w") as f:
-        from photon_ml_tpu.cli.game_training_driver import _json_safe
+    # multi-process rule: every rank participated in the scoring collectives
+    # above (DistributedScorer gathers across processes); only rank 0
+    # touches the shared output directory
+    import jax
 
-        json.dump(_json_safe(summary), f, indent=2, default=float)
+    if jax.process_index() == 0:
+        with Timed("save scores"):
+            write_scores(
+                os.path.join(output_dir, "scores"),
+                scored.scores,
+                records_per_file=1 << 20,
+                model_id=model_id,
+                uids=scored.unique_ids,
+                labels=np.asarray(data.dataset.host_array("labels")),
+                weights=np.asarray(data.dataset.host_array("weights")),
+            )
+        with open(os.path.join(output_dir, "scoring-summary.json"), "w") as f:
+            from photon_ml_tpu.cli.game_training_driver import _json_safe
+
+            json.dump(_json_safe(summary), f, indent=2, default=float)
     return summary
 
 
@@ -161,6 +193,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="random-effect coordinates whose feature space "
                         "exceeds this load as compact per-entity tables "
                         "(never materializing [entities, dim])")
+    p.add_argument("--distributed", action="store_true",
+                   help="score through the mesh-sharded SPMD scoring "
+                        "program over all devices")
+    p.add_argument("--mesh", default="",
+                   help="device mesh layout 'data=8,model=1' (implies "
+                        "--distributed; model>1 shards the fixed-effect "
+                        "feature/coefficient axis — required for "
+                        "column-sharded giant-d models)")
     return p
 
 
@@ -182,6 +222,8 @@ def main(argv: Sequence[str] | None = None) -> dict:
         model_id=args.model_id,
         input_format=args.input_format,
         compact_random_effect_threshold=args.compact_random_effect_threshold,
+        distributed=args.distributed,
+        mesh_shape=_parse_mesh_shape(args.mesh),
     )
 
 

@@ -315,7 +315,18 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
             )
 
     with Timed("feature shard stats"):
+        from photon_ml_tpu.io.index_map import IdentityIndexMap
+
         for shard_id, features in train.dataset.feature_shards.items():
+            imap = train.index_maps[shard_id]
+            if isinstance(imap, IdentityIndexMap) and imap.size > (1 << 20):
+                # pre-indexed giant-d space: a per-column stats file would
+                # be d records — skip (stats exist for name-term shards)
+                logger.info(
+                    "skipping feature stats for pre-indexed shard '%s' "
+                    "(d=%d)", shard_id, imap.size,
+                )
+                continue
             if isinstance(features, SparseShard):
                 stats = features.summarize(np.asarray(train.dataset.weights))
             else:
@@ -323,7 +334,7 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
             write_feature_stats(
                 os.path.join(out, "feature-stats", shard_id, "part-00000.avro"),
                 stats,
-                train.index_maps[shard_id],
+                imap,
             )
 
     initial_model = None

@@ -51,6 +51,17 @@ class LabeledPointBatch:
     def dtype(self):
         return self.features.dtype
 
+    @property
+    def solve_dtype(self):
+        """Dtype for coefficients/optimizer state: bf16 feature blocks
+        (half the HBM traffic on the hot loop) still solve in f32 — only
+        the per-product operand is bf16; accumulation, coefficients, and
+        every aux column stay f32 (CLAUDE.md: a bf16 block is a no-op
+        unless the whole read path is bf16; the solve path must NOT be)."""
+        import jax.numpy as _jnp
+
+        return _jnp.float32 if self.features.dtype == _jnp.bfloat16 else self.features.dtype
+
     def with_offsets(self, offsets: Array) -> "LabeledPointBatch":
         return self.replace(offsets=offsets)
 
@@ -72,6 +83,10 @@ class LabeledPointBatch:
         features = jnp.asarray(features, dtype=dtype)
         if dtype is None:
             dtype = features.dtype
+        if dtype == jnp.bfloat16:
+            # bf16 applies to the FEATURE BLOCK only; labels/offsets/weights
+            # stay f32 (loss math and accumulation are f32 throughout)
+            dtype = jnp.float32
         labels = jnp.asarray(labels, dtype=dtype)
         n = features.shape[0]
         if offsets is None:

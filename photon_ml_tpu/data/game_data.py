@@ -257,6 +257,11 @@ class RandomEffectDataset:
     #: per-entity projection insight (IndexMapProjectorRDD.scala:218-257)
     #: without ever materializing [E, d_re].
     active_cols: np.ndarray | None = None
+    #: True when INDEX_MAP bucket features were rewritten to normalized
+    #: space at build time (build_random_effect_dataset(normalization=...));
+    #: solvers must then use a PLAIN objective (no context) while table
+    #: conversions/scoring keep using the context
+    pre_normalized: bool = False
 
     def __post_init__(self):
         if self.projector_type is None:
@@ -415,6 +420,7 @@ def build_random_effect_dataset(
     projector_type: ProjectorType = ProjectorType.IDENTITY,
     projected_dim: int | None = None,
     features_to_samples_ratio: float | None = None,
+    normalization=None,
 ) -> RandomEffectDataset:
     """Group samples by entity into padded, size-bucketed blocks.
 
@@ -435,9 +441,29 @@ def build_random_effect_dataset(
       ceil(ratio * c) best features by |Pearson corr| with the label;
       dropped columns are zeroed in its block (and therefore excluded from
       INDEX_MAP active columns).
+    - normalization (INDEX_MAP only): an ops.normalization
+      NormalizationContext projected into each entity's active columns at
+      build time — the gathered [e, cap, k] blocks are rewritten to
+      x' = (x - shift)*factor so the per-entity solves run in normalized
+      space without a per-entity context object (reference
+      IndexMapProjectorRDD.projectNormalizationRDD:134-147 builds the
+      per-entity projected contexts; here the blocks are already dense
+      per-coordinate copies, so the rewrite is free). The scratch column
+      (pad slots) keeps factor 1 / shift 0, so padding stays zero.
     """
     shard = dataset.feature_shards[shard_id]
+    if normalization is not None and projector_type != ProjectorType.INDEX_MAP:
+        raise ValueError(
+            "build_random_effect_dataset(normalization=...) pre-normalizes "
+            "INDEX_MAP entity blocks only; IDENTITY coordinates normalize "
+            "through the objective's context, RANDOM is unsupported"
+        )
     if isinstance(shard, SparseShard):
+        if normalization is not None:
+            raise ValueError(
+                "normalization is not supported on sparse (compact) "
+                "random-effect shards"
+            )
         # giant-d_re path: per-entity observed-column blocks from the COO
         # triples, compact [E, K] coefficient table — never densify
         if projector_type not in (ProjectorType.IDENTITY, ProjectorType.INDEX_MAP):
@@ -517,6 +543,10 @@ def build_random_effect_dataset(
         bc = None
         if index_projected:
             bf, bc = _pack_index_projected(x, lane, slot, e, cap, dim)
+            if normalization is not None:
+                bf = _normalize_projected_block(
+                    bf, bc, bs, normalization, dim
+                )
         else:
             bf = np.zeros((e, cap, x.shape[1]), dtype=features.dtype)
             bf[lane, slot] = x
@@ -539,7 +569,28 @@ def build_random_effect_dataset(
         dim=dim,
         projector_type=projector_type,
         projection=projection,
+        pre_normalized=normalization is not None,
     )
+
+
+def _normalize_projected_block(bf, bc, bs, normalization, dim):
+    """Rewrite an index-projected [e, cap, k] block to normalized space:
+    x' = (x - shift)*factor over each entity's gathered columns. Valid
+    sample slots only (bs >= 0); the scratch column (bc == dim) maps to
+    factor 1 / shift 0 so padding slots stay exactly zero."""
+    out = bf
+    valid = (bs >= 0)[:, :, None]
+    if normalization.shifts is not None:
+        shift_ext = np.append(
+            np.asarray(normalization.shifts, dtype=bf.dtype), bf.dtype.type(0)
+        )
+        out = out - shift_ext[bc][:, None, :] * valid
+    if normalization.factors is not None:
+        fac_ext = np.append(
+            np.asarray(normalization.factors, dtype=bf.dtype), bf.dtype.type(1)
+        )
+        out = out * fac_ext[bc][:, None, :]
+    return out
 
 
 def _build_sparse_random_effect_dataset(

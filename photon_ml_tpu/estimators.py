@@ -223,6 +223,15 @@ class GameEstimator:
                     projector_type=cfg.projector_type,
                     projected_dim=cfg.projected_dim,
                     features_to_samples_ratio=cfg.features_to_samples_ratio,
+                    # INDEX_MAP + normalization: entity blocks are rewritten
+                    # to normalized space at build time (the reference
+                    # projects the context per entity,
+                    # IndexMapProjectorRDD.scala:134-147)
+                    normalization=(
+                        norms.get(cfg.feature_shard_id)
+                        if cfg.projector_type == ProjectorType.INDEX_MAP
+                        else None
+                    ),
                 )
                 coordinates[cid] = RandomEffectCoordinate(
                     coordinate_id=cid,
@@ -463,6 +472,11 @@ class GameEstimator:
                 projector_type=cfg.projector_type,
                 projected_dim=cfg.projected_dim,
                 features_to_samples_ratio=cfg.features_to_samples_ratio,
+                normalization=(
+                    norms.get(cfg.feature_shard_id)
+                    if cfg.projector_type == ProjectorType.INDEX_MAP
+                    else None
+                ),
             )
             norm = norms.get(cfg.feature_shard_id)
             if norm is not None:
@@ -478,18 +492,19 @@ class GameEstimator:
                 intercept_index=self.intercept_indices.get(cfg.feature_shard_id),
             ))
 
-        # fail variance-on-projected configs BEFORE the (possibly long)
-        # training run, not at model conversion afterwards (CD-path rule:
-        # only coordinates that REQUEST variances must be unprojected)
+        # fail variance-on-RANDOM configs BEFORE the (possibly long)
+        # training run (CD-path rule). INDEX_MAP/compact variances are
+        # computed in the solve space and scattered back with the means
+        # (IndexMapProjectorRDD.scala:103).
         for spec in re_specs:
             cid = re_cid_of_type[spec.re_type]
             if (
                 self.coordinate_configs[cid].optimization.compute_variance
-                and spec.projector != ProjectorType.IDENTITY
+                and spec.projector == ProjectorType.RANDOM
             ):
                 raise ValueError(
                     f"random-effect coordinate '{cid}': variance computation "
-                    "is not supported with projected/compact coordinates "
+                    "is not supported with RANDOM-projected coordinates "
                     "(same rule as the coordinate-descent path)"
                 )
 
@@ -796,9 +811,7 @@ def train_glm_grid(
             objective, variance_mode, batch.dim,
             num_problems=len(regularization_weights),
         )
-    dtype = batch.dtype
-    if dtype == jnp.bfloat16:
-        dtype = jnp.float32
+    dtype = batch.solve_dtype
     lams = sorted(float(l) for l in regularization_weights)
     l2s = jnp.asarray([(1.0 - elastic_net_alpha) * l for l in lams], dtype)
     # Mirror the sequential path's L1 rule (train_glm): the elastic-net
@@ -879,14 +892,20 @@ def _jitted_grid_solve(objective, use_owlqn, history, max_iter, tolerance,
     return jax.vmap(solve_one)(l2v, l1v)
 
 
-def _objective_for_batch(batch, loss, l2_weight, normalization):
+def _objective_for_batch(batch, loss, l2_weight, normalization,
+                         use_pallas: bool | None = False):
     """Dense or sparse objective by batch type — one train_glm[/grid] code
-    path serves both the [n, d] block and the giant-d flat-COO layout."""
+    path serves both the [n, d] block and the giant-d flat-COO layout.
+
+    use_pallas: False for vmapped-lane consumers (train_glm_grid — a Pallas
+    call inside a vmapped solver loop degrades to a serial per-lane loop),
+    None (auto) for sequential solves (train_glm)."""
     if isinstance(batch, SparseLabeledPointBatch):
         return SparseGLMObjective(
             loss, l2_weight=l2_weight, normalization=normalization
         )
-    return GLMObjective(loss, l2_weight=l2_weight, normalization=normalization)
+    return GLMObjective(loss, l2_weight=l2_weight, normalization=normalization,
+                        use_pallas=use_pallas)
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
@@ -944,11 +963,12 @@ def train_glm(
         )
     loss = loss_for_task(task)
     models: dict[float, GeneralizedLinearModel] = {}
-    w = jnp.zeros((batch.dim,), dtype=batch.dtype)
+    w = jnp.zeros((batch.dim,), dtype=batch.solve_dtype)
     for lam in sorted(regularization_weights):
         l1 = elastic_net_alpha * lam
         l2 = (1.0 - elastic_net_alpha) * lam
-        objective = _objective_for_batch(batch, loss, l2, normalization)
+        objective = _objective_for_batch(batch, loss, l2, normalization,
+                                         use_pallas=None)
         opt = optimizer
         if l1 > 0.0:
             opt = dataclasses.replace(

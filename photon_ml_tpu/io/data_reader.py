@@ -58,6 +58,12 @@ class FeatureShardConfiguration:
     feature_bags: tuple[str, ...]
     has_intercept: bool = True
     sparse: bool = False
+    #: PRE-INDEXED feature space (LibSVM integer columns / hashing-trick):
+    #: column j IS feature index j — no name-term map is materialized
+    #: (io.index_map.IdentityIndexMap), so ``dimension`` may be 10⁹⁺
+    #: (README.md:77 scale through the product path). LibSVM format only.
+    pre_indexed: bool = False
+    dimension: int | None = None
 
 
 def read_avro_records(path: str | os.PathLike) -> Iterator[dict]:
@@ -330,6 +336,13 @@ def read_merged(
     if not paths:
         raise ValueError("read_merged needs at least one input path")
 
+    pre_idx = [s for s, c in shard_configs.items() if c.pre_indexed]
+    if pre_idx and fmt != "libsvm":
+        raise ValueError(
+            f"pre-indexed shards {pre_idx} require the libsvm input format "
+            "(avro features are name-term keyed; index them with feature "
+            "maps instead)"
+        )
     if fmt == "libsvm":
         # CSR fast path: native C++ tokenizer (photon_ml_tpu/native/
         # libsvm_loader.cpp) + vectorized dense assembly, no per-record dicts
@@ -661,20 +674,59 @@ def _read_merged_libsvm(
     "features"; LibSVM carries no id/metadata columns)."""
     from photon_ml_tpu.io.libsvm_native import concat_libsvm, parse_libsvm
 
-    data = concat_libsvm([parse_libsvm(p) for p in paths])
+    def expand(p):
+        # directories expand to their (sorted) regular files, matching the
+        # avro path's part-file convention
+        if os.path.isdir(p):
+            return [
+                os.path.join(p, f) for f in sorted(os.listdir(p))
+                if not f.startswith(("_", "."))
+                and os.path.isfile(os.path.join(p, f))
+            ]
+        return [p]
+
+    files = [f for p in paths for f in expand(p)]
+    if not files:
+        raise ValueError(f"no LibSVM files found under {list(paths)}")
+    data = concat_libsvm([parse_libsvm(p) for p in files])
     n = data.num_rows
     distinct = np.unique(data.cols) if data.nnz else np.asarray([], dtype=np.uint32)
 
     if index_maps is None:
-        index_maps = {
-            shard: IndexMap.from_keys(
-                {feature_key(str(int(j)), "") for j in distinct}
-                if "features" in cfg.feature_bags
-                else set(),
-                add_intercept=cfg.has_intercept,
-            )
-            for shard, cfg in shard_configs.items()
-        }
+        from photon_ml_tpu.io.index_map import IdentityIndexMap
+
+        index_maps = {}
+        for shard, cfg in shard_configs.items():
+            if cfg.pre_indexed:
+                if cfg.dimension is None:
+                    raise ValueError(
+                        f"pre-indexed shard '{shard}' needs a dimension"
+                    )
+                if cfg.dimension > np.iinfo(np.int32).max:
+                    import jax as _jax
+
+                    if not _jax.config.jax_enable_x64:
+                        # without x64, device int arrays silently downcast
+                        # to int32 and column ids >= 2^31 would wrap
+                        raise ValueError(
+                            f"pre-indexed shard '{shard}': dimension "
+                            f"{cfg.dimension} exceeds int32; enable "
+                            "jax_enable_x64 for >2^31-column spaces"
+                        )
+                if cfg.has_intercept:
+                    raise ValueError(
+                        f"pre-indexed shard '{shard}': intercept=false "
+                        "required (an appended intercept would change the "
+                        "declared dimension; include it in the data)"
+                    )
+                index_maps[shard] = IdentityIndexMap(cfg.dimension)
+            else:
+                index_maps[shard] = IndexMap.from_keys(
+                    {feature_key(str(int(j)), "") for j in distinct}
+                    if "features" in cfg.feature_bags
+                    else set(),
+                    add_intercept=cfg.has_intercept,
+                )
 
     row_idx = np.repeat(
         np.arange(n, dtype=np.intp), np.diff(data.row_offsets).astype(np.intp)
@@ -683,6 +735,29 @@ def _read_merged_libsvm(
     intercept_indices: dict[str, int] = {}
     for shard, cfg in shard_configs.items():
         imap = index_maps[shard]
+        if cfg.pre_indexed and "features" in cfg.feature_bags:
+            # columns used AS-IS against the declared dimension; sparse
+            # keeps the COO triples (the only layout that exists at 10⁹)
+            dim = int(imap.size)
+            oob = int((data.cols >= dim).sum()) if data.nnz else 0
+            if oob:
+                raise ValueError(
+                    f"pre-indexed shard '{shard}': {oob} entries have "
+                    f"column >= dimension {dim}"
+                )
+            if cfg.sparse:
+                feature_shards[shard] = SparseShard(
+                    rows=row_idx.astype(np.int64),
+                    cols=data.cols.astype(np.int64),
+                    vals=data.vals.astype(dtype),
+                    num_samples=n, feature_dim=dim,
+                )
+            else:
+                feature_shards[shard] = _scatter_dense(
+                    n, dim, row_idx, data.cols.astype(np.int64),
+                    data.vals, dtype,
+                )
+            continue
         if "features" in cfg.feature_bags and data.nnz:
             # CSR col j -> shard column via the index map; searchsorted over
             # the distinct indices keeps memory O(distinct), independent of

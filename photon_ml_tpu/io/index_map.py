@@ -126,7 +126,15 @@ class IndexMap(Mapping[str, int]):
         maps: dict[str, IndexMap] = {}
         directory = str(directory)
         for fname in sorted(os.listdir(directory)):
-            if fname.endswith(".keys"):
+            if fname.endswith(".identity.json"):
+                shard = fname[: -len(".identity.json")]
+                if shard not in maps:
+                    with open(os.path.join(directory, fname)) as f:
+                        meta = json.load(f)
+                    maps[shard] = IdentityIndexMap(
+                        meta["dim"], intercept_index=meta.get("intercept_index")
+                    )
+            elif fname.endswith(".keys"):
                 shard = fname[: -len(".keys")]
                 if shard not in maps:
                     maps[shard] = IndexMap.load(directory, shard)
@@ -168,42 +176,84 @@ class IndexMap(Mapping[str, int]):
         return cls(mapping)
 
 
-class IdentityIndexMap(Mapping[str, int]):
-    """Keys are already stringified integers (reference
-    IdentityIndexMapLoader, used when data carries numeric feature ids)."""
+class IdentityIndexMap(IndexMap):
+    """An O(1) virtual map for PRE-INDEXED feature spaces: key "<j>" (term
+    empty, with or without the delimiter) maps to integer j for
+    0 <= j < dim; nothing is materialized (reference
+    IdentityIndexMapLoader, used when data carries numeric feature ids).
 
-    def __init__(self, size: int):
-        self._size = size
+    This is how a literal d=10⁹ coordinate flows through the product path
+    (config -> reader -> estimator): the reference sizes its feature space
+    by name-term maps (off-heap PalDB at production scale), which caps any
+    in-test dimension at the number of DISTINCT OBSERVED names; pre-indexed
+    data (LibSVM integer columns, hashing-trick features) needs no such
+    materialization. Iteration is refused above a size guard — callers that
+    enumerate entries (feature-stats writers) must special-case this type.
+    """
+
+    _ITER_GUARD = 1 << 20
+
+    def __init__(self, dim: int, *, intercept_index: int | None = None):
+        # deliberately NOT calling super().__init__: no dict exists
+        self._dim = int(dim)
+        self._intercept = intercept_index
 
     def __getitem__(self, key: str) -> int:
-        idx = int(split_feature_key(key)[0]) if DELIMITER in key else int(key)
-        if 0 <= idx < self._size:
-            return idx
-        raise KeyError(key)
+        idx = self.get_index(key)
+        if idx < 0:
+            raise KeyError(key)
+        return idx
 
-    def get_index(self, key: str) -> int:
-        try:
-            return self[key]
-        except (KeyError, ValueError):
-            return -1
-
-    def get_feature_name(self, index: int) -> str | None:
-        return str(index) if 0 <= index < self._size else None
-
-    def __iter__(self) -> Iterator[str]:
-        return (str(i) for i in range(self._size))
+    def __iter__(self):
+        if self._dim > self._ITER_GUARD:
+            raise RuntimeError(
+                f"refusing to enumerate a {self._dim}-entry IdentityIndexMap "
+                "(pre-indexed giant-d space); handle this map by index"
+            )
+        return (feature_key(str(i), "") for i in range(self._dim))
 
     def __len__(self) -> int:
-        return self._size
+        return self._dim
+
+    def get_index(self, key: str) -> int:
+        if self._intercept is not None and key == INTERCEPT_KEY:
+            return self._intercept
+        name, term = split_feature_key(key)
+        if term:
+            return -1
+        try:
+            j = int(name)
+        except ValueError:
+            return -1
+        return j if 0 <= j < self._dim else -1
+
+    def get_feature_name(self, index: int) -> str | None:
+        if self._intercept is not None and index == self._intercept:
+            return INTERCEPT_KEY
+        if 0 <= index < self._dim:
+            return feature_key(str(index), "")
+        return None
 
     @property
     def size(self) -> int:
-        return self._size
+        return self._dim
 
     @property
     def has_intercept(self) -> bool:
-        return False
+        return self._intercept is not None
 
     @property
     def intercept_index(self) -> int | None:
-        return None
+        return self._intercept
+
+    def save(self, directory: str | os.PathLike, name: str = "index") -> str:
+        """Persist as a tiny ``<name>.identity.json`` marker (dim only) —
+        no key material exists to write."""
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"{name}.identity.json")
+        with open(path, "w") as f:
+            json.dump({
+                "dim": self._dim, "intercept_index": self._intercept,
+                "format": "photon-ml-tpu/identity-index/v1",
+            }, f)
+        return path

@@ -37,6 +37,22 @@ from photon_ml_tpu.ops.normalization import NormalizationContext, no_normalizati
 
 Array = jax.Array
 
+try:
+    from jax._src.interpreters.batching import BatchTracer as _BatchTracer
+except ImportError:  # pragma: no cover - jax internals moved
+    _BatchTracer = None
+
+
+def _under_vmap(*arrays) -> bool:
+    """True when any input is a vmap batch tracer (the Pallas kernel has no
+    batching rule worth using; vmapped lanes stay on the autodiff path).
+    Fails SAFE: if the private BatchTracer type is unavailable (jax
+    internals moved), report "vmapped" so the kernel never silently bakes
+    into a vmapped loop (the serial per-lane regression)."""
+    if _BatchTracer is None:
+        return True
+    return any(isinstance(a, _BatchTracer) for a in arrays)
+
 
 class GLMObjective:
     """Weighted GLM objective: sum_i w_i * l(margin_i, y_i) + (l2/2)‖w‖².
@@ -53,19 +69,26 @@ class GLMObjective:
         l2_weight: float = 0.0,
         normalization: NormalizationContext | None = None,
         axis_name: str | None = None,
-        use_pallas: bool | None = False,
+        use_pallas: bool | None = None,
     ):
         self.loss = loss
         self.l2_weight = float(l2_weight)
         self.normalization = normalization if normalization is not None else no_normalization()
         self.axis_name = axis_name
-        #: route value_and_gradient through the hand-written Pallas kernel
-        #: (ops/pallas_glm.py). True forces it, False forces autodiff, None
-        #: means "auto" (currently = autodiff even on TPU: measured on v5e,
-        #: XLA fuses the autodiff value+gradient into one pass over X at
-        #: near-roofline HBM bandwidth and beats the kernel ~3x — see
-        #: pallas_glm.py docstring and BASELINE.md). Only valid on the
-        #: un-sharded (axis_name=None), un-vmapped solve path.
+        #: route value_and_gradient through the single-pass Pallas kernel
+        #: (ops/pallas_glm.py). None (default) means "auto": the kernel on
+        #: TPU whenever the call is not visibly vmapped. The kernel streams
+        #: X across HBM once per eval where autodiff reads it twice —
+        #: measured ~2x per eval f32 and more with bf16 feature blocks
+        #: (BASELINE.md r4 study). False forces autodiff — REQUIRED for
+        #: (a) solves that get vmapped (λ-grid lanes, per-entity RE/MF
+        #: buckets): `lax.while_loop` bodies trace with UNBATCHED tracers,
+        #: so the auto-detection below cannot see a vmap wrapping the
+        #: solver loop, and a Pallas call baked into the loop body batches
+        #: into a serial per-lane loop (~lanes x slower); and (b) GSPMD
+        #: mesh-sharded batches, whose pallas_call XLA cannot partition
+        #: (parallel/distributed.py sets it). True forces the kernel where
+        #: supported (still falls back on a DIRECTLY visible vmap).
         self.use_pallas = use_pallas
 
     # Value-based identity so jit static-arg caching works across repeated
@@ -87,7 +110,18 @@ class GLMObjective:
     def margins(self, coefficients: Array, batch: LabeledPointBatch) -> Array:
         eff = self.normalization.effective_coefficients(coefficients)
         shift = self.normalization.margin_shift(eff)
-        return batch.features @ eff - shift + batch.offsets
+        x = batch.features
+        if x.dtype == jnp.bfloat16 and eff.dtype != jnp.bfloat16:
+            # bf16 feature blocks: keep X in bf16 across HBM (half the
+            # traffic of the upcast a mixed-dtype matmul would do) and let
+            # the MXU accumulate in f32. Coefficients stay f32; only the
+            # per-product operand is rounded — same arithmetic as the
+            # Pallas kernel's bf16 path.
+            m = jnp.matmul(x, eff.astype(jnp.bfloat16),
+                           preferred_element_type=eff.dtype)
+        else:
+            m = x @ eff
+        return m - shift + batch.offsets
 
     def _data_value(self, coefficients: Array, batch: LabeledPointBatch) -> Array:
         margins = self.margins(coefficients, batch)
@@ -105,16 +139,21 @@ class GLMObjective:
 
     # -- derivatives ---------------------------------------------------------
 
-    def _pallas_enabled(self) -> bool:
-        if self.use_pallas is None:
-            # auto: XLA's own fusion measured faster than the kernel on v5e
+    def _pallas_enabled(self, coefficients: Array, batch: LabeledPointBatch) -> bool:
+        if self.use_pallas is False or self.axis_name is not None:
             return False
-        return self.use_pallas and self.axis_name is None
+        if _under_vmap(coefficients, batch.features):
+            # vmapped lanes (λ-grid, per-entity RE solves) share X reads
+            # across lanes in one XLA matmul — the kernel has no lane axis
+            return False
+        if self.use_pallas is None:
+            return jax.default_backend() == "tpu"
+        return True
 
     def value_and_gradient(
         self, coefficients: Array, batch: LabeledPointBatch
     ) -> tuple[Array, Array]:
-        if self._pallas_enabled():
+        if self._pallas_enabled(coefficients, batch):
             from photon_ml_tpu.ops.pallas_glm import fused_value_and_gradient
 
             return fused_value_and_gradient(

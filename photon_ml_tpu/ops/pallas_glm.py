@@ -3,23 +3,34 @@
 This is the reference's hot loop (ValueAndGradientAggregator.scala:133-177 —
 per-sample margin dot product, pointwise loss, axpy accumulation, merged
 tree-wise) as a single Pallas kernel: each row tile streams through VMEM
-once, computing the margin, the pointwise loss/derivative (VPU), and the
-gradient outer-accumulation before the tile leaves the chip.
+once; the margin matvec, the pointwise loss/derivative, and the gradient
+accumulation all consume the tile while it is resident, so X crosses HBM
+once per evaluation where the autodiff/XLA path reads it twice (forward
+margin matvec + backward transpose matvec — XLA does not fuse them into one
+read; BASELINE.md r3 bandwidth study).
 
-Measured verdict (v5e, n=2^17 d=512 logistic, BASELINE.md): XLA *already*
-performs this exact fusion on the autodiff path — the margin matvec, the
-elementwise loss, and the gradient matvec compile to a single pass over X at
-~750 GB/s marginal (near the 819 GB/s HBM roofline), while this kernel's
-Mosaic lowering streams at ~270 GB/s (the [tile, 1] margin/residual columns
-occupy one lane of each vreg, so the pointwise stage runs at 1/128th VPU
-occupancy). The kernel therefore stays an OPT-IN (``use_pallas=True``)
-correctness-tested alternative, not the default: "let XLA fuse — don't
-hand-schedule what the compiler already does" won on measurement.
+Measured on v5e (r4 kernel probes, experiments/kernel_probe*.py, all
+numbers same-run-calibrated against a one-X-read stream probe):
 
-Grid: 1-D over row tiles; the value/gradient outputs map to the same block
-in every grid step, making them sequential accumulators (TPU grids are
-serialized), initialized at step 0. Padding rows carry weight 0 and padded
-feature/coefficient columns are 0, so they contribute nothing.
+- f32 tiles, margins via a [tile, d]@[d, 1] MXU dot and gradient via a
+  [1, tile]@[tile, d] MXU dot: ~1.1x the same-run stream-probe rate per
+  eval (740-757 GB/s actual; the XLA-matvec stream probe slightly
+  UNDERESTIMATES achievable bandwidth) — vs the autodiff path's ~0.55x
+  (two X passes, each at bandwidth). Net ~2.0x per eval.
+- bf16 tiles (VPU cast + lane/sublane reductions at tile 2048; bf16
+  MXU-dot variants either crash the Mosaic compiler or run slower):
+  ~1.3x the f32 one-pass rate — another ~1.17x over the f32 kernel,
+  ~2.4x over the f32 autodiff default, at half the HBM footprint.
+- The r3 kernel measured 0.45-0.49x stream. Root cause (kernel_probe5/6
+  bisect): its three separate [tile, 1] label/offset/weight inputs each
+  cost ~0.07 ms/eval in narrow DMAs — more than the entire X stream.
+  This rewrite packs them into ONE [tile, 3] block and moves both
+  matvecs onto the MXU for f32.
+
+Accumulator outputs (value, gradient, Σr) map to the same block every grid
+step, making them sequential accumulators (TPU grids are serialized),
+initialized at step 0. Padding rows carry weight 0 and padded feature /
+coefficient columns are 0, so they contribute nothing.
 
 Falls back to interpreter mode off-TPU, so the same code path is testable
 on CPU (the guide's `interpret=True`).
@@ -55,54 +66,71 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _row_tile(d_pad: int) -> int:
-    """Rows per grid step: fill the VMEM budget, stay MXU-aligned."""
-    rows = _VMEM_BUDGET_BYTES // (4 * d_pad)
-    return int(np.clip(_round_up(rows, 8) if rows >= 8 else 8, 8, 1024))
+def _row_tile(d_pad: int, itemsize: int) -> int:
+    """Rows per grid step: measured optima with the packed-aux layout
+    (1024 f32 / 2048 bf16 at d=512, kernel_probe7), shrunk to fit the VMEM
+    budget for very wide feature blocks."""
+    cap = 1024 if itemsize >= 4 else 2048
+    rows = _VMEM_BUDGET_BYTES // (itemsize * d_pad)
+    return int(np.clip(_round_up(rows, 8) if rows >= 8 else 8, 8, cap))
 
 
-def _kernel(loss: PointwiseLoss, x_ref, y_ref, o_ref, ws_ref, w_ref,
-            val_ref, grad_ref, rsum_ref):
+def _kernel(loss: PointwiseLoss, use_mxu: bool, x_ref, aux_ref,
+            w_ref, val_ref, grad_ref, rsum_ref):
     @pl.when(pl.program_id(0) == 0)
     def _init():
         val_ref[0, 0] = jnp.float32(0.0)
         rsum_ref[0, 0] = jnp.float32(0.0)
         grad_ref[:] = jnp.zeros_like(grad_ref)
 
-    x = x_ref[:]  # [tile, d_pad]
-    # Margins via broadcast-multiply + lane reduction (constant accumulator —
-    # Mosaic rejects reductions fused with a non-constant init, so the offset
-    # is added in a separate op). M/N=1 dots lower to reductions anyway; the
-    # op is HBM-bandwidth-bound, so the VPU path costs nothing.
-    margins = jnp.sum(x * w_ref[:], axis=1, keepdims=True)  # [tile, 1]
-    margins = margins + o_ref[:]
-    l, dz = loss.loss_and_dz(margins, y_ref[:])
-    ws = ws_ref[:]
-    r = ws * dz
+    x = x_ref[:]  # [tile, d_pad], f32 or bf16
+    w = w_ref[:]  # [1, d_pad], f32
+    # per-sample columns ride as ONE [tile, 3] block (labels | offsets |
+    # weights): three separate [tile, 1] inputs cost ~0.07 ms/eval EACH in
+    # narrow DMAs — packing them removed the entire gap to stream rate
+    # (kernel_probe5/6 logs: 0.79 -> 0.36 ms/eval)
+    aux = aux_ref[:]
+    y, o, ws = aux[:, 0:1], aux[:, 1:2], aux[:, 2:3]
+    if use_mxu:
+        # f32 tiles: both matvecs ride the MXU ([tile,d]@[d,1] margins,
+        # [1,tile]@[tile,d] gradient) — measured ~1.4x the VPU reductions
+        margins = jnp.dot(x, w.reshape(-1, 1),
+                          preferred_element_type=jnp.float32)
+    else:
+        # bf16 tiles: every MXU-dot shape crashes the Mosaic compiler
+        # (kernel_probe2/3 logs); VPU cast + lane reduction still nets
+        # ~1.8x from the halved bytes
+        margins = jnp.sum(x.astype(jnp.float32) * w, axis=1, keepdims=True)
+    margins = margins + o
+    l, dz = loss.loss_and_dz(margins, y)
+    r = ws * dz  # [tile, 1] f32
     val_ref[0, 0] += jnp.sum(ws * l)
     # Σr feeds the normalized-space chain rule (grad shift term) for free
     rsum_ref[0, 0] += jnp.sum(r)
-    # gradient tile: [1, d_pad] = Σ_rows r ⊙ x
-    g = jnp.sum(r * x, axis=0, keepdims=True)
+    if use_mxu:
+        g = jax.lax.dot_general(
+            r, x, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        g = jnp.sum(r * x.astype(jnp.float32), axis=0, keepdims=True)
     grad_ref[:] = grad_ref[:] + g
 
 
-@functools.partial(jax.jit, static_argnums=(0, 5))
-def _fused_padded(loss: PointwiseLoss, x, y, o, ws, interpret: bool, w):
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _fused_padded(loss: PointwiseLoss, x, aux, interpret: bool, w):
     n_pad, d_pad = x.shape
-    tile = _row_tile(d_pad)
+    tile = _row_tile(d_pad, x.dtype.itemsize)
     grid = (n_pad // tile,)
+    use_mxu = x.dtype == jnp.float32
 
     vmem = dict(memory_space=pltpu.VMEM) if (_HAS_PLTPU and not interpret) else {}
     smem = dict(memory_space=pltpu.SMEM) if (_HAS_PLTPU and not interpret) else {}
     value, grad, rsum = pl.pallas_call(
-        functools.partial(_kernel, loss),
+        functools.partial(_kernel, loss, use_mxu),
         grid=grid,
         in_specs=[
             pl.BlockSpec((tile, d_pad), lambda i: (i, 0), **vmem),
-            pl.BlockSpec((tile, 1), lambda i: (i, 0), **vmem),
-            pl.BlockSpec((tile, 1), lambda i: (i, 0), **vmem),
-            pl.BlockSpec((tile, 1), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((tile, 3), lambda i: (i, 0), **vmem),
             pl.BlockSpec((1, d_pad), lambda i: (0, 0), **vmem),
         ],
         out_specs=[
@@ -116,7 +144,7 @@ def _fused_padded(loss: PointwiseLoss, x, y, o, ws, interpret: bool, w):
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(x, y, o, ws, w.reshape(1, d_pad))
+    )(x, aux, w.reshape(1, d_pad))
     return value[0, 0], grad[0], rsum[0, 0]
 
 
@@ -141,19 +169,22 @@ def fused_value_and_gradient(
     with ``eff = factors*w`` and a shifted offset column, and the chain rule
     back to ``w`` uses the kernel's Σr output —
     ``grad_w = factors * (X'r - (Σr)*shifts)``. Use inside jit.
-    Inputs of any shape are zero-padded to (8k rows, 128m cols); padded rows
-    get weight 0 and padded columns 0 coefficients, contributing nothing.
+
+    bf16 feature blocks stream as bf16 (half the HBM traffic) with all
+    accumulation in f32; coefficients/value/gradient stay f32 throughout.
+    Inputs of any shape are zero-padded to (tile-multiple rows, 128m cols);
+    padded rows get weight 0 and padded columns 0 coefficients,
+    contributing nothing.
     """
     if interpret is None:
         interpret = _should_interpret()
-    x = jnp.asarray(batch.features, jnp.float32)
+    x = batch.features
+    if x.dtype not in (jnp.float32, jnp.bfloat16):
+        x = jnp.asarray(x, jnp.float32)
     n, d = x.shape
-    tile = _row_tile(_round_up(d, _LANE))
+    tile = _row_tile(_round_up(d, _LANE), x.dtype.itemsize)
     n_pad, d_pad = _round_up(max(n, 1), tile), _round_up(d, _LANE)
     x = jnp.pad(x, ((0, n_pad - n), (0, d_pad - d)))
-    col = lambda v: jnp.pad(
-        jnp.asarray(v, jnp.float32).reshape(-1, 1), ((0, n_pad - n), (0, 0))
-    )
     factors = shifts = None
     if normalization is not None:
         factors, shifts = normalization.factors, normalization.shifts
@@ -164,10 +195,13 @@ def fused_value_and_gradient(
     if shifts is not None:
         offsets = offsets - jnp.dot(eff, jnp.asarray(shifts, jnp.float32))
     w = jnp.pad(eff, (0, d_pad - d))
-    value, grad, rsum = _fused_padded(
-        loss, x, col(batch.labels), col(offsets), col(batch.weights),
-        bool(interpret), w,
-    )
+    aux = jnp.stack([
+        jnp.asarray(batch.labels, jnp.float32),
+        offsets,
+        jnp.asarray(batch.weights, jnp.float32),
+    ], axis=1)
+    aux = jnp.pad(aux, ((0, n_pad - n), (0, 0)))
+    value, grad, rsum = _fused_padded(loss, x, aux, bool(interpret), w)
     grad = grad[:d]
     if shifts is not None:
         grad = grad - rsum * jnp.asarray(shifts, jnp.float32)

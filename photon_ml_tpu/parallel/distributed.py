@@ -202,9 +202,28 @@ def _data_pytree(dataset: GameDataset, re_specs: Sequence[RandomEffectStepSpec],
 def _buckets_pytree(
     re_datasets: Mapping[str, RandomEffectDataset],
     re_specs: Sequence[RandomEffectStepSpec] = (),
+    normalized_re_types: "set[str]" = frozenset(),
 ) -> dict:
     spec_projector = {s.re_type: s.projector for s in re_specs}
     for k, ds in re_datasets.items():
+        if (
+            k in normalized_re_types
+            and ds.projector_type == ProjectorType.INDEX_MAP
+            and not ds.pre_normalized
+        ):
+            raise ValueError(
+                f"random-effect coordinate '{k}': INDEX_MAP with "
+                "normalization requires the RandomEffectDataset to be "
+                "built with the same normalization "
+                "(build_random_effect_dataset(normalization=...))"
+            )
+        if ds.pre_normalized and k not in normalized_re_types:
+            raise ValueError(
+                f"random-effect coordinate '{k}': the RandomEffectDataset "
+                "was built pre-normalized but the program spec carries no "
+                "normalization context for it — tables would leave the "
+                "step in normalized space unconverted"
+            )
         expected = spec_projector.get(k, ProjectorType.IDENTITY)
         if ds.projector_type != expected:
             raise ValueError(
@@ -311,8 +330,11 @@ class GameTrainProgram:
         loss = loss_for_task(task)
         self._loss = loss
         self.normalization = normalization
+        # use_pallas=False everywhere in the fused program: its batches
+        # may be GSPMD mesh-sharded, and XLA cannot partition a pallas_call
         self._fe_objective = GLMObjective(loss, l2_weight=fe.l2_weight,
-                                          normalization=normalization)
+                                          normalization=normalization,
+                                          use_pallas=False)
         # sparse twin, used when the FE shard arrives as flat COO (the
         # giant-d path); shares the normalization context so jit caches of
         # both variants stay identity-keyed
@@ -336,6 +358,7 @@ class GameTrainProgram:
             s.feature_shard_id: GLMObjective(
                 loss, l2_weight=s.l2_weight,
                 normalization=extra_fe_normalizations.get(s.feature_shard_id),
+                use_pallas=False,
             )
             for s in self.extra_fes
         }
@@ -358,21 +381,37 @@ class GameTrainProgram:
                     "intercept_index (the intercept absorbs each entity's "
                     "margin shift in model space)"
                 )
-            if ctx is not None and s.projector != ProjectorType.IDENTITY:
+            if ctx is not None and s.projector == ProjectorType.RANDOM:
                 raise ValueError(
                     f"random-effect coordinate '{s.re_type}': normalization "
-                    "cannot combine with a projected coordinate (same rule "
-                    "as the coordinate-descent path)"
+                    "cannot combine with a RANDOM-projected coordinate "
+                    "(same rule as the coordinate-descent path)"
                 )
         self._re_objectives = {
             s.re_type: GLMObjective(
                 loss, l2_weight=s.l2_weight,
                 normalization=re_normalizations.get(s.re_type),
+                use_pallas=False,
+            )
+            for s in self.re_specs
+        }
+        # INDEX_MAP + normalization: entity blocks arrive pre-normalized
+        # (build_random_effect_dataset(normalization=...)), so their SOLVES
+        # use a plain objective; scoring/table conversion keep the context
+        self._re_solve_objectives = {
+            s.re_type: (
+                GLMObjective(loss, l2_weight=s.l2_weight, use_pallas=False)
+                if (
+                    s.projector == ProjectorType.INDEX_MAP
+                    and re_normalizations.get(s.re_type) is not None
+                )
+                else self._re_objectives[s.re_type]
             )
             for s in self.re_specs
         }
         self._mf_objectives = {
-            m.name: GLMObjective(loss, l2_weight=m.l2_weight)
+            m.name: GLMObjective(loss, l2_weight=m.l2_weight,
+                                 use_pallas=False)
             for m in self.mf_specs
         }
         self._step = jax.jit(self._step_impl)
@@ -474,6 +513,10 @@ class GameTrainProgram:
         buckets = _buckets_pytree(
             {s.re_type: re_datasets[s.re_type] for s in self.re_specs},
             self.re_specs,
+            normalized_re_types={
+                k for k in self._re_solve_objectives
+                if self._re_solve_objectives[k] is not self._re_objectives[k]
+            },
         )
         buckets["__mf__"] = {
             m.name: {
@@ -905,7 +948,7 @@ class GameTrainProgram:
     def _solve_re(self, data, buckets, k, full_offsets, table):
         """One random-effect coordinate (entities sharded, vmapped solves)."""
         spec = self._re_by_name[k]
-        objective = self._re_objectives[k]
+        objective = self._re_solve_objectives[k]
         if spec.projector == ProjectorType.INDEX_MAP:
             # scratch-column solve in each entity's observed columns
             # (ports algorithm/coordinates.py's single-chip path into
@@ -1005,6 +1048,8 @@ def compute_state_variances(
     from photon_ml_tpu.algorithm.coordinates import (
         _jitted_re_bucket_variances,
         _jitted_re_bucket_variances_diagonal,
+        _jitted_re_bucket_variances_indexmap,
+        _jitted_re_bucket_variances_indexmap_diagonal,
     )
     from photon_ml_tpu.ops.variance import (
         coefficient_variances,
@@ -1029,10 +1074,10 @@ def compute_state_variances(
                 f"program's random-effect coordinates; missing: {missing}"
             )
         for spec in selected:
-            if spec.projector != ProjectorType.IDENTITY:
+            if spec.projector == ProjectorType.RANDOM:
                 raise ValueError(
                     f"random-effect coordinate '{spec.re_type}': variance "
-                    "computation is not supported with projected/compact "
+                    "computation is not supported with RANDOM-projected "
                     "coordinates (same rule as the coordinate-descent path)"
                 )
 
@@ -1093,24 +1138,53 @@ def compute_state_variances(
     re_variances: dict[str, Array] = {}
     for spec in selected:
         ds = re_datasets[spec.re_type]
-        objective = program._re_objectives[spec.re_type]
         table = state.re_tables[spec.re_type]
         full_offsets = offsets_excluding(skip=spec.re_type)
         max_bucket = max((b.entity_rows.shape[0] for b in ds.buckets), default=1)
-        resolved = resolve_variance_mode(variance_mode, ds.dim,
-                                         num_problems=max_bucket)
-        kernel = (
-            _jitted_re_bucket_variances if resolved == "full"
-            else _jitted_re_bucket_variances_diagonal
-        )
-        var_table = jnp.full_like(table, jnp.nan)
-        for b in ds.buckets:
-            var_table = kernel(
-                objective, b.features, b.labels, b.weights,
-                b.sample_rows, b.entity_rows, full_offsets, table, var_table,
+        norm = program._re_objectives[spec.re_type].normalization
+        if spec.projector == ProjectorType.INDEX_MAP:
+            # solve-space diag(H⁻¹) scattered back through the entity index
+            # maps (IndexMapProjectorRDD.scala:103); serves dense INDEX_MAP
+            # and compact (sparse-shard) coordinates alike — col_index holds
+            # original columns (pad=dim) resp. local positions (pad=K)
+            objective = program._re_solve_objectives[spec.re_type]
+            width = max(
+                (int(b.features.shape[2]) for b in ds.buckets), default=1
             )
+            resolved = resolve_variance_mode(variance_mode, width,
+                                             num_problems=max_bucket)
+            kernel = (
+                _jitted_re_bucket_variances_indexmap if resolved == "full"
+                else _jitted_re_bucket_variances_indexmap_diagonal
+            )
+            table_ext = jnp.concatenate(
+                [table, jnp.zeros((table.shape[0], 1), table.dtype)], axis=1
+            )
+            var_ext = jnp.full_like(table_ext, jnp.nan)
+            for b in ds.buckets:
+                var_ext = kernel(
+                    objective, b.features, b.labels, b.weights,
+                    b.sample_rows, b.entity_rows, b.col_index,
+                    full_offsets, table_ext, var_ext,
+                )
+            var_table = var_ext[:, :-1]
+        else:
+            objective = program._re_objectives[spec.re_type]
+            resolved = resolve_variance_mode(variance_mode, ds.dim,
+                                             num_problems=max_bucket)
+            kernel = (
+                _jitted_re_bucket_variances if resolved == "full"
+                else _jitted_re_bucket_variances_diagonal
+            )
+            var_table = jnp.full_like(table, jnp.nan)
+            for b in ds.buckets:
+                var_table = kernel(
+                    objective, b.features, b.labels, b.weights,
+                    b.sample_rows, b.entity_rows, full_offsets, table,
+                    var_table,
+                )
         re_variances[spec.re_type] = (
-            objective.normalization.variances_to_model_space(var_table)
+            norm.variances_to_model_space(var_table)
         )
     return fe_variances, re_variances, extra_fe_variances
 

@@ -2,7 +2,12 @@
 
 Reference parity: photon-api transformers/GameTransformer.scala:156-298 —
 build the GAME dataset view, score with a GameModel (sum of sub-model
-scores), optionally run evaluators.
+scores), optionally run evaluators. The reference scores RDDs across
+executors (:156-203); here ``mesh=`` routes scoring through the jitted
+SPMD program (parallel/scoring.DistributedScorer) with samples sharded
+over "data" and — for column-sharded giant-d models —
+``fe_feature_sharded`` putting the FE feature/coefficient axis over
+"model", so nothing of size d is ever replicated.
 """
 
 from __future__ import annotations
@@ -31,15 +36,32 @@ class ScoredDataset:
 class GameTransformer:
     model: GameModel
     evaluator_specs: Sequence[str] = ()
+    #: jax.sharding.Mesh ("data", "model") — scores through the jitted
+    #: SPMD scoring program instead of the single-device path
+    mesh: object | None = None
+    #: shard the (single, or named) FE coordinate's feature axis over the
+    #: mesh "model" axis — required to score a column-sharded giant-d model
+    fe_feature_sharded: "bool | str" = False
 
     def transform(self, dataset: GameDataset) -> ScoredDataset:
-        scores = np.asarray(self.model.score_dataset(dataset)) + np.asarray(dataset.offsets)
+        if self.mesh is not None or self.fe_feature_sharded:
+            from photon_ml_tpu.parallel.scoring import DistributedScorer
+
+            scorer = DistributedScorer(
+                self.model, self.mesh,
+                fe_feature_sharded=self.fe_feature_sharded,
+            )
+            scores = scorer.score_dataset(dataset)  # includes offsets
+        else:
+            scores = np.asarray(self.model.score_dataset(dataset)) + np.asarray(
+                dataset.offsets
+            )
         evaluations: dict[str, float] = {}
         if self.evaluator_specs:
             data = EvaluationData(
-                labels=np.asarray(dataset.labels),
-                offsets=np.asarray(dataset.offsets),
-                weights=np.asarray(dataset.weights),
+                labels=np.asarray(dataset.host_array("labels")),
+                offsets=np.asarray(dataset.host_array("offsets")),
+                weights=np.asarray(dataset.host_array("weights")),
                 ids=dataset.ids,
             )
             for spec in self.evaluator_specs:

@@ -387,3 +387,51 @@ class TestPadGameDataset:
         )
         same, n2 = pad_game_dataset(padded, 8)
         assert same is padded and n2 == 208
+
+
+class TestDistributedProjectedNormalization:
+    def test_normalized_index_map_fused_matches_cd(self, data):
+        """INDEX_MAP + normalization through BOTH estimator paths (VERDICT
+        r3 #7 / missing #4): entity blocks are pre-normalized at build time
+        (the fused analogue of IndexMapProjectorRDD.projectNormalizationRDD)
+        and must agree with the CD path, variances included."""
+        from photon_ml_tpu.ops.normalization import NormalizationType
+        from photon_ml_tpu.projector.projectors import ProjectorType
+
+        train, val = data
+        var_opt = CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=20), l2_weight=1.0,
+            compute_variance=True,
+        )
+        configs = {
+            "fe": FixedEffectCoordinateConfig("global", OPT),
+            "per-user": RandomEffectCoordinateConfig(
+                "userId", "per", var_opt,
+                projector_type=ProjectorType.INDEX_MAP,
+            ),
+        }
+        results = {}
+        for name, mesh in (("cd", None), ("fused", make_mesh())):
+            est = GameEstimator(
+                task=TaskType.LINEAR_REGRESSION,
+                coordinate_configs=configs,
+                num_iterations=2,
+                normalization=NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+                validation_evaluators=("RMSE",),
+                mesh=mesh,
+            )
+            results[name] = est.fit(train, validation_dataset=val)
+        cd, fused = results["cd"], results["fused"]
+        assert np.isclose(fused.best_metric, cd.best_metric, rtol=1e-3)
+        m_cd = cd.model.get("per-user")
+        m_fu = fused.model.get("per-user")
+        np.testing.assert_allclose(
+            np.asarray(m_fu.coefficients), np.asarray(m_cd.coefficients),
+            atol=5e-3,
+        )
+        v_cd, v_fu = np.asarray(m_cd.variances), np.asarray(m_fu.variances)
+        mask = ~(np.isnan(v_cd) | np.isnan(v_fu))
+        assert mask.any()
+        np.testing.assert_allclose(v_fu[mask], v_cd[mask], rtol=1e-2)
+        # both carry NaN exactly where the other does (same active sets)
+        np.testing.assert_array_equal(np.isnan(v_fu), np.isnan(v_cd))

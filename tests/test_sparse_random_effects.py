@@ -217,9 +217,12 @@ class TestCompactTraining:
 
 
 class TestCompactEdgeCases:
-    def test_variance_on_compact_re_fails_before_training(self):
-        """compute_variance on a compact RE must raise at configuration
-        time, not after a (long) distributed run at model conversion."""
+    def test_variance_on_compact_re_computed(self):
+        """compute_variance on a compact RE (VERDICT r3 #7, closing the A10
+        partial): per-entity diag(H⁻¹) in the entity's active-column space,
+        persisted as an [E, K] variance table alongside the compact means
+        (the IndexMapProjectorRDD.scala:103 contract — variances travel
+        with the means through the index maps)."""
         ds, _, _ = _make()
         var_opt = CoordinateOptimizationConfig(
             optimizer=OptimizerConfig(max_iterations=30), l2_weight=0.1,
@@ -233,8 +236,16 @@ class TestCompactEdgeCases:
             },
             num_iterations=1, mesh=make_mesh(),
         )
-        with pytest.raises(ValueError, match="projected/compact"):
-            est.fit(ds)
+        res = est.fit(ds)
+        m = res.model.get("per-user")
+        v = np.asarray(m.variances)
+        assert v.shape == np.asarray(m.coefficients).shape
+        # trained entities carry finite positive variances over their
+        # active columns; the all-pad tail of a short active list is NaN
+        cols = np.asarray(m.active_cols)
+        real = cols < m.feature_dim
+        assert np.isfinite(v[real]).any()
+        assert (v[real][np.isfinite(v[real])] > 0).all()
 
     def test_fe_variance_with_compact_re_allowed(self):
         """FE variances + a compact (non-requesting) RE coordinate is a
