@@ -1,0 +1,136 @@
+"""Probe: can a Pallas kernel beat XLA's ~7 ns/index gather floor?
+
+VERDICT r3 #5. XLA's gather/scatter at d=10^7 runs ~7-12 ns/element
+(BASELINE.md giant-d study) regardless of sortedness. Ideas probed on
+hardware, all same-run calibrated:
+
+  a) XLA gather baseline (w[idx], 16.8M indices, d=2^22 and d=10^7)
+  b) XLA scatter-add baseline
+  c) Pallas scalar-loop gather from VMEM: w resident in VMEM (16 MB),
+     per-entry w_ref[0, idx] scalar loads accumulated via fori_loop
+  d) Pallas scalar-loop gather+multiply+accumulate (the real ELL inner op)
+
+Run: python experiments/sparse_gather_probe.py
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NNZ = 1 << 24  # 16.8M indices
+K_LO, K_HI = 2, 10
+
+
+def measure(step_fn, carry0, batch, reps=3):
+    def timed(k):
+        @jax.jit
+        def run(c, b):
+            c, _ = jax.lax.scan(lambda c, _: (step_fn(c, b), 0.0), c, None,
+                                length=k)
+            return c
+
+        float(run(carry0, batch).sum())
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(run(carry0, batch).sum())
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    return max((timed(K_HI) - timed(K_LO)) / (K_HI - K_LO), 1e-9)
+
+
+def gather_kernel(block, idx_ref, val_ref, w_ref, out_ref):
+    # idx block [1, block] int32; w [1, d] resident; accumulate sum of
+    # val*w[idx] into out [1, 1] (SMEM)
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[0, 0] = jnp.float32(0.0)
+
+    def body(i, acc):
+        j = idx_ref[0, i]
+        return acc + val_ref[0, i] * w_ref[0, j]
+
+    out_ref[0, 0] += jax.lax.fori_loop(0, block, body, jnp.float32(0.0))
+
+
+def pallas_gather_sum(idx, vals, w, block):
+    nnz = idx.shape[1]
+    (out,) = pl.pallas_call(
+        functools.partial(gather_kernel, block),
+        grid=(nnz // block,),
+        in_specs=[
+            pl.BlockSpec((1, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, w.shape[1]), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[pl.BlockSpec((1, 1), lambda i: (0, 0),
+                                memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((1, 1), jnp.float32)],
+    )(idx, vals, w)
+    return out[0, 0]
+
+
+def main():
+    rng = np.random.default_rng(0)
+    for d in (1 << 22, 10_000_000):
+        idx = rng.integers(0, d, size=NNZ).astype(np.int32)
+        vals = rng.normal(size=NNZ).astype(np.float32)
+        batch = {
+            "idx": jax.device_put(jnp.asarray(idx)),
+            "vals": jax.device_put(jnp.asarray(vals)),
+            "idx2": jax.device_put(jnp.asarray(idx).reshape(1, -1)),
+            "vals2": jax.device_put(jnp.asarray(vals).reshape(1, -1)),
+        }
+        w0 = jnp.asarray(rng.normal(size=d).astype(np.float32))
+
+        # a) XLA gather: sum(vals * w[idx]); consume carry so nothing hoists
+        def xla_gather(w, b):
+            s = jnp.sum(b["vals"] * w[b["idx"]])
+            return w + s * 1e-30
+
+        m = measure(xla_gather, w0, batch)
+        print(f"d={d}: XLA gather {m/NNZ*1e9:.2f} ns/idx ({m*1e3:.1f} ms)",
+              flush=True)
+
+        # b) XLA scatter-add
+        def xla_scatter(w, b):
+            return w * 0.999999 + jnp.zeros_like(w).at[b["idx"]].add(b["vals"])
+
+        m = measure(xla_scatter, w0, batch)
+        print(f"d={d}: XLA scatter {m/NNZ*1e9:.2f} ns/idx ({m*1e3:.1f} ms)",
+              flush=True)
+
+        # c/d) Pallas scalar-loop gather (VMEM-resident w) — only for the
+        # VMEM-sized d
+        if d <= 1 << 22:
+            for block in (1 << 12, 1 << 14):
+                def pstep(w, b, _blk=block):
+                    s = pallas_gather_sum(b["idx2"], b["vals2"],
+                                          w.reshape(1, -1), _blk)
+                    return w + s * 1e-30
+
+                try:
+                    m = measure(pstep, w0, batch)
+                except Exception as e:  # noqa: BLE001
+                    print(f"d={d}: pallas blk={block} FAILED "
+                          f"{type(e).__name__}: {str(e)[:150]}", flush=True)
+                    continue
+                print(f"d={d}: pallas scalar-loop blk={block} "
+                      f"{m/NNZ*1e9:.2f} ns/idx ({m*1e3:.1f} ms)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

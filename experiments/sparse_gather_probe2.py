@@ -1,0 +1,114 @@
+"""Probe 2: Pallas scalar-loop gather with 2-D VMEM layout.
+
+w lives as [d/128, 128] in VMEM; index j decomposes to (j>>7, j&127) and
+each entry does a scalar w_ref[hi, lo] load in a fori_loop.
+Run: python experiments/sparse_gather_probe2.py
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NNZ = 1 << 22  # 4.2M (keep compile fast; per-idx rate is what matters)
+K_LO, K_HI = 2, 10
+
+
+def measure(step_fn, carry0, batch, reps=3):
+    def timed(k):
+        @jax.jit
+        def run(c, b):
+            c, _ = jax.lax.scan(lambda c, _: (step_fn(c, b), 0.0), c, None,
+                                length=k)
+            return c
+
+        float(run(carry0, batch).sum())
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(run(carry0, batch).sum())
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    return max((timed(K_HI) - timed(K_LO)) / (K_HI - K_LO), 1e-9)
+
+
+def gather_kernel(block, idx_ref, val_ref, w_ref, out_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[0, 0] = jnp.float32(0.0)
+
+    def body(i, acc):
+        j = idx_ref[0, i]
+        return acc + val_ref[0, i] * w_ref[j >> 7, j & 127]
+
+    out_ref[0, 0] += jax.lax.fori_loop(0, block, body, jnp.float32(0.0))
+
+
+def pallas_gather_sum(idx, vals, w2d, block):
+    nnz = idx.shape[1]
+    rows = w2d.shape[0]
+    (out,) = pl.pallas_call(
+        functools.partial(gather_kernel, block),
+        grid=(nnz // block,),
+        in_specs=[
+            pl.BlockSpec((1, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[pl.BlockSpec((1, 1), lambda i: (0, 0),
+                                memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((1, 1), jnp.float32)],
+    )(idx, vals, w2d)
+    return out[0, 0]
+
+
+def main():
+    rng = np.random.default_rng(0)
+    d = 1 << 21  # 8 MB in VMEM
+    idx = rng.integers(0, d, size=NNZ).astype(np.int32)
+    vals = rng.normal(size=NNZ).astype(np.float32)
+    batch = {
+        "idx": jax.device_put(jnp.asarray(idx)),
+        "vals": jax.device_put(jnp.asarray(vals)),
+        "idx2": jax.device_put(jnp.asarray(idx).reshape(1, -1)),
+        "vals2": jax.device_put(jnp.asarray(vals).reshape(1, -1)),
+    }
+    w0 = jnp.asarray(rng.normal(size=d).astype(np.float32))
+
+    def xla_gather(w, b):
+        s = jnp.sum(b["vals"] * w[b["idx"]])
+        return w + s * 1e-30
+
+    m = measure(xla_gather, w0, batch)
+    print(f"XLA gather {m/NNZ*1e9:.2f} ns/idx ({m*1e3:.1f} ms)", flush=True)
+
+    for block in (1 << 12, 1 << 15):
+        def pstep(w, b, _blk=block):
+            s = pallas_gather_sum(b["idx2"], b["vals2"],
+                                  w.reshape(-1, 128), _blk)
+            return w + s * 1e-30
+
+        try:
+            m = measure(pstep, w0, batch)
+        except Exception as e:  # noqa: BLE001
+            print(f"pallas blk={block} FAILED {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+            continue
+        print(f"pallas scalar-loop blk={block} {m/NNZ*1e9:.2f} ns/idx "
+              f"({m*1e3:.1f} ms)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
