@@ -291,6 +291,7 @@ def bench_game_sweep() -> dict:
             RandomEffectStepSpec("user", "per_entity", opt, l2_weight=1.0),
             RandomEffectStepSpec("item", "per_entity", opt, l2_weight=1.0),
         ),
+        use_pallas_fe=True,  # single chip: the FE solve takes the kernel
     )
 
     data, buckets = program.prepare_inputs(dataset, re_datasets, None)

@@ -1,0 +1,95 @@
+"""Probe: bf16 feature block under the vmapped λ-grid (the primary bench
+workload). The grid's per-lane margins batch into one [n,d]@[d,L] matmul —
+bandwidth-bound, so bf16 X should approach 2x. Checks marginal grid time
+f32 vs bf16 and the per-lane solution agreement.
+
+Run: python experiments/grid_bf16_probe.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.ops.losses import LogisticLoss
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+
+N, D, MAX_ITER, GRID = 1 << 18, 512, 30, 32
+
+
+def main():
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=D).astype(np.float32) / np.sqrt(D)
+    x = rng.normal(size=(N, D)).astype(np.float32)
+    y = (rng.uniform(size=N) < 1 / (1 + np.exp(-(x @ w_true)))).astype(np.float32)
+    l2v = jnp.asarray(np.logspace(-2, 2, GRID), jnp.float32)
+    objective = GLMObjective(LogisticLoss(), l2_weight=0.0, use_pallas=False)
+
+    @jax.jit
+    def run_grid(b, l2v, seed):
+        bound = objective.bind(b)
+
+        def solve_one(l2, key):
+            def vg(w):
+                v, g = bound.value_and_grad(w)
+                return v + 0.5 * l2 * jnp.vdot(w, w), g + l2 * w
+
+            w0 = 1e-4 * jax.random.normal(key, (D,), jnp.float32)
+            return minimize_lbfgs(vg, w0, max_iter=MAX_ITER, tolerance=0.0)
+
+        keys = jax.random.split(jax.random.PRNGKey(seed), l2v.shape[0])
+        rs = jax.vmap(solve_one)(l2v, keys)
+        return rs.iterations.sum(), rs.value.sum(), rs.coefficients
+
+    def marginal(batch):
+        def timed(k, seed0):
+            t0 = time.perf_counter()
+            results = [run_grid(batch, l2v, seed0 + i) for i in range(k)]
+            for _, checksum, _ in results:
+                float(checksum)
+            return time.perf_counter() - t0, sum(int(it) for it, _, _ in results)
+
+        float(run_grid(batch, l2v, 0)[1])  # compile
+        vals = []
+        iters = 0
+        for rep in range(3):
+            lo = min(timed(1, 100 * rep + s)[0] for s in (1, 2))
+            hi_t, hi_iters = min(
+                (timed(3, 100 * rep + s) for s in (10, 20)),
+                key=lambda r: r[0],
+            )
+            vals.append(max((hi_t - lo) / 2, 1e-6))
+            iters = hi_iters // 3
+        vals.sort()
+        return vals[1], vals, iters
+
+    b32 = LabeledPointBatch.create(jax.device_put(jnp.asarray(x)),
+                                   jax.device_put(jnp.asarray(y)))
+    bbf = LabeledPointBatch.create(jax.device_put(jnp.asarray(x, jnp.bfloat16)),
+                                   jax.device_put(jnp.asarray(y)))
+    m32, v32, it32 = marginal(b32)
+    mbf, vbf, itbf = marginal(bbf)
+    print(f"f32 : {m32*1e3:.1f} ms/grid (spread {sorted(v32)}), {it32} lane-iters "
+          f"-> {N*it32/m32/1e6:.1f}M ex-iters/s", flush=True)
+    print(f"bf16: {mbf*1e3:.1f} ms/grid (spread {sorted(vbf)}), {itbf} lane-iters "
+          f"-> {N*itbf/mbf/1e6:.1f}M ex-iters/s", flush=True)
+    print(f"speedup {m32/mbf:.2f}x (per-grid), "
+          f"{(N*itbf/mbf)/(N*it32/m32):.2f}x (per-iter-rate)", flush=True)
+
+    # solution agreement
+    _, _, w_f32 = run_grid(b32, l2v, 7)
+    _, _, w_bf = run_grid(bbf, l2v, 7)
+    wa, wb = np.asarray(w_f32), np.asarray(w_bf)
+    rel = np.linalg.norm(wb - wa, axis=1) / np.linalg.norm(wa, axis=1)
+    print(f"per-lane rel dw: max={rel.max():.2e} median={np.median(rel):.2e}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

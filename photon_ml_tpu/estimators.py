@@ -540,6 +540,9 @@ class GameEstimator:
             extra_fe_normalizations={
                 sh: norms[sh] for sh in extra_fe_cid_of_shard if sh in norms
             },
+            # single-device meshes can take the single-pass kernel on the
+            # dense FE solve (a sharded batch cannot — see the program)
+            use_pallas_fe=int(np.prod(list(self.mesh.devices.shape))) == 1,
         )
 
         # locked coordinates: fixed residual offsets + pass-through models

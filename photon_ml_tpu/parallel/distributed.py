@@ -280,6 +280,7 @@ class GameTrainProgram:
         normalization: NormalizationContext | None = None,
         re_normalizations: Mapping[str, NormalizationContext] | None = None,
         extra_fe_normalizations: Mapping[str, NormalizationContext] | None = None,
+        use_pallas_fe: bool = False,
     ):
         self.task = task
         self.fe = fe
@@ -330,11 +331,15 @@ class GameTrainProgram:
         loss = loss_for_task(task)
         self._loss = loss
         self.normalization = normalization
-        # use_pallas=False everywhere in the fused program: its batches
-        # may be GSPMD mesh-sharded, and XLA cannot partition a pallas_call
+        # use_pallas=False everywhere in the fused program by default: its
+        # batches may be GSPMD mesh-sharded, and XLA cannot partition a
+        # pallas_call. use_pallas_fe=True opts the (un-vmapped, dense)
+        # primary-FE solve into the single-pass kernel — valid ONLY on a
+        # single-device run (callers that know the mesh set it).
         self._fe_objective = GLMObjective(loss, l2_weight=fe.l2_weight,
                                           normalization=normalization,
-                                          use_pallas=False)
+                                          use_pallas=None if use_pallas_fe
+                                          else False)
         # sparse twin, used when the FE shard arrives as flat COO (the
         # giant-d path); shares the normalization context so jit caches of
         # both variants stay identity-keyed

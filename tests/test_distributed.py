@@ -783,3 +783,36 @@ class TestFusedStateVariances:
                                      num_iterations=1)
         with pytest.raises(ValueError, match="re_datasets"):
             state_to_game_model(program, state, dataset, compute_variance=True)
+
+
+def test_fused_step_pallas_fe_matches_default(rng):
+    """use_pallas_fe=True (single-device fused program) routes the primary
+    FE solve through the single-pass kernel (interpret mode on CPU) and
+    must reproduce the autodiff program's sweep."""
+    n, d_fe, d_re = 128, 16, 4
+    users = np.array([f"u{i}" for i in rng.integers(0, 10, size=n)])
+    x_fe = rng.normal(size=(n, d_fe)).astype(np.float32)
+    x_re = rng.normal(size=(n, d_re)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    ds = build_game_dataset(
+        labels=y, feature_shards={"global": x_fe, "per": x_re},
+        entity_keys={"user": users},
+    )
+    res = {}
+    for flag in (False, True):
+        re_ds = {"user": build_random_effect_dataset(ds, "user", "per",
+                                                     bucket_sizes=(32,))}
+        opt = OptimizerConfig(max_iterations=8)
+        program = GameTrainProgram(
+            TaskType.LOGISTIC_REGRESSION,
+            FixedEffectStepSpec("global", opt, l2_weight=0.5),
+            (RandomEffectStepSpec("user", "per", opt, l2_weight=0.5),),
+            use_pallas_fe=flag,
+        )
+        data, buckets = program.prepare_inputs(ds, re_ds)
+        state, loss = program.step(data, buckets,
+                                   program.init_state(ds, re_ds))
+        res[flag] = (np.asarray(state.fe_coefficients), float(loss))
+    np.testing.assert_allclose(res[True][0], res[False][0], rtol=2e-4,
+                               atol=2e-4)
+    assert abs(res[True][1] - res[False][1]) < 1e-5
