@@ -40,7 +40,7 @@ from photon_ml_tpu.models.game import (
 )
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.ops.losses import loss_for_task
-from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.normalization import NormalizationContext, no_normalization
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
 from photon_ml_tpu.ops.variance import (
@@ -300,8 +300,6 @@ class RandomEffectCoordinate(Coordinate):
         full_offsets = self.dataset.offsets
         if extra_offsets is not None:
             full_offsets = full_offsets + extra_offsets
-        from photon_ml_tpu.ops.normalization import no_normalization
-
         norm = (
             self.normalization if self.normalization is not None
             else no_normalization()

@@ -40,6 +40,25 @@ from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
 Array = jax.Array
 
 
+def _pad_nnz(arrays: dict, data_axis: int, pad_values: dict | None = None) -> dict:
+    """Pad flat nnz-axis arrays to a mesh multiple: values pad with 0 (they
+    contribute nothing), "rows" repeats its last id (keeps the row
+    segment-sum's sorted promise), and ``pad_values`` overrides per key."""
+    nnz = int(arrays["vals"].shape[0])
+    pad = (-nnz) % data_axis
+    if not pad:
+        return arrays
+    last_row = arrays["rows"][-1:] if nnz else jnp.zeros(1, jnp.int32)
+    out = {}
+    for k, v in arrays.items():
+        if k == "rows":
+            out[k] = jnp.concatenate([v, jnp.broadcast_to(last_row, (pad,))])
+        else:
+            out[k] = jnp.pad(v, (0, pad),
+                             constant_values=(pad_values or {}).get(k, 0))
+    return out
+
+
 def _model_kinds(model: GameModel) -> dict[str, str]:
     kinds: dict[str, str] = {}
     for cid, m in model.models.items():
@@ -145,6 +164,7 @@ class DistributedScorer:
                         "ent": jnp.asarray(ent), "pos": jnp.asarray(pos),
                         "rows": jnp.asarray(rows), "vals": jnp.asarray(vals),
                     }
+                    params[cid] = {"table": jnp.asarray(m.coefficients)}
                 else:
                     c["x"] = jnp.asarray(feats)
                     c["idx"] = jnp.asarray(idx)
@@ -154,8 +174,6 @@ class DistributedScorer:
                             np.asarray(m.active_cols, np.int32)
                         ),
                     }
-                if "entries" in c:
-                    params[cid] = {"table": jnp.asarray(m.coefficients)}
             else:  # mf
                 c["row_idx"] = jnp.asarray(dataset.entity_idx[m.row_effect_type])
                 c["col_idx"] = jnp.asarray(dataset.entity_idx[m.col_effect_type])
@@ -194,42 +212,20 @@ class DistributedScorer:
                 out["row_idx"] = put(c["row_idx"], vec)
                 out["col_idx"] = put(c["col_idx"], vec)
             if "sparse" in c:
-                sp = c["sparse"]
-                nnz = int(sp["vals"].shape[0])
-                pad = (-nnz) % data_axis
-                if pad:
-                    # pad vals with 0 (contribute nothing) and keep the row
-                    # ids sorted by repeating the last row
-                    last = sp["rows"][-1:] if nnz else jnp.zeros(1, jnp.int32)
-                    sp = {
-                        "rows": jnp.concatenate(
-                            [sp["rows"], jnp.broadcast_to(last, (pad,))]
-                        ),
-                        "cols": jnp.pad(sp["cols"], (0, pad)),
-                        "vals": jnp.pad(sp["vals"], (0, pad)),
-                    }
-                out["sparse"] = {k: put(v, vec) for k, v in sp.items()}
+                out["sparse"] = {
+                    k: put(v, vec)
+                    for k, v in _pad_nnz(c["sparse"], data_axis).items()
+                }
             if "entries" in c:
-                sp = c["entries"]
-                nnz = int(sp["vals"].shape[0])
-                pad = (-nnz) % data_axis
-                if pad:
-                    last = sp["rows"][-1:] if nnz else jnp.zeros(1, jnp.int32)
-                    # pos pads point at the scratch slot; ent 0 is harmless
-                    # because vals pad with 0
-                    k_scratch = int(
-                        self.model.models[cid].coefficients.shape[1]
-                    )
-                    sp = {
-                        "ent": jnp.pad(sp["ent"], (0, pad)),
-                        "pos": jnp.pad(sp["pos"], (0, pad),
-                                       constant_values=k_scratch),
-                        "rows": jnp.concatenate(
-                            [sp["rows"], jnp.broadcast_to(last, (pad,))]
-                        ),
-                        "vals": jnp.pad(sp["vals"], (0, pad)),
-                    }
-                out["entries"] = {k: put(v, vec) for k, v in sp.items()}
+                # pos pads point at the scratch slot; ent 0 is harmless
+                # because vals pad with 0
+                k_scratch = int(self.model.models[cid].coefficients.shape[1])
+                out["entries"] = {
+                    k: put(v, vec)
+                    for k, v in _pad_nnz(
+                        c["entries"], data_axis, pad_values={"pos": k_scratch}
+                    ).items()
+                }
             coords[cid] = out
         data["coords"] = coords
 
