@@ -265,11 +265,15 @@ class RandomEffectCoordinate(Coordinate):
                 "variance computation is not supported with RANDOM-projected "
                 "random-effect coordinates (use INDEX_MAP or IDENTITY)"
             )
-        if self.re_dataset.is_compact and self.normalization is not None:
+        if (
+            self.re_dataset.is_compact
+            and self.normalization is not None
+            and self.normalization.shifts is not None
+        ):
             raise ValueError(
-                "feature normalization is not supported on sparse (compact) "
-                "random-effect coordinates — normalize upstream or use a "
-                "dense shard"
+                "compact (sparse-shard) random-effect coordinates support "
+                "SCALE-only normalization; mean shifts (STANDARDIZATION) "
+                "would densify the feature space"
             )
         if (
             projector == ProjectorType.INDEX_MAP
@@ -304,7 +308,17 @@ class RandomEffectCoordinate(Coordinate):
             self.normalization if self.normalization is not None
             else no_normalization()
         )
-        table = norm.from_model_space(model.coefficients, self.intercept_index)
+        compact_cols = (
+            jnp.asarray(self.re_dataset.active_cols)
+            if self.re_dataset.is_compact else None
+        )
+        if compact_cols is not None:
+            # compact tables convert per entity through gathered factors
+            table = norm.from_model_space_compact(
+                model.coefficients, compact_cols
+            )
+        else:
+            table = norm.from_model_space(model.coefficients, self.intercept_index)
 
         if projector == ProjectorType.INDEX_MAP:
             # extra scratch column absorbs the padding scatter/gather slots
@@ -398,8 +412,16 @@ class RandomEffectCoordinate(Coordinate):
                         bucket.sample_rows, bucket.entity_rows,
                         full_offsets, table, var_table,
                     )
-            variances = norm.variances_to_model_space(var_table)
-        table = norm.to_model_space(table, self.intercept_index)
+            variances = (
+                norm.variances_to_model_space_compact(var_table, compact_cols)
+                if compact_cols is not None
+                else norm.variances_to_model_space(var_table)
+            )
+        table = (
+            norm.to_model_space_compact(table, compact_cols)
+            if compact_cols is not None
+            else norm.to_model_space(table, self.intercept_index)
+        )
         return dataclasses.replace(model, coefficients=table, variances=variances), None
 
     def score(self, model: RandomEffectModel) -> Array:

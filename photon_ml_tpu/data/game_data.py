@@ -452,17 +452,22 @@ def build_random_effect_dataset(
       (pad slots) keeps factor 1 / shift 0, so padding stays zero.
     """
     shard = dataset.feature_shards[shard_id]
-    if normalization is not None and projector_type != ProjectorType.INDEX_MAP:
+    if (
+        normalization is not None
+        and projector_type != ProjectorType.INDEX_MAP
+        and not isinstance(shard, SparseShard)  # sparse coerces to INDEX_MAP
+    ):
         raise ValueError(
             "build_random_effect_dataset(normalization=...) pre-normalizes "
             "INDEX_MAP entity blocks only; IDENTITY coordinates normalize "
             "through the objective's context, RANDOM is unsupported"
         )
     if isinstance(shard, SparseShard):
-        if normalization is not None:
+        if normalization is not None and normalization.shifts is not None:
             raise ValueError(
-                "normalization is not supported on sparse (compact) "
-                "random-effect shards"
+                "sparse (compact) random-effect shards support SCALE-only "
+                "normalization; mean shifts (STANDARDIZATION) would densify "
+                "the feature space"
             )
         # giant-d_re path: per-entity observed-column blocks from the COO
         # triples, compact [E, K] coefficient table — never densify
@@ -483,6 +488,7 @@ def build_random_effect_dataset(
             active_data_lower_bound=active_data_lower_bound,
             bucket_sizes=bucket_sizes,
             seed=seed,
+            normalization=normalization,
         )
 
     entity_idx = dataset.host_array(f"entity_idx/{re_type}")
@@ -578,17 +584,17 @@ def _normalize_projected_block(bf, bc, bs, normalization, dim):
     x' = (x - shift)*factor over each entity's gathered columns. Valid
     sample slots only (bs >= 0); the scratch column (bc == dim) maps to
     factor 1 / shift 0 so padding slots stay exactly zero."""
+    from photon_ml_tpu.ops.normalization import host_factors, host_shifts
+
     out = bf
     valid = (bs >= 0)[:, :, None]
-    if normalization.shifts is not None:
-        shift_ext = np.append(
-            np.asarray(normalization.shifts, dtype=bf.dtype), bf.dtype.type(0)
-        )
+    shifts = host_shifts(normalization)
+    if shifts is not None:
+        shift_ext = np.append(shifts.astype(bf.dtype), bf.dtype.type(0))
         out = out - shift_ext[bc][:, None, :] * valid
-    if normalization.factors is not None:
-        fac_ext = np.append(
-            np.asarray(normalization.factors, dtype=bf.dtype), bf.dtype.type(1)
-        )
+    factors = host_factors(normalization)
+    if factors is not None:
+        fac_ext = np.append(factors.astype(bf.dtype), bf.dtype.type(1))
         out = out * fac_ext[bc][:, None, :]
     return out
 
@@ -603,6 +609,7 @@ def _build_sparse_random_effect_dataset(
     active_data_lower_bound: int | None,
     bucket_sizes: Sequence[int],
     seed: int,
+    normalization=None,
 ) -> RandomEffectDataset:
     """Compact per-entity blocks from a sparse (giant-d_re) shard.
 
@@ -627,6 +634,13 @@ def _build_sparse_random_effect_dataset(
     rows_s = np.asarray(rows_s)
     cols_s = np.asarray(cols_s)
     vals_s = np.asarray(vals_s)
+    if normalization is not None and normalization.factors is not None:
+        # pre-normalize at build time: x' = x * factor[col] (SCALE-only —
+        # shifts rejected by the dispatcher); solves then run on a plain
+        # objective and tables convert via the *_compact context methods
+        from photon_ml_tpu.ops.normalization import host_factors
+
+        vals_s = vals_s * host_factors(normalization).astype(vals_s.dtype)[cols_s]
     row_ptr = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(np.bincount(rows_s, minlength=n), out=row_ptr[1:])
 
@@ -719,6 +733,7 @@ def _build_sparse_random_effect_dataset(
         dim=dim,
         projector_type=ProjectorType.INDEX_MAP,
         active_cols=active_cols,
+        pre_normalized=normalization is not None,
     )
 
 

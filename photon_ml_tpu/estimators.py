@@ -223,15 +223,12 @@ class GameEstimator:
                     projector_type=cfg.projector_type,
                     projected_dim=cfg.projected_dim,
                     features_to_samples_ratio=cfg.features_to_samples_ratio,
-                    # INDEX_MAP + normalization: entity blocks are rewritten
-                    # to normalized space at build time (the reference
-                    # projects the context per entity,
+                    # INDEX_MAP (and compact/sparse, which coerces to
+                    # INDEX_MAP) + normalization: entity blocks are
+                    # rewritten to normalized space at build time (the
+                    # reference projects the context per entity,
                     # IndexMapProjectorRDD.scala:134-147)
-                    normalization=(
-                        norms.get(cfg.feature_shard_id)
-                        if cfg.projector_type == ProjectorType.INDEX_MAP
-                        else None
-                    ),
+                    normalization=_build_normalization_for(cfg, dataset, norms),
                 )
                 coordinates[cid] = RandomEffectCoordinate(
                     coordinate_id=cid,
@@ -472,11 +469,7 @@ class GameEstimator:
                 projector_type=cfg.projector_type,
                 projected_dim=cfg.projected_dim,
                 features_to_samples_ratio=cfg.features_to_samples_ratio,
-                normalization=(
-                    norms.get(cfg.feature_shard_id)
-                    if cfg.projector_type == ProjectorType.INDEX_MAP
-                    else None
-                ),
+                normalization=_build_normalization_for(cfg, dataset, norms),
             )
             norm = norms.get(cfg.feature_shard_id)
             if norm is not None:
@@ -749,6 +742,20 @@ class GameEstimator:
                 intercept_index=intercept,
             )
         return norms
+
+
+def _build_normalization_for(cfg: RandomEffectCoordinateConfig,
+                             dataset: GameDataset, norms) -> "NormalizationContext | None":
+    """Context to PRE-normalize an RE coordinate's entity blocks at dataset
+    build: INDEX_MAP coordinates, and sparse shards (which coerce to the
+    compact INDEX_MAP representation). IDENTITY coordinates normalize
+    through the objective's context instead; one predicate shared by the
+    CD and fused paths so they cannot drift."""
+    if cfg.projector_type == ProjectorType.INDEX_MAP or isinstance(
+        dataset.feature_shards[cfg.feature_shard_id], SparseShard
+    ):
+        return norms.get(cfg.feature_shard_id)
+    return None
 
 
 def train_glm_grid(

@@ -22,12 +22,42 @@ that standardization does not destroy the intercept semantics
 from __future__ import annotations
 
 import enum
+import weakref
 
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Array = jax.Array
+
+#: host-side copies of context factor vectors, fetched once per context —
+#: build-time consumers (entity-block pre-normalization) would otherwise
+#: re-pull a [dim] device array through the transfer path on every dataset
+#: build / prepare call (at giant d_re that is a ~GiB device-to-host copy)
+_HOST_FACTOR_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def host_factors(ctx: "NormalizationContext") -> "np.ndarray | None":
+    """Cached numpy view of ``ctx.factors`` (None when identity)."""
+    if ctx.factors is None:
+        return None
+    try:
+        return _HOST_FACTOR_CACHE[ctx]
+    except (KeyError, TypeError):
+        pass
+    arr = np.asarray(ctx.factors)
+    try:
+        _HOST_FACTOR_CACHE[ctx] = arr
+    except TypeError:  # unhashable/non-weakrefable context
+        pass
+    return arr
+
+
+def host_shifts(ctx: "NormalizationContext") -> "np.ndarray | None":
+    if ctx.shifts is None:
+        return None
+    return np.asarray(ctx.shifts)
 
 
 class NormalizationType(enum.Enum):
@@ -115,6 +145,46 @@ class NormalizationContext:
         if self.factors is None:
             return variances
         return variances * self.factors * self.factors
+
+    # -- compact ([E, K] active-column) table variants -----------------------
+    # Compact (giant-d_re) coordinates store per-entity tables over
+    # active_cols [E, K] (pad = dim); the context's [dim] factor vector is
+    # gathered per slot. SCALE-only: mean shifts would densify a sparse
+    # shard, so compact coordinates reject contexts with shifts upstream.
+
+    def _compact_factors(self, active_cols: Array) -> Array:
+        fac = jnp.concatenate(
+            [self.factors, jnp.ones((1,), self.factors.dtype)]
+        )  # pad slot (col == dim) keeps factor 1
+        return fac[jnp.minimum(active_cols, self.factors.shape[0])]
+
+    def _check_compact(self):
+        if self.shifts is not None:
+            raise ValueError(
+                "compact (sparse-shard) coordinates support SCALE-only "
+                "normalization; mean shifts (STANDARDIZATION) would densify "
+                "the feature space"
+            )
+
+    def to_model_space_compact(self, table: Array, active_cols: Array) -> Array:
+        self._check_compact()
+        if self.factors is None:
+            return table
+        return table * self._compact_factors(active_cols)
+
+    def from_model_space_compact(self, table: Array, active_cols: Array) -> Array:
+        self._check_compact()
+        if self.factors is None:
+            return table
+        return table / self._compact_factors(active_cols)
+
+    def variances_to_model_space_compact(self, variances: Array,
+                                         active_cols: Array) -> Array:
+        self._check_compact()
+        if self.factors is None:
+            return variances
+        f = self._compact_factors(active_cols)
+        return variances * f * f
 
 
 _NO_NORMALIZATION = NormalizationContext(factors=None, shifts=None)

@@ -499,6 +499,19 @@ class GameTrainProgram:
                 np.asarray(dataset.host_array(f"entity_idx/{s.re_type}")),
                 ds.active_cols,
             )
+            norm = self._re_objectives[s.re_type].normalization
+            if norm.factors is not None:
+                # normalized compact coordinate: the state's table lives in
+                # normalized space, so residual scoring needs normalized
+                # entry values x' = x * factor[col] (SCALE-only; entry
+                # order matches coalesced(), which compact_entry_positions
+                # reads)
+                from photon_ml_tpu.ops.normalization import host_factors
+
+                _, cols_s, _ = shard.coalesced()
+                vals = np.asarray(vals) * host_factors(norm).astype(
+                    np.asarray(vals).dtype
+                )[np.asarray(cols_s)]
             data.setdefault("re_sparse", {})[s.re_type] = {
                 "ent": jnp.asarray(ent),
                 "pos": jnp.asarray(pos),
@@ -771,8 +784,10 @@ class GameTrainProgram:
         standardized coordinates)."""
         sp = data.get("re_sparse", {}).get(k)
         if sp is not None:
-            # compact [E, K] table over per-entity active columns
-            # (normalization is rejected for projected/compact coordinates)
+            # compact [E, K] table over per-entity active columns; when the
+            # coordinate is SCALE-normalized, both the table and the entry
+            # values (scaled in _attach_re_sparse) live in normalized space
+            # — their product is the data-space margin, no shift term
             from photon_ml_tpu.models.game import score_random_effect_compact
 
             return score_random_effect_compact(
@@ -1173,6 +1188,13 @@ def compute_state_variances(
                     full_offsets, table_ext, var_ext,
                 )
             var_table = var_ext[:, :-1]
+            if ds.is_compact and norm.factors is not None:
+                re_variances[spec.re_type] = (
+                    norm.variances_to_model_space_compact(
+                        var_table, jnp.asarray(ds.active_cols)
+                    )
+                )
+                continue
         else:
             objective = program._re_objectives[spec.re_type]
             resolved = resolve_variance_mode(variance_mode, ds.dim,
@@ -1277,8 +1299,15 @@ def state_to_game_model(
                 "so the compact model keeps its active-column lists"
             )
         models[spec.re_type] = RandomEffectModel(
-            coefficients=re_norm.to_model_space(
-                state.re_tables[spec.re_type], spec.intercept_index
+            coefficients=(
+                re_norm.to_model_space_compact(
+                    state.re_tables[spec.re_type],
+                    jnp.asarray(ds.active_cols),
+                )
+                if is_compact
+                else re_norm.to_model_space(
+                    state.re_tables[spec.re_type], spec.intercept_index
+                )
             ),
             entity_keys=dataset.entity_vocabs[spec.re_type],
             random_effect_type=spec.re_type,
@@ -1469,8 +1498,12 @@ def game_model_to_state(
                 np.asarray(ds.active_cols, dtype=np.int64), ds.dim,
             ))
         re_norm = program._re_objectives[spec.re_type].normalization
-        re_tables[spec.re_type] = re_norm.from_model_space(
-            aligned, spec.intercept_index
+        re_tables[spec.re_type] = (
+            re_norm.from_model_space_compact(
+                aligned, jnp.asarray(ds.active_cols)
+            )
+            if ds_compact
+            else re_norm.from_model_space(aligned, spec.intercept_index)
         )
     mf_rows, mf_cols = {}, {}
     for spec in program.mf_specs:

@@ -338,3 +338,89 @@ class TestCompactModelIO:
                 dt[i][cols[mask]],
                 np.asarray(cm.coefficients[i])[mask], atol=1e-12,
             )
+
+
+class TestCompactNormalization:
+    """r4: compact (sparse-shard) REs support SCALE-only normalization —
+    entry values are pre-scaled at build time and tables convert through
+    per-entity gathered factors (the giant-d analogue of the reference's
+    per-entity projected contexts, IndexMapProjectorRDD.scala:134-147)."""
+
+    def _dense_twin(self, ds):
+        """Densify the sparse RE shard so the identity path can reference."""
+        import dataclasses as dc
+
+        shard = ds.feature_shards["re"]
+        rows, cols, vals = shard.coalesced()
+        x = np.zeros((ds.num_samples, shard.feature_dim))
+        x[np.asarray(rows), np.asarray(cols)] = np.asarray(vals)
+        host_cache = dict(ds.host_cache)
+        host_cache["shard/re"] = x
+        return dc.replace(
+            ds, feature_shards={**ds.feature_shards, "re": jnp.asarray(x)},
+            host_cache=host_cache,
+        )
+
+    def _fit(self, ds, mesh=None):
+        from photon_ml_tpu.ops.normalization import NormalizationType
+
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinate_configs={
+                "per-user": RandomEffectCoordinateConfig("userId", "re", OPT)
+            },
+            normalization=NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+            num_iterations=1,
+            mesh=mesh,
+        )
+        return est.fit(ds)
+
+    def test_cd_matches_dense_identity_path(self):
+        ds, _, _ = _make(d_re=300)  # densifiable for the reference path
+        dense = self._dense_twin(ds)
+        m_sparse = self._fit(ds).model.get("per-user")
+        m_dense = self._fit(dense).model.get("per-user")
+        assert m_sparse.is_compact and not m_dense.is_compact
+        # agreement on each entity's active columns (original model space)
+        cols = np.asarray(m_sparse.active_cols)
+        tbl_s = np.asarray(m_sparse.coefficients)
+        tbl_d = np.asarray(m_dense.coefficients)
+        e_idx, k_idx = np.nonzero(cols < m_sparse.feature_dim)
+        np.testing.assert_allclose(
+            tbl_s[e_idx, k_idx], tbl_d[e_idx, cols[e_idx, k_idx]], atol=5e-3
+        )
+        # and the models score identically
+        np.testing.assert_allclose(
+            np.asarray(m_sparse.score_dataset(ds)),
+            np.asarray(m_dense.score_dataset(dense)),
+            atol=1e-2,
+        )
+
+    def test_fused_matches_cd(self):
+        ds, _, _ = _make(n=296)
+        cd = self._fit(ds).model.get("per-user")
+        fused = self._fit(ds, mesh=make_mesh()).model.get("per-user")
+        np.testing.assert_allclose(
+            np.asarray(fused.coefficients), np.asarray(cd.coefficients),
+            atol=5e-3,
+        )
+
+    def test_standardization_rejected(self):
+        from photon_ml_tpu.ops.normalization import (
+            NormalizationType,
+            build_normalization,
+        )
+
+        ds, _, _ = _make(d_re=200)
+        shard = ds.feature_shards["re"]
+        stats = shard.summarize(np.asarray(ds.weights))
+        norm = build_normalization(
+            NormalizationType.STANDARDIZATION,
+            mean=jnp.asarray(stats["mean"]),
+            variance=jnp.asarray(stats["variance"]),
+            max_magnitude=jnp.asarray(stats["max_magnitude"]),
+            intercept_index=0,
+        )
+        with pytest.raises(ValueError, match="SCALE-only"):
+            build_random_effect_dataset(ds, "userId", "re",
+                                        normalization=norm)
