@@ -214,3 +214,60 @@ def test_vectorized_score_writer_matches_generic(tmp_path, rng):
         assert read_scores(tmp_path / f"fast{i}.avro") == read_scores(
             tmp_path / f"slow{i}.avro"
         ), f"case {i} diverged"
+
+
+def test_compact_re_variances_survive_round_trip(tmp_path):
+    """r4: compact [E, K] variance tables persist with the means through the
+    reference dir layout and reload onto the compact model (the wire format
+    is per-feature name-term-value, indistinguishable from dense)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.io.index_map import IndexMap, feature_key
+    from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+    from photon_ml_tpu.models.game import GameModel, RandomEffectModel
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(0)
+    E, K, dim = 6, 3, 40
+    cols = np.sort(rng.choice(dim, size=(E, K), replace=True), axis=1).astype(np.int32)
+    # make rows unique+sorted with pad: entity 5 has a short active list
+    cols[5, 2] = dim
+    table = rng.normal(size=(E, K))
+    table[5, 2] = 0.0
+    variances = np.abs(rng.normal(size=(E, K))) + 0.1
+    variances[5, 2] = np.nan  # pad slot: NaN by construction
+    m = RandomEffectModel(
+        coefficients=jnp.asarray(table),
+        entity_keys=np.array([f"e{i}" for i in range(E)]),
+        random_effect_type="per",
+        feature_shard_id="s",
+        task=TaskType.LINEAR_REGRESSION,
+        variances=jnp.asarray(variances),
+        active_cols=cols,
+        feature_dim=dim,
+    )
+    imap = IndexMap.from_keys({feature_key(str(j), "") for j in range(dim)})
+    save_game_model(tmp_path / "model", GameModel(models={"per": m}),
+                    {"s": imap})
+    loaded = load_game_model(
+        tmp_path / "model", {"s": imap}, compact_random_effect_threshold=1,
+    ).get("per")
+    assert loaded.is_compact
+    assert loaded.variances is not None
+    row_of = {k: i for i, k in enumerate(np.asarray(loaded.entity_keys))}
+    lc = np.asarray(loaded.active_cols)
+    lt = np.asarray(loaded.coefficients)
+    lv = np.asarray(loaded.variances)
+    for i in range(E):
+        r = row_of[f"e{i}"]
+        got = {
+            int(c): (t, v)
+            for c, t, v in zip(lc[r], lt[r], lv[r]) if c < dim
+        }
+        for k in range(K):
+            if cols[i, k] >= dim:
+                continue
+            t, v = got[int(cols[i, k])]
+            np.testing.assert_allclose(t, table[i, k], rtol=1e-6)
+            np.testing.assert_allclose(v, variances[i, k], rtol=1e-6)
