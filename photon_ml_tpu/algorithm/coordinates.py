@@ -256,15 +256,11 @@ class RandomEffectCoordinate(Coordinate):
                 "feature normalization is not supported with RANDOM-projected "
                 "random-effect coordinates (use INDEX_MAP or IDENTITY)"
             )
-        if projector == ProjectorType.RANDOM and self.config.compute_variance:
-            # the reference back-projects means but passes the PROJECTED-space
-            # variance vector through unchanged (ProjectionMatrixBroadcast.
-            # scala:76) — a length-k vector on a length-d model; rejected
-            # loudly instead of reproducing that
-            raise ValueError(
-                "variance computation is not supported with RANDOM-projected "
-                "random-effect coordinates (use INDEX_MAP or IDENTITY)"
-            )
+        # RANDOM-projected variances are PROPAGATED properly below:
+        # var(w) = diag(P H_k⁻¹ Pᵀ). (The reference back-projects means but
+        # passes the projected-space variance vector through unchanged —
+        # ProjectionMatrixBroadcast.scala:76 — which we refuse to reproduce;
+        # this is the mathematically consistent improvement.)
         if (
             self.re_dataset.is_compact
             and self.normalization is not None
@@ -364,7 +360,30 @@ class RandomEffectCoordinate(Coordinate):
                 (b.entity_rows.shape[0] for b in self.re_dataset.buckets),
                 default=1,
             )
-            if projector == ProjectorType.INDEX_MAP:
+            if projector == ProjectorType.RANDOM:
+                # propagate through the sketch: var(w) = diag(P H_k⁻¹ Pᵀ)
+                resolved = random_variance_mode(
+                    self.config.variance_mode,
+                    self.re_dataset.dim,
+                    int(self.re_dataset.projection.matrix.shape[1]),
+                    max_bucket,
+                )
+                kernel = (
+                    _jitted_re_bucket_variances_random if resolved == "full"
+                    else _jitted_re_bucket_variances_random_diagonal
+                )
+                matrix = jnp.asarray(
+                    self.re_dataset.projection.matrix, dtype=table.dtype
+                )
+                var_table = jnp.full_like(table, jnp.nan)
+                for bucket in self.re_dataset.buckets:
+                    var_table = kernel(
+                        objective,
+                        bucket.features, bucket.labels, bucket.weights,
+                        bucket.sample_rows, bucket.entity_rows,
+                        matrix, full_offsets, table, var_table,
+                    )
+            elif projector == ProjectorType.INDEX_MAP:
                 # solve-space diag(H⁻¹) over each entity's active columns,
                 # scattered back through the same index maps as the means —
                 # the reference's IndexMapProjectorRDD.scala:103 contract.
@@ -622,6 +641,102 @@ def solve_entity_bucket_indexmap(
     )
     table_ext = table_ext.at[entity_rows[:, None], col_index].set(solved)
     return table_ext.at[:, -1].set(0.0)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jitted_re_bucket_variances_random(
+    objective: GLMObjective,
+    features: Array,  # [e, cap, k] (already projected)
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    matrix: Array,  # [d, k]
+    full_offsets: Array,
+    table: Array,  # [E, d] solved ORIGINAL-space coefficients
+    var_table: Array,  # [E, d] accumulator (NaN = not computed)
+):
+    """Original-space variances of a RANDOM-projected solve: the estimator
+    is w = P w_k, so Cov(w) = P Cov(w_k) Pᵀ and
+    var(w) = diag(P H_k⁻¹ Pᵀ) = rowsum((P @ H_k⁻¹) ∘ P).
+
+    This is an IMPROVEMENT over the reference, which back-projects the
+    means but passes the PROJECTED-space variance vector through unchanged
+    (ProjectionMatrixBroadcast.scala:76) — a length-k vector attached to a
+    length-d model. Standalone entry points reject that; this kernel does
+    the propagation properly."""
+    from photon_ml_tpu.ops.variance import full_inverse_from_hessian
+
+    offsets = _bucket_offsets(sample_rows, full_offsets)
+    wks = _recover_sketch_coefficients(table[entity_rows], matrix)
+
+    def one(f, l, o, wt, wk):
+        batch = LabeledPointBatch(features=f, labels=l, offsets=o, weights=wt)
+        h_inv = full_inverse_from_hessian(objective.hessian_matrix(wk, batch))
+        return jnp.einsum("dk,kl,dl->d", matrix, h_inv, matrix)
+
+    vs = jax.vmap(one)(features, labels, offsets, weights, wks)
+    return var_table.at[entity_rows].set(vs)
+
+
+def random_variance_mode(mode: str, d: int, k: int, num_problems: int) -> str:
+    """AUTO gate for the RANDOM-projection variance kernels: the full
+    propagation materializes a [d, k] (P @ H_k⁻¹) intermediate PER VMAPPED
+    ENTITY — num_problems·d·k floats, unbounded in d (the axis the sketch
+    exists to shrink) — so the budget must cover that stack, not just the
+    e·k² Hessians."""
+    from photon_ml_tpu.ops.variance import (
+        FULL_VARIANCE_MAX_DIM,
+        resolve_variance_mode,
+    )
+
+    resolved = resolve_variance_mode(mode, k, num_problems=num_problems)
+    if (
+        mode == "auto"
+        and resolved == "full"
+        and num_problems * d * k > FULL_VARIANCE_MAX_DIM * FULL_VARIANCE_MAX_DIM
+    ):
+        return "diagonal"
+    return resolved
+
+
+def _recover_sketch_coefficients(rows: Array, matrix: Array) -> Array:
+    """EXACT solve-space coefficients from back-projected table rows.
+
+    Table rows hold w = P w_k exactly (set by ``solved @ P.T``), so
+    w_k = (PᵀP)⁻¹ Pᵀ w — a shared [k, k] Gram solve. The cheaper adjoint
+    Pᵀw = (PᵀP) w_k is fine as a solver WARM START but deviates from w_k by
+    ~sqrt(k/d) relative error, which would bias any coefficient-dependent
+    Hessian (logistic/Poisson) evaluated there.
+    """
+    gram = matrix.T @ matrix  # [k, k]
+    return jnp.linalg.solve(gram, (rows @ matrix).T).T
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jitted_re_bucket_variances_random_diagonal(
+    objective: GLMObjective,
+    features: Array,
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    matrix: Array,
+    full_offsets: Array,
+    table: Array,
+    var_table: Array,
+):
+    """Diagonal-approximation twin: var(w) ≈ (P∘P) @ 1/diag(H_k)."""
+    offsets = _bucket_offsets(sample_rows, full_offsets)
+    wks = _recover_sketch_coefficients(table[entity_rows], matrix)
+    p2 = matrix * matrix
+
+    def one(f, l, o, wt, wk):
+        batch = LabeledPointBatch(features=f, labels=l, offsets=o, weights=wt)
+        return p2 @ inverse_of_diagonal(objective.hessian_diagonal(wk, batch))
+
+    vs = jax.vmap(one)(features, labels, offsets, weights, wks)
+    return var_table.at[entity_rows].set(vs)
 
 
 def solve_entity_bucket_random(

@@ -485,21 +485,11 @@ class GameEstimator:
                 intercept_index=self.intercept_indices.get(cfg.feature_shard_id),
             ))
 
-        # fail variance-on-RANDOM configs BEFORE the (possibly long)
-        # training run (CD-path rule). INDEX_MAP/compact variances are
-        # computed in the solve space and scattered back with the means
-        # (IndexMapProjectorRDD.scala:103).
-        for spec in re_specs:
-            cid = re_cid_of_type[spec.re_type]
-            if (
-                self.coordinate_configs[cid].optimization.compute_variance
-                and spec.projector == ProjectorType.RANDOM
-            ):
-                raise ValueError(
-                    f"random-effect coordinate '{cid}': variance computation "
-                    "is not supported with RANDOM-projected coordinates "
-                    "(same rule as the coordinate-descent path)"
-                )
+        # Variances are available for every projector: INDEX_MAP/compact in
+        # the solve space scattered back with the means
+        # (IndexMapProjectorRDD.scala:103); RANDOM propagated through the
+        # sketch as diag(P H_k⁻¹ Pᵀ) — an improvement over the reference's
+        # unchanged pass-through (ProjectionMatrixBroadcast.scala:76).
 
         # the fused sweep trains coordinates in the CONFIGURED sequence
         # order (CoordinateDescent.scala:198-255 — order determines which

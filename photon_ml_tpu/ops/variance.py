@@ -96,6 +96,20 @@ def diag_inverse_from_hessian(h: Array) -> Array:
     return jnp.where(jnp.isfinite(full), full, approx)
 
 
+def full_inverse_from_hessian(h: Array) -> Array:
+    """Full H⁻¹ via Cholesky (for covariance PROPAGATION through a
+    projection: diag(P H⁻¹ Pᵀ) needs the off-diagonal entries that
+    :func:`diag_inverse_from_hessian` never materializes). Non-PD H falls
+    back to the clamped diagonal-only inverse, mirroring that function's
+    guard."""
+    chol = jnp.linalg.cholesky(h)
+    eye = jnp.eye(h.shape[0], dtype=h.dtype)
+    linv = jax.scipy.linalg.solve_triangular(chol, eye, lower=True)
+    full = linv.T @ linv
+    approx = jnp.diag(inverse_of_diagonal(jnp.diagonal(h)))
+    return jnp.where(jnp.isfinite(full).all(), full, approx)
+
+
 @partial(jax.jit, static_argnums=(0,))
 def _full_variances(objective, coefficients: Array, batch) -> Array:
     return diag_inverse_from_hessian(

@@ -1062,14 +1062,19 @@ def compute_state_variances(
     NaN rows mark entities no bucket trained.
 
     Requires ``re_datasets`` when the program has RE coordinates (their
-    buckets carry the per-entity training views). Projected RE coordinates
-    are rejected, matching the CD path.
+    buckets carry the per-entity training views). Projected coordinates are
+    fully supported, matching the CD path: INDEX_MAP/compact variances are
+    computed in the solve space and scattered back through the entity index
+    maps; RANDOM variances are propagated through the sketch as
+    diag(P H_k⁻¹ Pᵀ).
     """
     from photon_ml_tpu.algorithm.coordinates import (
         _jitted_re_bucket_variances,
         _jitted_re_bucket_variances_diagonal,
         _jitted_re_bucket_variances_indexmap,
         _jitted_re_bucket_variances_indexmap_diagonal,
+        _jitted_re_bucket_variances_random,
+        _jitted_re_bucket_variances_random_diagonal,
     )
     from photon_ml_tpu.ops.variance import (
         coefficient_variances,
@@ -1093,13 +1098,6 @@ def compute_state_variances(
                 "compute_state_variances needs re_datasets entries for the "
                 f"program's random-effect coordinates; missing: {missing}"
             )
-        for spec in selected:
-            if spec.projector == ProjectorType.RANDOM:
-                raise ValueError(
-                    f"random-effect coordinate '{spec.re_type}': variance "
-                    "computation is not supported with RANDOM-projected "
-                    "coordinates (same rule as the coordinate-descent path)"
-                )
 
     data = _data_pytree(
         dataset, program.re_specs, program.fe.feature_shard_id, program.mf_specs,
@@ -1162,7 +1160,33 @@ def compute_state_variances(
         full_offsets = offsets_excluding(skip=spec.re_type)
         max_bucket = max((b.entity_rows.shape[0] for b in ds.buckets), default=1)
         norm = program._re_objectives[spec.re_type].normalization
-        if spec.projector == ProjectorType.INDEX_MAP:
+        if spec.projector == ProjectorType.RANDOM:
+            # propagated through the sketch: var(w) = diag(P H_k⁻¹ Pᵀ) — an
+            # improvement over the reference, which passes the k-dim
+            # projected variances through unchanged
+            # (ProjectionMatrixBroadcast.scala:76)
+            from photon_ml_tpu.algorithm.coordinates import (
+                random_variance_mode,
+            )
+
+            objective = program._re_objectives[spec.re_type]
+            resolved = random_variance_mode(
+                variance_mode, ds.dim, int(ds.projection.matrix.shape[1]),
+                max_bucket,
+            )
+            kernel = (
+                _jitted_re_bucket_variances_random if resolved == "full"
+                else _jitted_re_bucket_variances_random_diagonal
+            )
+            matrix = jnp.asarray(ds.projection.matrix, dtype=table.dtype)
+            var_table = jnp.full_like(table, jnp.nan)
+            for b in ds.buckets:
+                var_table = kernel(
+                    objective, b.features, b.labels, b.weights,
+                    b.sample_rows, b.entity_rows, matrix,
+                    full_offsets, table, var_table,
+                )
+        elif spec.projector == ProjectorType.INDEX_MAP:
             # solve-space diag(H⁻¹) scattered back through the entity index
             # maps (IndexMapProjectorRDD.scala:103); serves dense INDEX_MAP
             # and compact (sparse-shard) coordinates alike — col_index holds

@@ -345,10 +345,12 @@ def test_index_map_variances_with_normalization():
     np.testing.assert_allclose(v_proj[mask], v_id[mask], rtol=1e-3, atol=1e-5)
 
 
-def test_random_projection_variance_still_rejected():
-    """The reference passes PROJECTED-space variances through unchanged on
-    RANDOM back-projection (ProjectionMatrixBroadcast.scala:76) — a length
-    mismatch we refuse to reproduce."""
+def test_random_projection_variances_propagated():
+    """r4 improvement over the reference: RANDOM-projected variances are
+    PROPAGATED through the sketch — var(w) = diag(P H_k⁻¹ Pᵀ) — where the
+    reference passes the k-dim projected vector through unchanged
+    (ProjectionMatrixBroadcast.scala:76). Closed-form check per entity."""
+    l2 = 0.5
     x, y, entities = _sparse_entity_data(n=400, d=40)
     ds = build_game_dataset(labels=y, feature_shards={"s": x},
                             entity_keys={"e": entities})
@@ -359,8 +361,64 @@ def test_random_projection_variance_still_rejected():
         coordinate_id="re", dataset=ds, re_dataset=re,
         task=TaskType.LINEAR_REGRESSION,
         config=CoordinateOptimizationConfig(
-            optimizer=OptimizerConfig(), compute_variance=True
+            optimizer=OptimizerConfig(max_iterations=30), l2_weight=l2,
+            compute_variance=True, variance_mode="full",
         ),
     )
-    with pytest.raises(ValueError, match="RANDOM-projected"):
-        coord.update_model(coord.initial_model())
+    model, _ = coord.update_model(coord.initial_model())
+    v = np.asarray(model.variances)
+    p = np.asarray(re.projection.matrix, np.float64)
+    row_of = {k: i for i, k in enumerate(np.asarray(model.entity_keys))}
+    # closed form for a couple of entities (squared loss: H is w-free)
+    checked = 0
+    for e_key in np.unique(entities)[:3]:
+        mask = entities == e_key
+        xk = x[mask].astype(np.float64) @ p
+        h = xk.T @ xk + l2 * np.eye(p.shape[1])
+        want = np.einsum("dk,kl,dl->d", p, np.linalg.inv(h), p)
+        got = v[row_of[e_key]]
+        np.testing.assert_allclose(got, want, rtol=2e-3)
+        checked += 1
+    assert checked == 3
+
+
+def test_random_projection_variances_logistic_eval_point():
+    """The Hessian must be evaluated at the EXACT solve-space coefficients
+    w_k = (PᵀP)⁻¹Pᵀw (table rows are exactly P w_k), not the adjoint Pᵀw —
+    for a coefficient-dependent Hessian (logistic) the adjoint deviates by
+    ~sqrt(k/d) and biases variances ~tens of percent."""
+    rng = np.random.default_rng(3)
+    l2 = 0.3
+    n, d, k = 500, 40, 8
+    entities = np.array([f"e{i}" for i in rng.integers(0, 4, size=n)])
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    ds = build_game_dataset(labels=y, feature_shards={"s": x},
+                            entity_keys={"e": entities})
+    re = build_random_effect_dataset(
+        ds, "e", "s", projector_type=ProjectorType.RANDOM, projected_dim=k
+    )
+    coord = RandomEffectCoordinate(
+        coordinate_id="re", dataset=ds, re_dataset=re,
+        task=TaskType.LOGISTIC_REGRESSION,
+        config=CoordinateOptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=40), l2_weight=l2,
+            compute_variance=True, variance_mode="full",
+        ),
+    )
+    model, _ = coord.update_model(coord.initial_model())
+    v = np.asarray(model.variances)
+    p = np.asarray(re.projection.matrix, np.float64)
+    tbl = np.asarray(model.coefficients, np.float64)
+    row_of = {kk: i for i, kk in enumerate(np.asarray(model.entity_keys))}
+    for e_key in np.unique(entities)[:2]:
+        mask = entities == e_key
+        r = row_of[e_key]
+        # exact solve-space coefficients from the back-projected row
+        wk = np.linalg.solve(p.T @ p, p.T @ tbl[r])
+        xk = x[mask].astype(np.float64) @ p
+        m = xk @ wk
+        s = 1 / (1 + np.exp(-m))
+        h = xk.T @ (xk * (s * (1 - s))[:, None]) + l2 * np.eye(k)
+        want = np.einsum("dk,kl,dl->d", p, np.linalg.inv(h), p)
+        np.testing.assert_allclose(v[r], want, rtol=2e-3)
