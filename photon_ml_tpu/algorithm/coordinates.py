@@ -44,8 +44,10 @@ from photon_ml_tpu.ops.normalization import NormalizationContext, no_normalizati
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
 from photon_ml_tpu.ops.variance import (
+    FULL_VARIANCE_MAX_DIM,
     coefficient_variances,
     diag_inverse_from_hessian,
+    full_inverse_from_hessian,
     inverse_of_diagonal,
     resolve_variance_mode,
     resolve_variance_mode_for,
@@ -248,13 +250,21 @@ class RandomEffectCoordinate(Coordinate):
 
     def update_model(self, model: RandomEffectModel, extra_offsets: Array | None = None):
         projector = self.re_dataset.projector_type
-        if projector == ProjectorType.RANDOM and self.normalization is not None:
-            # the reference's ProjectionMatrixBroadcast.projectNormalizationContext
-            # maps factors/shifts through the Gaussian sketch, which does not
-            # commute with per-feature scaling — rejected loudly here
+        if (
+            projector == ProjectorType.RANDOM
+            and self.normalization is not None
+            and not self.re_dataset.pre_normalized
+        ):
+            # normalization must be applied BEFORE the sketch (exact),
+            # which happens at dataset build; a post-hoc context cannot be
+            # folded through P (the reference's projected-context approach,
+            # ProjectionMatrixBroadcast.projectNormalizationContext, does
+            # not commute with per-feature scaling and is not reproduced)
             raise ValueError(
-                "feature normalization is not supported with RANDOM-projected "
-                "random-effect coordinates (use INDEX_MAP or IDENTITY)"
+                "RANDOM-projected coordinate with normalization: the "
+                "RandomEffectDataset must be built with the same "
+                "normalization (build_random_effect_dataset(normalization=...)) "
+                "so features are normalized before sketching"
             )
         # RANDOM-projected variances are PROPAGATED properly below:
         # var(w) = diag(P H_k⁻¹ Pᵀ). (The reference back-projects means but
@@ -289,11 +299,13 @@ class RandomEffectCoordinate(Coordinate):
                 "tables would be emitted as model-space coefficients while "
                 "actually living in normalized space"
             )
-        # pre-normalized INDEX_MAP blocks already hold x' = (x-shift)*factor,
-        # so the SOLVE runs on a plain objective; table/model conversions and
-        # variance post-processing still use the context
+        # pre-normalized projected blocks already hold x' = (x-shift)*factor
+        # (INDEX_MAP: per-entity gathered columns; RANDOM: normalized before
+        # sketching), so the SOLVE runs on a plain objective; table/model
+        # conversions and variance post-processing still use the context
         solve_norm = (
-            None if projector == ProjectorType.INDEX_MAP else self.normalization
+            None if projector in (ProjectorType.INDEX_MAP, ProjectorType.RANDOM)
+            else self.normalization
         )
         objective = _make_objective(self.task, self.config, solve_norm)
         opt = _solve_config(self.config)
@@ -665,8 +677,6 @@ def _jitted_re_bucket_variances_random(
     (ProjectionMatrixBroadcast.scala:76) — a length-k vector attached to a
     length-d model. Standalone entry points reject that; this kernel does
     the propagation properly."""
-    from photon_ml_tpu.ops.variance import full_inverse_from_hessian
-
     offsets = _bucket_offsets(sample_rows, full_offsets)
     wks = _recover_sketch_coefficients(table[entity_rows], matrix)
 
@@ -685,11 +695,6 @@ def random_variance_mode(mode: str, d: int, k: int, num_problems: int) -> str:
     ENTITY — num_problems·d·k floats, unbounded in d (the axis the sketch
     exists to shrink) — so the budget must cover that stack, not just the
     e·k² Hessians."""
-    from photon_ml_tpu.ops.variance import (
-        FULL_VARIANCE_MAX_DIM,
-        resolve_variance_mode,
-    )
-
     resolved = resolve_variance_mode(mode, k, num_problems=num_problems)
     if (
         mode == "auto"

@@ -454,13 +454,13 @@ def build_random_effect_dataset(
     shard = dataset.feature_shards[shard_id]
     if (
         normalization is not None
-        and projector_type != ProjectorType.INDEX_MAP
+        and projector_type == ProjectorType.IDENTITY
         and not isinstance(shard, SparseShard)  # sparse coerces to INDEX_MAP
     ):
         raise ValueError(
             "build_random_effect_dataset(normalization=...) pre-normalizes "
-            "INDEX_MAP entity blocks only; IDENTITY coordinates normalize "
-            "through the objective's context, RANDOM is unsupported"
+            "PROJECTED entity blocks (INDEX_MAP/RANDOM/compact); IDENTITY "
+            "coordinates normalize through the objective's context"
         )
     if isinstance(shard, SparseShard):
         if normalization is not None and normalization.shifts is not None:
@@ -504,6 +504,26 @@ def build_random_effect_dataset(
         if projected_dim is None:
             raise ValueError("RANDOM projection requires projected_dim")
         projection = RandomProjectionMatrix.create(dim, projected_dim, seed)
+        if normalization is not None:
+            # normalize BEFORE sketching: x' = (x - shift)*factor, then
+            # project — exact, unlike the reference's projection OF the
+            # context (ProjectionMatrixBroadcast.projectNormalizationContext
+            # maps factor/shift vectors through the Gaussian sketch, which
+            # does not commute with per-feature scaling). Solves then run
+            # plain; the back-projected [E, d] tables are normalized-space
+            # coefficients and convert through the standard context algebra.
+            from photon_ml_tpu.ops.normalization import (
+                host_factors,
+                host_shifts,
+            )
+
+            features = np.asarray(features)
+            shifts = host_shifts(normalization)
+            if shifts is not None:
+                features = features - shifts.astype(features.dtype)
+            factors = host_factors(normalization)
+            if factors is not None:
+                features = features * factors.astype(features.dtype)
         features = projection.project_features(features).astype(features.dtype)
 
     per_bucket = group_entities_into_buckets(

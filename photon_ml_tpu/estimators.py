@@ -737,13 +737,14 @@ class GameEstimator:
 def _build_normalization_for(cfg: RandomEffectCoordinateConfig,
                              dataset: GameDataset, norms) -> "NormalizationContext | None":
     """Context to PRE-normalize an RE coordinate's entity blocks at dataset
-    build: INDEX_MAP coordinates, and sparse shards (which coerce to the
-    compact INDEX_MAP representation). IDENTITY coordinates normalize
-    through the objective's context instead; one predicate shared by the
-    CD and fused paths so they cannot drift."""
-    if cfg.projector_type == ProjectorType.INDEX_MAP or isinstance(
-        dataset.feature_shards[cfg.feature_shard_id], SparseShard
-    ):
+    build: INDEX_MAP and RANDOM coordinates (RANDOM normalizes BEFORE
+    sketching — exact), and sparse shards (which coerce to the compact
+    INDEX_MAP representation). IDENTITY coordinates normalize through the
+    objective's context instead; one predicate shared by the CD and fused
+    paths so they cannot drift."""
+    if cfg.projector_type in (
+        ProjectorType.INDEX_MAP, ProjectorType.RANDOM
+    ) or isinstance(dataset.feature_shards[cfg.feature_shard_id], SparseShard):
         return norms.get(cfg.feature_shard_id)
     return None
 

@@ -208,12 +208,13 @@ def _buckets_pytree(
     for k, ds in re_datasets.items():
         if (
             k in normalized_re_types
-            and ds.projector_type == ProjectorType.INDEX_MAP
+            and ds.projector_type in (ProjectorType.INDEX_MAP,
+                                      ProjectorType.RANDOM)
             and not ds.pre_normalized
         ):
             raise ValueError(
-                f"random-effect coordinate '{k}': INDEX_MAP with "
-                "normalization requires the RandomEffectDataset to be "
+                f"random-effect coordinate '{k}': projected coordinates "
+                "with normalization require the RandomEffectDataset to be "
                 "built with the same normalization "
                 "(build_random_effect_dataset(normalization=...))"
             )
@@ -386,12 +387,6 @@ class GameTrainProgram:
                     "intercept_index (the intercept absorbs each entity's "
                     "margin shift in model space)"
                 )
-            if ctx is not None and s.projector == ProjectorType.RANDOM:
-                raise ValueError(
-                    f"random-effect coordinate '{s.re_type}': normalization "
-                    "cannot combine with a RANDOM-projected coordinate "
-                    "(same rule as the coordinate-descent path)"
-                )
         self._re_objectives = {
             s.re_type: GLMObjective(
                 loss, l2_weight=s.l2_weight,
@@ -400,14 +395,16 @@ class GameTrainProgram:
             )
             for s in self.re_specs
         }
-        # INDEX_MAP + normalization: entity blocks arrive pre-normalized
-        # (build_random_effect_dataset(normalization=...)), so their SOLVES
-        # use a plain objective; scoring/table conversion keep the context
+        # projected (INDEX_MAP/RANDOM) + normalization: entity blocks
+        # arrive pre-normalized (build_random_effect_dataset(
+        # normalization=...)), so their SOLVES use a plain objective;
+        # scoring/table conversion keep the context
         self._re_solve_objectives = {
             s.re_type: (
                 GLMObjective(loss, l2_weight=s.l2_weight, use_pallas=False)
                 if (
-                    s.projector == ProjectorType.INDEX_MAP
+                    s.projector in (ProjectorType.INDEX_MAP,
+                                    ProjectorType.RANDOM)
                     and re_normalizations.get(s.re_type) is not None
                 )
                 else self._re_objectives[s.re_type]
@@ -1169,7 +1166,10 @@ def compute_state_variances(
                 random_variance_mode,
             )
 
-            objective = program._re_objectives[spec.re_type]
+            # PLAIN solve objective: features/coefficients are k-dim
+            # sketch-space (and pre-normalized at build when a context
+            # exists) — the d-length context must not touch them
+            objective = program._re_solve_objectives[spec.re_type]
             resolved = random_variance_mode(
                 variance_mode, ds.dim, int(ds.projection.matrix.shape[1]),
                 max_bucket,

@@ -422,3 +422,148 @@ def test_random_projection_variances_logistic_eval_point():
         h = xk.T @ (xk * (s * (1 - s))[:, None]) + l2 * np.eye(k)
         want = np.einsum("dk,kl,dl->d", p, np.linalg.inv(h), p)
         np.testing.assert_allclose(v[r], want, rtol=2e-3)
+
+
+def test_random_projection_with_normalization_matches_prescaled():
+    """r4: RANDOM × normalization — features are normalized BEFORE
+    sketching (exact; the reference instead maps the context through the
+    sketch, which does not commute with per-feature scaling). A normalized
+    fit on raw data must equal a plain fit on manually pre-scaled data,
+    related by w_model = factor ∘ w_plain — variances by factor²."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(9)
+    n, d, k = 600, 30, 10
+    entities = np.array([f"e{i}" for i in rng.integers(0, 6, size=n)])
+    x = rng.normal(size=(n, d)).astype(np.float32) * 10.0 ** rng.uniform(
+        -1, 1, size=d
+    ).astype(np.float32)
+    y = (x.sum(axis=1) / d + 0.1 * rng.normal(size=n)).astype(np.float32)
+    norm = _norm_for(x)
+    factors = np.asarray(norm.factors)
+
+    ds_raw = build_game_dataset(labels=y, feature_shards={"s": x},
+                                entity_keys={"e": entities})
+    ds_scaled = build_game_dataset(
+        labels=y, feature_shards={"s": x * factors},
+        entity_keys={"e": entities},
+    )
+
+    def fit(ds, normalization, variance=True):
+        re = build_random_effect_dataset(
+            ds, "e", "s", projector_type=ProjectorType.RANDOM,
+            projected_dim=k, seed=5, normalization=normalization,
+        )
+        coord = RandomEffectCoordinate(
+            coordinate_id="re", dataset=ds, re_dataset=re,
+            task=TaskType.LINEAR_REGRESSION,
+            config=CoordinateOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=40), l2_weight=0.3,
+                compute_variance=variance, variance_mode="full",
+            ),
+            normalization=normalization,
+        )
+        model, _ = coord.update_model(coord.initial_model())
+        return model
+
+    m_norm = fit(ds_raw, norm)
+    m_plain = fit(ds_scaled, None)
+    w_norm = np.asarray(m_norm.coefficients)
+    w_plain = np.asarray(m_plain.coefficients)
+    np.testing.assert_allclose(w_norm, w_plain * factors, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(m_norm.variances),
+        np.asarray(m_plain.variances) * factors * factors,
+        rtol=1e-4,
+    )
+    # and both models score their respective data identically
+    np.testing.assert_allclose(
+        np.asarray(m_norm.score_dataset(ds_raw)),
+        np.asarray(m_plain.score_dataset(ds_scaled)),
+        atol=1e-4,
+    )
+
+
+def test_random_projection_normalized_through_estimator_fused():
+    """RANDOM × normalization through GameEstimator, CD vs fused mesh."""
+    from photon_ml_tpu.estimators import (
+        GameEstimator,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_ml_tpu.ops.normalization import NormalizationType
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(11)
+    n, d = 424, 24
+    entities = np.array([f"e{i}" for i in rng.integers(0, 7, size=n)])
+    x = (rng.normal(size=(n, d)) * 10.0 ** rng.uniform(-1, 1, size=d)).astype(
+        np.float32
+    )
+    y = (x.sum(axis=1) / d + 0.1 * rng.normal(size=n)).astype(np.float32)
+    ds = build_game_dataset(labels=y, feature_shards={"s": x},
+                            entity_keys={"e": entities})
+    out = {}
+    for name, mesh in (("cd", None), ("fused", make_mesh())):
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinate_configs={
+                "re": RandomEffectCoordinateConfig(
+                    "e", "s",
+                    CoordinateOptimizationConfig(
+                        optimizer=OptimizerConfig(max_iterations=30),
+                        l2_weight=0.3,
+                    ),
+                    projector_type=ProjectorType.RANDOM, projected_dim=8,
+                )
+            },
+            normalization=NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+            num_iterations=1, mesh=mesh,
+        )
+        out[name] = np.asarray(est.fit(ds).model.get("re").coefficients)
+    np.testing.assert_allclose(out["fused"], out["cd"], atol=5e-3)
+
+
+def test_random_projection_normalized_variances_fused():
+    """The fused post-hoc variance path for a normalized RANDOM coordinate
+    must use the PLAIN solve objective over sketch-space features (the
+    d-length context cannot apply to k-dim blocks) and agree with CD."""
+    from photon_ml_tpu.estimators import (
+        GameEstimator,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_ml_tpu.ops.normalization import NormalizationType
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(13)
+    n, d = 312, 24
+    entities = np.array([f"e{i}" for i in rng.integers(0, 5, size=n)])
+    x = (rng.normal(size=(n, d)) * 10.0 ** rng.uniform(-1, 1, size=d)).astype(
+        np.float32
+    )
+    y = (x.sum(axis=1) / d + 0.1 * rng.normal(size=n)).astype(np.float32)
+    ds = build_game_dataset(labels=y, feature_shards={"s": x},
+                            entity_keys={"e": entities})
+    out = {}
+    for name, mesh in (("cd", None), ("fused", make_mesh())):
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinate_configs={
+                "re": RandomEffectCoordinateConfig(
+                    "e", "s",
+                    CoordinateOptimizationConfig(
+                        optimizer=OptimizerConfig(max_iterations=30),
+                        l2_weight=0.3, compute_variance=True,
+                    ),
+                    projector_type=ProjectorType.RANDOM, projected_dim=8,
+                )
+            },
+            normalization=NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+            num_iterations=1, mesh=mesh,
+        )
+        m = est.fit(ds).model.get("re")
+        out[name] = (np.asarray(m.coefficients), np.asarray(m.variances))
+    np.testing.assert_allclose(out["fused"][0], out["cd"][0], atol=5e-3)
+    v_cd, v_fu = out["cd"][1], out["fused"][1]
+    fin = np.isfinite(v_cd) & np.isfinite(v_fu)
+    assert fin.any()
+    np.testing.assert_allclose(v_fu[fin], v_cd[fin], rtol=5e-2)
