@@ -130,6 +130,19 @@ class DistributedScorer:
             if kind == "fe":
                 feats = dataset.feature_shards[m.feature_shard_id]
                 w = jnp.asarray(m.glm.coefficients.means)
+                if cid == self.fe_sharded_cid:
+                    # the sharded feature/coefficient axis must divide the
+                    # mesh "model" axis: right-pad with zero columns /
+                    # coefficients (contribute nothing), same convention as
+                    # the training estimator's fe_pad
+                    model_axis = int(self.mesh.shape["model"])
+                    pad = (-int(w.shape[0])) % model_axis
+                    if pad:
+                        w = jnp.pad(w, (0, pad))
+                        if not isinstance(feats, SparseShard):
+                            feats = jnp.pad(
+                                jnp.asarray(feats), ((0, 0), (0, pad))
+                            )
                 if isinstance(feats, SparseShard):
                     rows, cols, vals = feats.coalesced()
                     # rows fit int32 (sample counts); cols keep a width

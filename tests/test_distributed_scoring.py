@@ -209,3 +209,103 @@ class TestCompactModelDistributedScoring:
         ref = GameTransformer(model=model).transform(ds)
         got = DistributedScorer(model, make_mesh()).score_dataset(ds)
         np.testing.assert_allclose(got, ref.scores, rtol=1e-5, atol=1e-5)
+
+
+class TestScoringDriverDistributed:
+    def test_cli_mesh_scores_match_single_device(self, tmp_path):
+        """Train via the training-driver CLI, then score via the
+        scoring-driver CLI with and without --mesh: identical score files
+        and evaluations (the VERDICT r3 #3 done-criterion)."""
+        from photon_ml_tpu.io import avro as avro_io
+        from photon_ml_tpu.io import photon_schemas as schemas
+        from photon_ml_tpu.cli import game_scoring_driver
+        from photon_ml_tpu.cli.game_training_driver import parse_args, run
+        from photon_ml_tpu.io.model_io import read_scores
+
+        schema = {
+            "name": "ScoreDriverE2EAvro", "type": "record",
+            "fields": [
+                {"name": "uid", "type": ["string", "null"]},
+                {"name": "label", "type": "double"},
+                {"name": "features",
+                 "type": {"type": "array", "items": schemas.FEATURE_AVRO}},
+                {"name": "userFeatures",
+                 "type": {"type": "array", "items": "FeatureAvro"}},
+                {"name": "weight", "type": ["double", "null"], "default": None},
+                {"name": "offset", "type": ["double", "null"], "default": None},
+                {"name": "metadataMap",
+                 "type": [{"type": "map", "values": "string"}, "null"],
+                 "default": None},
+            ],
+        }
+
+        def records(n, seed):
+            rng = np.random.default_rng(seed)
+            out = []
+            for i in range(n):
+                xg, xu = rng.normal(size=4), rng.normal(size=2)
+                out.append({
+                    "uid": str(i),
+                    "label": float(xg.sum() + 0.1 * rng.normal()),
+                    "features": [
+                        {"name": f"g{j}", "term": "", "value": float(xg[j])}
+                        for j in range(4)
+                    ],
+                    "userFeatures": [
+                        {"name": f"u{j}", "term": "", "value": float(xu[j])}
+                        for j in range(2)
+                    ],
+                    "weight": 1.0, "offset": 0.0,
+                    "metadataMap": {"userId": f"user{int(rng.integers(0, 5))}"},
+                })
+            return out
+
+        import os
+
+        for split, n, seed in (("train", 160, 1), ("score", 75, 2)):
+            os.makedirs(tmp_path / split, exist_ok=True)
+            avro_io.write_container(
+                str(tmp_path / split / "part-00000.avro"), schema,
+                records(n, seed),
+            )
+        run(parse_args([
+            "--input-data-path", str(tmp_path / "train"),
+            "--root-output-dir", str(tmp_path / "out"),
+            "--task-type", "LINEAR_REGRESSION",
+            "--feature-shard-configurations",
+            "name=global,feature.bags=features,intercept=true",
+            "--feature-shard-configurations",
+            "name=perUser,feature.bags=userFeatures,intercept=false",
+            "--coordinate-configurations",
+            "name=fe,feature.shard=global,reg.weights=1,max.iter=10",
+            "--coordinate-configurations",
+            "name=per-user,feature.shard=perUser,random.effect.type=userId,"
+            "reg.weights=1,max.iter=10",
+            "--coordinate-descent-iterations", "1",
+        ]))
+        shard_args = [
+            "--feature-shard-configurations",
+            "name=global,feature.bags=features,intercept=true",
+            "--feature-shard-configurations",
+            "name=perUser,feature.bags=userFeatures,intercept=false",
+        ]
+        outs = {}
+        for mode, extra in (
+            ("single", []),
+            ("dist", ["--mesh", "data=4,model=2"]),
+        ):
+            summary = game_scoring_driver.main([
+                "--input-data-path", str(tmp_path / "score"),
+                "--model-input-dir", str(tmp_path / "out" / "best"),
+                "--output-dir", str(tmp_path / f"scored-{mode}"),
+                "--evaluators", "RMSE",
+            ] + shard_args + extra)
+            recs = read_scores(str(tmp_path / f"scored-{mode}" / "scores"))
+            recs.sort(key=lambda r: int(r["uid"]))
+            outs[mode] = (
+                np.asarray([r["predictionScore"] for r in recs]),
+                summary["evaluations"]["RMSE"],
+            )
+        np.testing.assert_allclose(outs["dist"][0], outs["single"][0],
+                                   rtol=1e-5, atol=1e-5)
+        assert outs["dist"][1] == pytest.approx(outs["single"][1], rel=1e-6)
