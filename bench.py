@@ -153,12 +153,33 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
     from photon_ml_tpu.ops.losses import LogisticLoss
     from photon_ml_tpu.ops.objective import GLMObjective
 
+    from photon_ml_tpu.data.game_data import build_game_dataset
+    from photon_ml_tpu.io.data_reader import (
+        FeatureShardConfiguration,
+        shard_np_dtypes,
+    )
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.parallel.sharded_dense import ShardedDenseGLMObjective
+
     n, d = x.shape
     xbytes = n * d * 4
     batch = LabeledPointBatch.create(jax.device_put(x), jax.device_put(y))
-    batch_bf16 = LabeledPointBatch.create(
-        jax.device_put(jnp.asarray(x, jnp.bfloat16)), jax.device_put(y)
+    # bf16 block via the PRODUCT path: FeatureShardConfiguration(dtype=bf16)
+    # -> shard_np_dtypes -> build_game_dataset(shard_dtypes=...), the exact
+    # chain `--feature-shard-configurations ...,dtype=bf16` drives — so
+    # this row measures what the CLI actually feeds the hot loop
+    # (VERDICT r4 #3)
+    _ds = build_game_dataset(
+        labels=y, feature_shards={"global": x},
+        shard_dtypes=shard_np_dtypes(
+            {"global": FeatureShardConfiguration(("f",), dtype="bfloat16")}
+        ),
     )
+    batch_bf16 = LabeledPointBatch.create(
+        _ds.feature_shards["global"], jax.device_put(y)
+    )
+    assert batch_bf16.features.dtype == jnp.bfloat16
+    del _ds
     # wide K spread: per-call tunnel dispatch jitters by tens of ms, so the
     # K_hi-K_lo device-time delta must dwarf it (BENCH_r03 saw a 80-eval
     # spread produce a NEGATIVE marginal under dispatch noise)
@@ -221,7 +242,15 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
         ("pallas_bf16",
          GLMObjective(LogisticLoss(), l2_weight=0.5, use_pallas=True),
          batch_bf16, xbytes // 2,
-         "1 fused bf16 X pass/eval (half the bytes), f32 accumulation"),
+         "1 fused bf16 X pass/eval (half the bytes, via the reader's "
+         "dtype=bf16 product cast), f32 accumulation"),
+        ("pallas_shardmap_mesh1",
+         ShardedDenseGLMObjective(LogisticLoss(), make_mesh(data=1, model=1),
+                                  l2_weight=0.5, use_pallas=True),
+         batch, xbytes,
+         "the same single-pass kernel INSIDE shard_map on a 1-device mesh "
+         "(parallel/sharded_dense.py — the multi-chip hot-loop path, "
+         "VERDICT r4 #1); parity with pallas_kernel = the wrapper is free"),
     ):
         def step(w, bb, _obj=obj):
             v, g = _obj.value_and_gradient(w, bb)

@@ -147,13 +147,21 @@ class FixedEffectCoordinate(Coordinate):
     config: CoordinateOptimizationConfig
     normalization: NormalizationContext | None = None
     intercept_index: int | None = None
+    #: single-pass kernel on this (un-vmapped, dense) solve: None = TPU
+    #: auto, True = force (interpret off-TPU), False = off
+    use_pallas: bool | None = None
     _update_count: int = dataclasses.field(default=0, init=False, repr=False)
 
     def initial_model(self) -> FixedEffectModel:
         shard = self.dataset.feature_shards[self.feature_shard_id]
+        from photon_ml_tpu.data.batch import solve_dtype_of
+
         return FixedEffectModel(
             glm=GeneralizedLinearModel(
-                Coefficients.zeros(shard.shape[1], dtype=shard.dtype), self.task
+                Coefficients.zeros(
+                    shard.shape[1], dtype=solve_dtype_of(shard.dtype)
+                ),
+                self.task,
             ),
             feature_shard_id=self.feature_shard_id,
         )
@@ -175,14 +183,14 @@ class FixedEffectCoordinate(Coordinate):
             )
             self._update_count += 1
             batch = batch.replace(weights=jnp.asarray(new_w, dtype=batch.weights.dtype))
-        # use_pallas=None (auto): the FE solve is the one UN-vmapped dense
-        # hot loop, where the single-pass Pallas kernel measures ~2x the
-        # autodiff path on TPU (BASELINE.md r4 study; harmless no-op for
+        # default use_pallas=None (auto): the FE solve is the one UN-vmapped
+        # dense hot loop, where the single-pass Pallas kernel measures ~2x
+        # the autodiff path on TPU (BASELINE.md r4 study; harmless no-op for
         # sparse batches, whose objective has no kernel)
         objective = _make_objective(
             self.task, self.config, self.normalization,
             sparse=isinstance(batch, SparseLabeledPointBatch),
-            use_pallas=None,
+            use_pallas=self.use_pallas,
         )
         if self.config.compute_variance:
             # fail a full-variance-on-sparse config BEFORE the (possibly
@@ -232,8 +240,12 @@ class RandomEffectCoordinate(Coordinate):
     intercept_index: int | None = None
 
     def initial_model(self) -> RandomEffectModel:
+        from photon_ml_tpu.data.batch import solve_dtype_of
+
         re = self.re_dataset
-        dtype = self.dataset.feature_shards[re.feature_shard_id].dtype
+        dtype = solve_dtype_of(
+            self.dataset.feature_shards[re.feature_shard_id].dtype
+        )
         return RandomEffectModel(
             # compact (sparse-shard) coordinates hold [E, K] tables over each
             # entity's active columns; dense hold [E, dim]

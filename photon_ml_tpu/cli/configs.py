@@ -84,6 +84,19 @@ def parse_feature_shard_config(spec: str) -> tuple[str, FeatureShardConfiguratio
     sparse = _bool(kv.pop("sparse", "false"))
     pre_indexed = _bool(kv.pop("pre.indexed", "false"))
     dimension = kv.pop("dimension", None)
+    # dtype=bf16 halves the dense block's HBM footprint/traffic (hot loop
+    # at ~1.2-1.4x, BASELINE.md r4 bf16 study); accepted aliases follow
+    # common usage
+    dtype_aliases = {
+        "f32": "float32", "float32": "float32", "fp32": "float32",
+        "bf16": "bfloat16", "bfloat16": "bfloat16",
+    }
+    raw_dtype = kv.pop("dtype", "float32").lower()
+    if raw_dtype not in dtype_aliases:
+        raise ValueError(
+            f"unknown feature shard dtype {raw_dtype!r} in {spec!r} "
+            f"(expected one of {sorted(dtype_aliases)})"
+        )
     if kv:
         raise ValueError(f"unknown feature shard keys {sorted(kv)} in {spec!r}")
     if pre_indexed and dimension is None:
@@ -94,6 +107,7 @@ def parse_feature_shard_config(spec: str) -> tuple[str, FeatureShardConfiguratio
         feature_bags=bags, has_intercept=intercept, sparse=sparse,
         pre_indexed=pre_indexed,
         dimension=None if dimension is None else int(dimension),
+        dtype=dtype_aliases[raw_dtype],
     )
 
 

@@ -115,6 +115,15 @@ class LabeledPointBatch:
         )
 
 
+def solve_dtype_of(feature_dtype) -> jnp.dtype:
+    """Coefficient/optimizer-state dtype for a feature-block dtype: bf16
+    blocks still solve in f32 (see LabeledPointBatch.solve_dtype)."""
+    return (
+        jnp.float32 if jnp.dtype(feature_dtype) == jnp.bfloat16
+        else jnp.dtype(feature_dtype)
+    )
+
+
 def compute_margins(batch: LabeledPointBatch, coefficients: Array) -> Array:
     """margin_i = x_i . w + offset_i (reference DataPoint.computeMargin)."""
     return batch.features @ coefficients + batch.offsets

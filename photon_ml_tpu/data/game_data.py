@@ -862,12 +862,18 @@ def build_game_dataset(
     ids: Mapping[str, np.ndarray] | None = None,
     entity_vocabs: Mapping[str, np.ndarray] | None = None,
     dtype=np.float32,
+    shard_dtypes: Mapping[str, object] | None = None,
 ) -> GameDataset:
     """Assemble a GameDataset from host arrays (reference GameConverters).
 
     entity_keys: RE type -> [n] per-sample entity key array; vocabs are built
     from the observed keys unless provided (warm-start scoring needs the
     training vocab, reference GameEstimator.getInitialModel).
+
+    shard_dtypes: per-shard storage-dtype overrides (e.g. ml_dtypes.bfloat16
+    for a dtype=bf16 FeatureShardConfiguration) — applied at assembly so a
+    bf16 block is cast ONCE on host and transferred once, never staged
+    through a full-size f32 device array.
     """
     labels = np.asarray(labels, dtype=dtype)
     n = len(labels)
@@ -911,7 +917,10 @@ def build_game_dataset(
         k: v for k, v in feature_shards.items()
         if not isinstance(v, SparseShard)
     }
-    host_shards = {k: np.asarray(v, dtype=dtype) for k, v in host_shards.items()}
+    host_shards = {
+        k: np.asarray(v, dtype=(shard_dtypes or {}).get(k, dtype))
+        for k, v in host_shards.items()
+    }
     device_shards: dict[str, object] = {
         k: (v if isinstance(v, SparseShard) else None)
         for k, v in feature_shards.items()

@@ -159,6 +159,12 @@ class GameEstimator:
     #: shard the FE coordinate's feature axis over the mesh "model" axis
     #: (giant-d coordinates; requires mesh)
     fe_feature_sharded: bool = False
+    #: single-pass Pallas GLM kernel on the primary FE solve. None (default)
+    #: = auto: the kernel on TPU — per-device via shard_map when the mesh
+    #: has >1 devices, direct when single-device — autodiff elsewhere.
+    #: True forces it (interpret mode off-TPU; what the virtual-mesh tests
+    #: use), False disables it.
+    use_pallas: bool | None = None
 
     def fit(
         self,
@@ -194,6 +200,7 @@ class GameEstimator:
                     config=cfg.optimization,
                     normalization=norms.get(cfg.feature_shard_id),
                     intercept_index=self.intercept_indices.get(cfg.feature_shard_id),
+                    use_pallas=self.use_pallas,
                 )
             elif isinstance(cfg, MatrixFactorizationCoordinateConfig):
                 mf_dataset = build_mf_dataset(
@@ -523,9 +530,12 @@ class GameEstimator:
             extra_fe_normalizations={
                 sh: norms[sh] for sh in extra_fe_cid_of_shard if sh in norms
             },
-            # single-device meshes can take the single-pass kernel on the
-            # dense FE solve (a sharded batch cannot — see the program)
-            use_pallas_fe=int(np.prod(list(self.mesh.devices.shape))) == 1,
+            # the single-pass kernel reaches the dense FE solve directly on
+            # a single-device mesh and via the shard_map wrapper on a
+            # multi-device one (the program gates on the mesh)
+            use_pallas_fe=self.use_pallas,
+            mesh=self.mesh,
+            fe_feature_sharded=self.fe_feature_sharded,
         )
 
         # locked coordinates: fixed residual offsets + pass-through models

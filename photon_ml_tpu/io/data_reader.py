@@ -64,6 +64,27 @@ class FeatureShardConfiguration:
     #: (README.md:77 scale through the product path). LibSVM format only.
     pre_indexed: bool = False
     dimension: int | None = None
+    #: storage dtype of the assembled dense block: "float32" (default) or
+    #: "bfloat16". bf16 halves the block's HBM footprint and traffic — the
+    #: hot loop streams it at ~1.2-1.4x the f32 rate with all accumulation,
+    #: coefficients, and aux columns staying f32 (BASELINE.md r4 bf16
+    #: study; <5e-6 coefficient delta on the accuracy table). Dense shards
+    #: only. No reference analogue (TPU-first capability).
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"feature shard dtype must be 'float32' or 'bfloat16', "
+                f"got {self.dtype!r}"
+            )
+        if self.dtype == "bfloat16" and self.sparse:
+            raise ValueError(
+                "dtype=bfloat16 applies to dense feature blocks; sparse "
+                "(COO/ELL) shards keep f32 values — their hot loop is "
+                "index-bound, not bandwidth-bound (BASELINE.md sparse "
+                "floor study)"
+            )
 
 
 def read_avro_records(path: str | os.PathLike) -> Iterator[dict]:
@@ -304,6 +325,7 @@ def records_to_game_dataset(
         ids=eval_ids,
         entity_vocabs=entity_vocabs,
         dtype=dtype,
+        shard_dtypes=shard_np_dtypes(shard_configs),
     )
     return ReadResult(
         dataset=dataset,
@@ -343,10 +365,11 @@ def read_merged(
             "(avro features are name-term keyed; index them with feature "
             "maps instead)"
         )
+    result = None
     if fmt == "libsvm":
         # CSR fast path: native C++ tokenizer (photon_ml_tpu/native/
         # libsvm_loader.cpp) + vectorized dense assembly, no per-record dicts
-        return _read_merged_libsvm(
+        result = _read_merged_libsvm(
             paths,
             shard_configs,
             index_maps=index_maps,
@@ -355,14 +378,13 @@ def read_merged(
             entity_vocabs=entity_vocabs,
             dtype=dtype,
         )
-
-    if fmt == "avro" and os.environ.get("PHOTON_NO_NATIVE_AVRO") != "1":
+    elif fmt == "avro" and os.environ.get("PHOTON_NO_NATIVE_AVRO") != "1":
         # columnar C++ decode (native/avro_decoder.cpp): ~2 orders of
         # magnitude over the per-record Python path; falls back below on
         # unsupported schema shapes or a missing compiler. Equivalence of
         # the two paths is pinned by tests/test_avro_native.py.
         try:
-            return _read_merged_avro_native(
+            result = _read_merged_avro_native(
                 paths, shard_configs,
                 index_maps=index_maps,
                 random_effect_id_columns=random_effect_id_columns,
@@ -374,28 +396,55 @@ def read_merged(
             logger.info("native avro path unavailable (%s); using the "
                         "Python reader", e)
 
-    def records():
-        if fmt == "avro":
-            return itertools.chain.from_iterable(read_avro_records(p) for p in paths)
-        raise ValueError(f"unknown format {fmt!r}")
+    if result is None:
+        def records():
+            if fmt == "avro":
+                return itertools.chain.from_iterable(
+                    read_avro_records(p) for p in paths
+                )
+            raise ValueError(f"unknown format {fmt!r}")
 
-    if index_maps is None:
-        # Decode once: index-map construction and dataset assembly both scan
-        # every record, and assembly materializes the data anyway.
-        materialized = list(records())
-        index_maps = build_index_maps(materialized, shard_configs)
-        record_source = materialized
-    else:
-        record_source = records()
-    return records_to_game_dataset(
-        record_source,
-        shard_configs,
-        index_maps,
-        random_effect_id_columns=random_effect_id_columns,
-        evaluation_id_columns=evaluation_id_columns,
-        entity_vocabs=entity_vocabs,
-        dtype=dtype,
-    )
+        if index_maps is None:
+            # Decode once: index-map construction and dataset assembly both
+            # scan every record, and assembly materializes the data anyway.
+            materialized = list(records())
+            index_maps = build_index_maps(materialized, shard_configs)
+            record_source = materialized
+        else:
+            record_source = records()
+        result = records_to_game_dataset(
+            record_source,
+            shard_configs,
+            index_maps,
+            random_effect_id_columns=random_effect_id_columns,
+            evaluation_id_columns=evaluation_id_columns,
+            entity_vocabs=entity_vocabs,
+            dtype=dtype,
+        )
+    return result
+
+
+def shard_np_dtypes(
+    shard_configs: Mapping[str, FeatureShardConfiguration],
+) -> dict[str, object] | None:
+    """Per-shard numpy storage dtypes from the shard configs, for
+    ``build_game_dataset(shard_dtypes=...)``.
+
+    Assembly (duplicate accumulation, intercept append) runs in the
+    reader's f32; the finished block is rounded to bf16 ONCE on host and
+    transferred once — the same arithmetic as casting the operand in the
+    kernel, so the BASELINE.md bf16 accuracy table applies. Both the
+    device-facing array and the host cache (bucket builders, normalization
+    summaries) see the cast block.
+    """
+    import ml_dtypes
+
+    out = {
+        s: ml_dtypes.bfloat16
+        for s, c in shard_configs.items()
+        if c.dtype == "bfloat16"
+    }
+    return out or None
 
 
 class _AvroNativeFallback(Exception):
@@ -651,6 +700,7 @@ def _read_merged_avro_native(
         ids={c: id_cols[c] for c in evaluation_id_columns},
         entity_vocabs=entity_vocabs,
         dtype=dtype,
+        shard_dtypes=shard_np_dtypes(shard_configs),
     )
     return ReadResult(
         dataset=dataset,
@@ -788,6 +838,7 @@ def _read_merged_libsvm(
         ids={c: empty_ids for c in evaluation_id_columns},
         entity_vocabs=entity_vocabs,
         dtype=dtype,
+        shard_dtypes=shard_np_dtypes(shard_configs),
     )
     return ReadResult(
         dataset=dataset,

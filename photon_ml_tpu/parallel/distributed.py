@@ -281,7 +281,9 @@ class GameTrainProgram:
         normalization: NormalizationContext | None = None,
         re_normalizations: Mapping[str, NormalizationContext] | None = None,
         extra_fe_normalizations: Mapping[str, NormalizationContext] | None = None,
-        use_pallas_fe: bool = False,
+        use_pallas_fe: bool | None = None,
+        mesh: Mesh | None = None,
+        fe_feature_sharded: bool = False,
     ):
         self.task = task
         self.fe = fe
@@ -334,13 +336,38 @@ class GameTrainProgram:
         self.normalization = normalization
         # use_pallas=False everywhere in the fused program by default: its
         # batches may be GSPMD mesh-sharded, and XLA cannot partition a
-        # pallas_call. use_pallas_fe=True opts the (un-vmapped, dense)
-        # primary-FE solve into the single-pass kernel — valid ONLY on a
-        # single-device run (callers that know the mesh set it).
-        self._fe_objective = GLMObjective(loss, l2_weight=fe.l2_weight,
-                                          normalization=normalization,
-                                          use_pallas=None if use_pallas_fe
-                                          else False)
+        # pallas_call. The single-pass kernel reaches the (un-vmapped,
+        # dense) primary-FE solve two ways:
+        #  - single device: use_pallas_fe opts this GLMObjective in
+        #    (None = TPU auto, True = force/interpret, False = off);
+        #  - multi-device mesh (pass ``mesh``): a shard_map wrapper runs
+        #    the kernel per-device on local rows and psums — the
+        #    reference's one-pass seqOp on every executor
+        #    (ValueAndGradientAggregator.scala:133-154, :236-251). Not
+        #    built when the FE block is feature-sharded over "model"
+        #    (that path is sparse/column-sharded) or use_pallas_fe=False.
+        # Callers that never pass a mesh keep the conservative False
+        # default: their batches may be GSPMD-sharded later, where a
+        # baked-in pallas_call cannot be partitioned.
+        n_mesh_devices = int(mesh.devices.size) if mesh is not None else 1
+        multi_device = mesh is not None and n_mesh_devices > 1
+        if mesh is None and use_pallas_fe is None:
+            use_pallas_fe = False  # topology unknown: keep the kernel out
+        self._fe_objective = GLMObjective(
+            loss, l2_weight=fe.l2_weight, normalization=normalization,
+            use_pallas=False if (multi_device or use_pallas_fe is False)
+            else use_pallas_fe,
+        )
+        self._fe_sharded_objective = None
+        if multi_device and use_pallas_fe is not False and not fe_feature_sharded:
+            from photon_ml_tpu.parallel.sharded_dense import (
+                ShardedDenseGLMObjective,
+            )
+
+            self._fe_sharded_objective = ShardedDenseGLMObjective(
+                loss, mesh, l2_weight=fe.l2_weight,
+                normalization=normalization, use_pallas=use_pallas_fe,
+            )
         # sparse twin, used when the FE shard arrives as flat COO (the
         # giant-d path); shares the normalization context so jit caches of
         # both variants stay identity-keyed
@@ -435,8 +462,12 @@ class GameTrainProgram:
                    dtype=None) -> GameTrainState:
         from photon_ml_tpu.models.matrix_factorization import init_factors
 
+        from photon_ml_tpu.data.batch import solve_dtype_of
+
         fe_dim = dataset.feature_shards[self.fe.feature_shard_id].shape[1]
-        dtype = dtype or dataset.feature_shards[self.fe.feature_shard_id].dtype
+        dtype = solve_dtype_of(
+            dtype or dataset.feature_shards[self.fe.feature_shard_id].dtype
+        )
         tables = {
             s.re_type: jnp.zeros(
                 (re_datasets[s.re_type].num_entities,
@@ -941,7 +972,13 @@ class GameTrainProgram:
                 offsets=fe_offsets,
                 weights=fe_weights,
             )
-            fe_objective = self._fe_objective
+            # multi-device mesh: per-device single-pass kernel + psum
+            # (parallel/sharded_dense.py) instead of the GSPMD autodiff path
+            fe_objective = (
+                self._fe_sharded_objective
+                if self._fe_sharded_objective is not None
+                else self._fe_objective
+            )
         return solve(
             self.fe.optimizer, fe_objective.bind(fe_batch), fe_w0
         ).coefficients
@@ -1819,6 +1856,27 @@ def train_distributed(
                 put_fn=put_fn,
             )
 
+    if val_data is not None and mesh is not None:
+        # device twins of the evaluators (evaluation/sharded.py): consts
+        # (labels/weights/query codes) are padded to the mesh length and
+        # placed sharded over "data" alongside the scores they reduce with.
+        # Prepared AFTER put_fn resolution so multi-process runs place
+        # through global_put like every other sharded input. mesh=None runs
+        # keep the exact host evaluators — there is no giant-n funnel to
+        # avoid, and the device AUC is a histogram approximation.
+        from photon_ml_tpu.evaluation.sharded import (
+            mesh_data_placer,
+            prepare_device_evaluators,
+        )
+
+        device_evals = prepare_device_evaluators(
+            evaluators, validation_eval_data,
+            n_pad=validation_dataset.num_samples,
+            place=mesh_data_placer(mesh, put_fn),
+        )
+    else:
+        device_evals = [None] * len(evaluators)
+
     def to_host(v):
         """Host copy of a (possibly multi-process sharded) array. The
         allgather is a COLLECTIVE — every process must call it, even those
@@ -1875,9 +1933,21 @@ def train_distributed(
                 training_evaluator.evaluate(train_scores, training_eval_data)
             )
         if val_data is not None:
-            val_scores = _host_scores(program.score(val_data, state), n_val)
-            for i, ev in enumerate(evaluators):
-                v = float(ev.evaluate(val_scores, validation_eval_data))
+            # device-side evaluation (evaluation/sharded.py): on a mesh,
+            # metrics reduce ON it from the still-sharded score vector;
+            # only scalars cross to the host — the giant-n validation pass
+            # never funnels [n] rows through one core (the reference's
+            # executor-side Evaluator/MultiEvaluator, Evaluator.scala:39-49).
+            # Evaluators without a device form (AUPR), and every evaluator
+            # on mesh=None runs, take the single host gather.
+            from photon_ml_tpu.evaluation.sharded import evaluate_prepared
+
+            val_scores = program.score(val_data, state)
+            values = evaluate_prepared(
+                evaluators, device_evals, val_scores, validation_eval_data,
+                lambda: _host_scores(val_scores, n_val),
+            )
+            for i, (ev, v) in enumerate(zip(evaluators, values)):
                 metrics[f"validate:{ev.name}"] = v
                 if i == 0 and (
                     best_state is None or ev.better_than(v, best_metric)

@@ -36,8 +36,29 @@ class TestConfigGrammar:
         assert name == "global"
         assert cfg.feature_bags == ("features", "userFeatures")
         assert not cfg.has_intercept
+        assert cfg.dtype == "float32"
         with pytest.raises(ValueError, match="unknown"):
             parse_feature_shard_config("name=g,feature.bags=f,bogus=1")
+
+    def test_feature_shard_dtype(self):
+        """dtype=bf16 grammar (VERDICT r4 #3): aliases accepted, sparse
+        shards rejected, junk rejected."""
+        for alias in ("bf16", "bfloat16", "BF16"):
+            _, cfg = parse_feature_shard_config(
+                f"name=g,feature.bags=f,dtype={alias}"
+            )
+            assert cfg.dtype == "bfloat16"
+        for alias in ("f32", "float32", "fp32"):
+            _, cfg = parse_feature_shard_config(
+                f"name=g,feature.bags=f,dtype={alias}"
+            )
+            assert cfg.dtype == "float32"
+        with pytest.raises(ValueError, match="unknown feature shard dtype"):
+            parse_feature_shard_config("name=g,feature.bags=f,dtype=fp8")
+        with pytest.raises(ValueError, match="dense"):
+            parse_feature_shard_config(
+                "name=g,feature.bags=f,sparse=true,dtype=bf16"
+            )
 
     def test_coordinate_fixed_effect(self):
         cfg = parse_coordinate_config(

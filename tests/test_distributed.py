@@ -786,9 +786,11 @@ class TestFusedStateVariances:
 
 
 def test_fused_step_pallas_fe_matches_default(rng):
-    """use_pallas_fe=True (single-device fused program) routes the primary
-    FE solve through the single-pass kernel (interpret mode on CPU) and
-    must reproduce the autodiff program's sweep."""
+    """use_pallas_fe=True (single-device fused program) FORCES the primary
+    FE solve through the single-pass kernel (interpret mode on CPU — since
+    r5 True means force, not auto) and must reproduce the autodiff
+    program's sweep up to f32 kernel-vs-autodiff reduction-order drift
+    amplified over the 8-iteration solve."""
     n, d_fe, d_re = 128, 16, 4
     users = np.array([f"u{i}" for i in rng.integers(0, 10, size=n)])
     x_fe = rng.normal(size=(n, d_fe)).astype(np.float32)
@@ -813,6 +815,6 @@ def test_fused_step_pallas_fe_matches_default(rng):
         state, loss = program.step(data, buckets,
                                    program.init_state(ds, re_ds))
         res[flag] = (np.asarray(state.fe_coefficients), float(loss))
-    np.testing.assert_allclose(res[True][0], res[False][0], rtol=2e-4,
-                               atol=2e-4)
-    assert abs(res[True][1] - res[False][1]) < 1e-5
+    np.testing.assert_allclose(res[True][0], res[False][0], rtol=2e-3,
+                               atol=1e-3)
+    assert abs(res[True][1] - res[False][1]) < 1e-4
