@@ -268,6 +268,69 @@ class DistributedScorer:
 
     # -- the jitted program --------------------------------------------------
 
+    def _ring_re_score(self, table: Array, x: Array, idx: Array) -> Array:
+        """Dense RE scoring with the entity table KEPT entity-sharded.
+
+        The naive ``table[idx]`` gather pairs an entity-sharded operand
+        with sample-sharded indices — GSPMD resolves that by all-gathering
+        the table, materializing the full [E, d] on every device (VERDICT
+        r4 missing-scale #6; the reference avoids it with an RDD join,
+        RandomEffectModel.scala). Here each device keeps only its
+        [E/K, d] block and the blocks ROTATE around the mesh "data" ring
+        (K-1 ppermutes): at step k a device scores the local samples whose
+        entity rows sit in the block it currently holds. Peak per-device
+        table memory is E/K·d — the ring trades the all-gather's K× memory
+        for the same total bytes on ICI.
+        """
+        mesh_k = int(self.mesh.shape["data"])
+        e_pad = int(table.shape[0])
+        eb = e_pad // mesh_k
+        if eb == 0:
+            # untrained/empty RE table — contribute zeros, mirroring the
+            # single-device score_random_effect guard (models/game.py)
+            return jnp.zeros(x.shape[:1], x.dtype)
+
+        def body(block, x_l, idx_l):
+            me = jax.lax.axis_index("data")
+            # bf16 feature shards: rows (f32) x x_l (bf16) promotes to f32
+            acc_dtype = jnp.result_type(block.dtype, x_l.dtype)
+
+            def accumulate(k, blk, acc):
+                # after k forward rotations device `me` holds block
+                # (me - k) mod K
+                owner = (me - k) % mesh_k
+                rel = idx_l - owner * eb
+                hit = (rel >= 0) & (rel < eb) & (idx_l >= 0)
+                rows = blk[jnp.clip(rel, 0, eb - 1)]
+                return acc + jnp.where(
+                    hit, jnp.einsum("nd,nd->n", rows, x_l), 0.0
+                )
+
+            def step(k, carry):
+                blk, acc = carry
+                acc = accumulate(k, blk, acc)
+                blk = jax.lax.ppermute(
+                    blk, "data",
+                    [(i, (i + 1) % mesh_k) for i in range(mesh_k)],
+                )
+                return blk, acc
+
+            # K-1 rotate+accumulate steps, then the last block accumulates
+            # WITHOUT a final (discarded) rotation
+            blk, acc = jax.lax.fori_loop(
+                0, mesh_k - 1, step,
+                (block, jnp.zeros(x_l.shape[:1], acc_dtype)),
+            )
+            return accumulate(mesh_k - 1, blk, acc)
+
+        return jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P("data", None), P("data", None), P("data")),
+            out_specs=P("data"),
+            check_vma=False,
+        )(table, x, idx)
+
     def _score_impl(self, data, params) -> Array:
         total = data["offsets"]
         for cid, c in data["coords"].items():
@@ -285,7 +348,10 @@ class DistributedScorer:
                 else:
                     s = c["x"] @ w
             elif kind == "re":
-                s = score_random_effect(p["table"], c["x"], c["idx"])
+                if self.mesh is not None and int(self.mesh.shape["data"]) > 1:
+                    s = self._ring_re_score(p["table"], c["x"], c["idx"])
+                else:
+                    s = score_random_effect(p["table"], c["x"], c["idx"])
             elif kind == "re_compact":
                 if "entries" in c:
                     e = c["entries"]
@@ -331,3 +397,52 @@ class DistributedScorer:
         else:
             scores = self._jit_score(data, params)
         return _host_scores(scores, n_true)
+
+    def evaluate_dataset(
+        self, dataset: GameDataset, evaluator_specs
+    ) -> dict[str, float]:
+        """Score + evaluate WITHOUT gathering [n] scores to the host:
+        metrics with a device form (evaluation/sharded.py — RMSE, MAE, the
+        losses, AUC, per-query RMSE/AUC/precision@k) reduce on the mesh and
+        only scalars cross; the rest (AUPR) fall back to one host gather.
+        The on-mesh analogue of the reference's executor-side evaluation
+        (Evaluator.scala:39-49, MultiEvaluator.scala:40-88)."""
+        from photon_ml_tpu.evaluation.evaluators import (
+            EvaluationData,
+            parse_evaluator,
+        )
+        from photon_ml_tpu.evaluation.sharded import (
+            evaluate_prepared,
+            mesh_data_placer,
+            prepare_device_evaluators,
+        )
+        from photon_ml_tpu.parallel.distributed import _host_scores
+
+        evaluators = [
+            parse_evaluator(s) if isinstance(s, str) else s
+            for s in evaluator_specs
+        ]
+        eval_data = EvaluationData(
+            labels=np.asarray(dataset.host_array("labels")),
+            offsets=np.asarray(dataset.host_array("offsets")),
+            weights=np.asarray(dataset.host_array("weights")),
+            ids=dataset.ids,
+        )
+        data, params, n_true = self.prepare(dataset)
+        if self.mesh is not None:
+            device_evals = prepare_device_evaluators(
+                evaluators, eval_data,
+                n_pad=int(data["offsets"].shape[0]),
+                place=mesh_data_placer(self.mesh),
+            )
+            with self.mesh:
+                scores = self._jit_score(data, params)
+        else:
+            # single device: the exact host evaluators, nothing to avoid
+            device_evals = [None] * len(evaluators)
+            scores = self._jit_score(data, params)
+        values = evaluate_prepared(
+            evaluators, device_evals, scores, eval_data,
+            lambda: _host_scores(scores, n_true),
+        )
+        return {ev.name: v for ev, v in zip(evaluators, values)}
