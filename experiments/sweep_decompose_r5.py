@@ -1,0 +1,218 @@
+"""Decompose the fused GAME sweep's ~45 ms (VERDICT r4 "what's weak" #1 /
+next-round task #2).
+
+The r4 single-pass kernel doubled the FE hot-loop rate but fused_game_sweep_ms
+did not move — so the FE value+grad is not the sweep's dominant term, and
+nobody measured where the 45 ms actually go. This script applies the in-run
+interleaved-differencing technique that settled the r3 bandwidth
+contradiction (BASELINE.md:128-159) to PER-COORDINATE variants of the exact
+bench workload (bench.py::bench_game_sweep — n=2^17, FE d=256, user/item REs
+d=16 with 2000/1500 entities, 10 LBFGS iters per coordinate):
+
+- fe_only_10 / fe_only_1:   FE coordinate alone at 10 vs 1 LBFGS iters
+                            -> FE per-iter solve cost (slope) and the
+                            FE-coordinate fixed cost (intercept)
+- fe_user_10:               + user RE (2000 entities) -> that coordinate's
+                            full marginal (solve + residual-offset gathers +
+                            rescoring scatter)
+- full_10:                  + item RE (1500 entities) == the bench metric
+- full_re1:                 both REs at 1 iter -> RE per-iter solve slope
+- full_fe1:                 FE at 1 iter -> FE slope inside the full sweep
+- all_1:                    everything at 1 iter -> the sweep's
+                            iteration-independent floor (rescoring, gathers,
+                            bookkeeping)
+
+All variants interleave round-robin in ONE process (median-of-3 marginals,
+5-vs-1 sweep differencing, host-read sync) with a same-run stream probe so
+fractions survive the chip lottery. Results -> sweep_decompose_r5.log,
+summarized in BASELINE.md.
+
+Run from the repo root on the TPU (no PYTHONPATH), nothing else on the host.
+"""
+
+import json
+import statistics
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.game_data import (
+        build_game_dataset,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+    from photon_ml_tpu.parallel.distributed import (
+        FixedEffectStepSpec,
+        GameTrainProgram,
+        GameTrainState,
+        RandomEffectStepSpec,
+    )
+    from photon_ml_tpu.types import TaskType
+
+    print(f"backend={jax.default_backend()} devices={jax.devices()}")
+
+    rng = np.random.default_rng(0)
+    n, d_fe, d_re = 1 << 17, 256, 16
+    n_users, n_items = 2000, 1500
+    users = np.array([f"u{i}" for i in rng.integers(0, n_users, size=n)])
+    items = np.array([f"i{i}" for i in rng.integers(0, n_items, size=n)])
+    x_fe = rng.normal(size=(n, d_fe)).astype(np.float32)
+    x_re = rng.normal(size=(n, d_re)).astype(np.float32)
+    y = (x_fe @ rng.normal(size=d_fe).astype(np.float32) / np.sqrt(d_fe)
+         + rng.normal(size=n).astype(np.float32))
+    dataset = build_game_dataset(
+        labels=y,
+        feature_shards={"global": x_fe, "per_entity": x_re},
+        entity_keys={"user": users, "item": items},
+        dtype=np.float32,
+    )
+    re_datasets = {
+        t: build_random_effect_dataset(dataset, t, "per_entity",
+                                       bucket_sizes=(128,))
+        for t in ("user", "item")
+    }
+
+    def make(fe_iters, re_iters, res):
+        fe = FixedEffectStepSpec(
+            feature_shard_id="global",
+            optimizer=OptimizerConfig(optimizer_type=OptimizerType.LBFGS,
+                                      max_iterations=fe_iters),
+            l2_weight=1.0,
+        )
+        specs = tuple(
+            RandomEffectStepSpec(
+                t, "per_entity",
+                OptimizerConfig(optimizer_type=OptimizerType.LBFGS,
+                                max_iterations=re_iters),
+                l2_weight=1.0,
+            )
+            for t in res
+        )
+        program = GameTrainProgram(TaskType.LINEAR_REGRESSION, fe, specs,
+                                   use_pallas_fe=True)
+        rds = {t: re_datasets[t] for t in res}
+        data, buckets = program.prepare_inputs(dataset, rds, None)
+        base = program.init_state(dataset, rds, None)
+        return program, data, buckets, base
+
+    variants = {
+        "fe_only_1": make(1, 10, ()),
+        "fe_only_10": make(10, 10, ()),
+        "fe_user_10": make(10, 10, ("user",)),
+        "full_10": make(10, 10, ("user", "item")),
+        "full_re1": make(10, 1, ("user", "item")),
+        "full_fe1": make(1, 10, ("user", "item")),
+        "all_1": make(1, 1, ("user", "item")),
+    }
+
+    def perturbed(base, seed):
+        key = jax.random.PRNGKey(seed)
+        keys = jax.random.split(key, 1 + max(len(base.re_tables), 1))
+        return GameTrainState(
+            fe_coefficients=base.fe_coefficients
+            + 1e-3 * jax.random.normal(keys[0], base.fe_coefficients.shape),
+            re_tables={
+                t: tab + 1e-3 * jax.random.normal(k, tab.shape)
+                for k, (t, tab) in zip(keys[1:], base.re_tables.items())
+            },
+            mf_rows=dict(base.mf_rows),
+            mf_cols=dict(base.mf_cols),
+        )
+
+    def timed(v, k, seed):
+        program, data, buckets, base = variants[v]
+        state = perturbed(base, seed)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            state, loss = program.step(data, buckets, state)
+        float(np.asarray(state.fe_coefficients)[0])  # host read: hard sync
+        return time.perf_counter() - t0
+
+    seed = [0]
+
+    def once(v):
+        s0 = seed[0]
+        seed[0] += 10
+        lo = min(timed(v, 1, s0 + s) for s in (1, 2))
+        hi = min(timed(v, 5, s0 + s) for s in (3, 4))
+        return max((hi - lo) / 4, 1e-6)
+
+    # same-run stream calibration: one [n, d_fe] X read per scan step
+    xbytes = n * d_fe * 4
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(2,))
+    def stream_run(w0, xx, k):
+        w, _ = jax.lax.scan(
+            lambda w, _: (w + jnp.sum(xx @ w) * 1e-30, 0.0), w0, None,
+            length=k,
+        )
+        return w.sum()
+
+    x_dev = jax.device_put(x_fe)
+
+    def stream_once():
+        k_lo, k_hi = 16, 256
+
+        def t(k):
+            w0 = jnp.full((d_fe,), 1e-3, jnp.float32)
+            float(stream_run(w0, x_dev, k))  # compile+sync
+            best = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(stream_run(w0, x_dev, k))
+                el = time.perf_counter() - t0
+                best = el if best is None or el < best else best
+            return best
+
+        return max((t(k_hi) - t(k_lo)) / (k_hi - k_lo), 1e-9)
+
+    # compile everything first (one pass), then interleave measurements
+    for v in variants:
+        timed(v, 1, 0)
+        print(f"compiled {v}")
+
+    reps = {v: [] for v in variants}
+    stream = []
+    for r in range(3):
+        stream.append(stream_once())
+        for v in variants:
+            reps[v].append(once(v))
+        print(f"rep {r}: stream={xbytes / stream[-1] / 1e9:.0f} GB/s " +
+              " ".join(f"{v}={reps[v][-1] * 1e3:.1f}ms" for v in variants),
+              flush=True)
+
+    med = {v: statistics.median(reps[v]) * 1e3 for v in reps}
+    sp = {v: [min(reps[v]) * 1e3, max(reps[v]) * 1e3] for v in reps}
+    stream_gbps = xbytes / statistics.median(stream) / 1e9
+
+    print("\n=== medians (ms/sweep, spread=[min,max]) ===")
+    for v in med:
+        print(f"{v:12s} {med[v]:7.1f}  {sp[v][0]:7.1f} .. {sp[v][1]:7.1f}")
+    print(f"stream calibration: {stream_gbps:.0f} GB/s")
+
+    print("\n=== decomposition ===")
+    fe_slope = (med["fe_only_10"] - med["fe_only_1"]) / 9
+    fe_slope_full = (med["full_10"] - med["full_fe1"]) / 9
+    re_slope = (med["full_10"] - med["full_re1"]) / 9
+    user_total = med["fe_user_10"] - med["fe_only_10"]
+    item_total = med["full_10"] - med["fe_user_10"]
+    print(f"FE per-LBFGS-iter (alone):      {fe_slope:6.2f} ms")
+    print(f"FE per-LBFGS-iter (in full):    {fe_slope_full:6.2f} ms")
+    print(f"both-RE per-LBFGS-iter:         {re_slope:6.2f} ms")
+    print(f"user RE coordinate total:       {user_total:6.2f} ms")
+    print(f"item RE coordinate total:       {item_total:6.2f} ms")
+    print(f"FE-only fixed (1-iter sweep):   {med['fe_only_1']:6.2f} ms")
+    print(f"full 1-iter floor (all_1):      {med['all_1']:6.2f} ms")
+    print(json.dumps({"medians_ms": med, "spread_ms": sp,
+                      "stream_gbps": round(stream_gbps, 1)}))
+
+
+if __name__ == "__main__":
+    main()

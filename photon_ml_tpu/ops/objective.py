@@ -41,6 +41,16 @@ try:
     from jax._src.interpreters.batching import BatchTracer as _BatchTracer
 except ImportError:  # pragma: no cover - jax internals moved
     _BatchTracer = None
+    # Loud, once, at import: the fail-safe below silently downgrades EVERY
+    # auto-mode solve to the 2-pass autodiff path (~0.5x the one-pass
+    # kernel). tests/test_pallas_glm.py carries the matching canary test.
+    import logging as _logging
+
+    _logging.getLogger(__name__).warning(
+        "jax private BatchTracer import broke (jax internals moved): "
+        "vmap detection disabled, the single-pass Pallas GLM kernel is OFF "
+        "for all auto-mode solves — update _under_vmap in %s", __name__,
+    )
 
 
 def _under_vmap(*arrays) -> bool:

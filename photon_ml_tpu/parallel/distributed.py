@@ -1842,10 +1842,10 @@ def train_distributed(
             extra_fe=dict(state_.extra_fe),
         )
     if mesh is not None:
-        if put_fn is None and jax.process_count() > 1:
-            from photon_ml_tpu.parallel.multihost import global_put
+        if put_fn is None:
+            from photon_ml_tpu.parallel.multihost import default_put
 
-            put_fn = global_put
+            put_fn = default_put()
         data, buckets, state = program.shard_inputs(
             mesh, data, buckets, state, fe_feature_sharded=fe_feature_sharded,
             put_fn=put_fn,

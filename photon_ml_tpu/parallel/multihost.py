@@ -140,6 +140,17 @@ def make_hybrid_mesh(
     return Mesh(grid, axis_names=("data", "model"))
 
 
+def default_put():
+    """The host->sharding placement function for the current topology:
+    :func:`global_put` when the program spans processes (plain device_put
+    cannot target shardings that include other processes' devices),
+    ``jax.device_put`` otherwise. The one selection rule shared by the
+    training path (distributed.train_distributed) and the scorer."""
+    if jax.process_count() > 1:
+        return global_put
+    return jax.device_put
+
+
 def global_put(arr, sharding):
     """Place a host array onto a (possibly multi-process) sharding.
 

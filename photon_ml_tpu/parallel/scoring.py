@@ -40,23 +40,34 @@ from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
 Array = jax.Array
 
 
-def _pad_nnz(arrays: dict, data_axis: int, pad_values: dict | None = None) -> dict:
+def _pad_nnz(arrays: dict, data_axis: int, pad_values: dict | None = None,
+             xp=jnp) -> dict:
     """Pad flat nnz-axis arrays to a mesh multiple: values pad with 0 (they
     contribute nothing), "rows" repeats its last id (keeps the row
-    segment-sum's sorted promise), and ``pad_values`` overrides per key."""
+    segment-sum's sorted promise), and ``pad_values`` overrides per key.
+    ``xp`` (numpy on mesh paths) keeps the padding on the host so placement
+    never round-trips through the local device."""
     nnz = int(arrays["vals"].shape[0])
     pad = (-nnz) % data_axis
     if not pad:
         return arrays
-    last_row = arrays["rows"][-1:] if nnz else jnp.zeros(1, jnp.int32)
+    last_row = arrays["rows"][-1:] if nnz else xp.zeros(1, np.int32)
     out = {}
     for k, v in arrays.items():
         if k == "rows":
-            out[k] = jnp.concatenate([v, jnp.broadcast_to(last_row, (pad,))])
+            out[k] = xp.concatenate([v, xp.broadcast_to(last_row, (pad,))])
         else:
-            out[k] = jnp.pad(v, (0, pad),
-                             constant_values=(pad_values or {}).get(k, 0))
+            out[k] = xp.pad(v, (0, pad),
+                            constant_values=(pad_values or {}).get(k, 0))
     return out
+
+
+def _assembly_xp():
+    """Array namespace for host-side data assembly before placement:
+    numpy when the program spans processes (global_put slices host arrays
+    zero-copy; a jnp intermediate would cost a D2H per array), jnp
+    otherwise (device-resident inputs reshard on-device)."""
+    return np if jax.process_count() > 1 else jnp
 
 
 def _model_kinds(model: GameModel) -> dict[str, str]:
@@ -115,21 +126,29 @@ class DistributedScorer:
 
     def prepare(self, dataset: GameDataset):
         """(data pytree, params pytree, n_true). With a mesh, the sample
-        axis is padded to a mesh multiple and everything is device_put with
-        the program's shardings; params hold the model's device tables."""
+        axis is padded to a mesh multiple and everything is placed with
+        the program's shardings; params hold the model's device tables.
+
+        On a MULTI-PROCESS mesh every array is assembled with HOST numpy
+        (``xp = np``) and only then placed: committing to the local device
+        first would cost a device round-trip per array under global_put
+        (its docstring warns about exactly this). Single-process — mesh or
+        not — keeps jnp assembly: device-resident inputs (e.g. a live
+        model's tables) reshard on-device without a D2H."""
         n_true = dataset.num_samples
+        xp = _assembly_xp()
         if self.mesh is not None:
             dataset, n_true = pad_game_dataset(
                 dataset, int(self.mesh.shape["data"])
             )
-        data: dict = {"offsets": jnp.asarray(dataset.offsets), "coords": {}}
+        data: dict = {"offsets": xp.asarray(dataset.offsets), "coords": {}}
         params: dict = {}
         for cid, m in self.model.models.items():
             kind = self._kinds[cid]
             c: dict = {}
             if kind == "fe":
                 feats = dataset.feature_shards[m.feature_shard_id]
-                w = jnp.asarray(m.glm.coefficients.means)
+                w = xp.asarray(m.glm.coefficients.means)
                 if cid == self.fe_sharded_cid:
                     # the sharded feature/coefficient axis must divide the
                     # mesh "model" axis: right-pad with zero columns /
@@ -138,10 +157,10 @@ class DistributedScorer:
                     model_axis = int(self.mesh.shape["model"])
                     pad = (-int(w.shape[0])) % model_axis
                     if pad:
-                        w = jnp.pad(w, (0, pad))
+                        w = xp.pad(w, (0, pad))
                         if not isinstance(feats, SparseShard):
-                            feats = jnp.pad(
-                                jnp.asarray(feats), ((0, 0), (0, pad))
+                            feats = xp.pad(
+                                xp.asarray(feats), ((0, 0), (0, pad))
                             )
                 if isinstance(feats, SparseShard):
                     rows, cols, vals = feats.coalesced()
@@ -153,17 +172,17 @@ class DistributedScorer:
                         else np.int64
                     )
                     c["sparse"] = {
-                        "rows": jnp.asarray(np.asarray(rows, np.int32)),
-                        "cols": jnp.asarray(np.asarray(cols, col_dt)),
-                        "vals": jnp.asarray(vals),
+                        "rows": xp.asarray(np.asarray(rows, np.int32)),
+                        "cols": xp.asarray(np.asarray(cols, col_dt)),
+                        "vals": xp.asarray(vals),
                     }
                 else:
-                    c["x"] = jnp.asarray(feats)
+                    c["x"] = xp.asarray(feats)
                 params[cid] = {"w": w}
             elif kind == "re":
-                c["x"] = jnp.asarray(dataset.feature_shards[m.feature_shard_id])
-                c["idx"] = jnp.asarray(dataset.entity_idx[m.random_effect_type])
-                params[cid] = {"table": jnp.asarray(m.coefficients)}
+                c["x"] = xp.asarray(dataset.feature_shards[m.feature_shard_id])
+                c["idx"] = xp.asarray(dataset.entity_idx[m.random_effect_type])
+                params[cid] = {"table": xp.asarray(m.coefficients)}
             elif kind == "re_compact":
                 feats = dataset.feature_shards[m.feature_shard_id]
                 idx = np.asarray(
@@ -174,25 +193,25 @@ class DistributedScorer:
                         feats, idx, np.asarray(m.active_cols)
                     )
                     c["entries"] = {
-                        "ent": jnp.asarray(ent), "pos": jnp.asarray(pos),
-                        "rows": jnp.asarray(rows), "vals": jnp.asarray(vals),
+                        "ent": xp.asarray(ent), "pos": xp.asarray(pos),
+                        "rows": xp.asarray(rows), "vals": xp.asarray(vals),
                     }
-                    params[cid] = {"table": jnp.asarray(m.coefficients)}
+                    params[cid] = {"table": xp.asarray(m.coefficients)}
                 else:
-                    c["x"] = jnp.asarray(feats)
-                    c["idx"] = jnp.asarray(idx)
+                    c["x"] = xp.asarray(feats)
+                    c["idx"] = xp.asarray(idx)
                     params[cid] = {
-                        "table": jnp.asarray(m.coefficients),
-                        "active_cols": jnp.asarray(
+                        "table": xp.asarray(m.coefficients),
+                        "active_cols": xp.asarray(
                             np.asarray(m.active_cols, np.int32)
                         ),
                     }
             else:  # mf
-                c["row_idx"] = jnp.asarray(dataset.entity_idx[m.row_effect_type])
-                c["col_idx"] = jnp.asarray(dataset.entity_idx[m.col_effect_type])
+                c["row_idx"] = xp.asarray(dataset.entity_idx[m.row_effect_type])
+                c["col_idx"] = xp.asarray(dataset.entity_idx[m.col_effect_type])
                 params[cid] = {
-                    "rows": jnp.asarray(m.row_factors),
-                    "cols": jnp.asarray(m.col_factors),
+                    "rows": xp.asarray(m.row_factors),
+                    "cols": xp.asarray(m.col_factors),
                 }
             data["coords"][cid] = c
         if self.mesh is not None:
@@ -200,8 +219,10 @@ class DistributedScorer:
         return data, params, n_true
 
     def _place(self, data, params):
+        from photon_ml_tpu.parallel.multihost import default_put
+
         mesh = self.mesh
-        put = jax.device_put
+        put = default_put()
         vec = NamedSharding(mesh, P("data"))
         rep = NamedSharding(mesh, P())
         row2 = NamedSharding(mesh, P("data", None))
@@ -227,7 +248,9 @@ class DistributedScorer:
             if "sparse" in c:
                 out["sparse"] = {
                     k: put(v, vec)
-                    for k, v in _pad_nnz(c["sparse"], data_axis).items()
+                    for k, v in _pad_nnz(
+                        c["sparse"], data_axis, xp=_assembly_xp()
+                    ).items()
                 }
             if "entries" in c:
                 # pos pads point at the scratch slot; ent 0 is harmless
@@ -236,7 +259,8 @@ class DistributedScorer:
                 out["entries"] = {
                     k: put(v, vec)
                     for k, v in _pad_nnz(
-                        c["entries"], data_axis, pad_values={"pos": k_scratch}
+                        c["entries"], data_axis, pad_values={"pos": k_scratch},
+                        xp=_assembly_xp(),
                     ).items()
                 }
             coords[cid] = out
@@ -259,7 +283,7 @@ class DistributedScorer:
                     # entity ids stay < E)
                     pad = (-int(v.shape[0])) % data_axis
                     if pad:
-                        v = jnp.pad(v, ((0, pad), (0, 0)))
+                        v = _assembly_xp().pad(v, ((0, pad), (0, 0)))
                     out[k] = put(v, ent2)
                 else:
                     out[k] = put(v, rep)
@@ -385,27 +409,21 @@ class DistributedScorer:
 
     # -- public entry --------------------------------------------------------
 
-    def score_dataset(self, dataset: GameDataset) -> np.ndarray:
-        """[n] host scores INCLUDING offsets (GameTransformer semantics) —
-        gathered across processes, mesh padding rows dropped."""
-        from photon_ml_tpu.parallel.distributed import _host_scores
-
-        data, params, n_true = self.prepare(dataset)
+    def _score_prepared(self, data, params) -> Array:
         if self.mesh is not None:
             with self.mesh:
-                scores = self._jit_score(data, params)
-        else:
-            scores = self._jit_score(data, params)
-        return _host_scores(scores, n_true)
+                return self._jit_score(data, params)
+        return self._jit_score(data, params)
 
-    def evaluate_dataset(
-        self, dataset: GameDataset, evaluator_specs
+    def _evaluate_scores(
+        self, scores: Array, dataset: GameDataset, evaluator_specs,
+        n_pad: int, host_scores_fn,
     ) -> dict[str, float]:
-        """Score + evaluate WITHOUT gathering [n] scores to the host:
-        metrics with a device form (evaluation/sharded.py — RMSE, MAE, the
-        losses, AUC, per-query RMSE/AUC/precision@k) reduce on the mesh and
-        only scalars cross; the rest (AUPR) fall back to one host gather.
-        The on-mesh analogue of the reference's executor-side evaluation
+        """Evaluate still-sharded scores: metrics with a device form
+        (evaluation/sharded.py — RMSE, MAE, the losses, AUC, per-query
+        RMSE/AUC/precision@k) reduce on the mesh and only scalars cross;
+        the rest (AUPR) fall back to ``host_scores_fn``. The on-mesh
+        analogue of the reference's executor-side evaluation
         (Evaluator.scala:39-49, MultiEvaluator.scala:40-88)."""
         from photon_ml_tpu.evaluation.evaluators import (
             EvaluationData,
@@ -416,7 +434,7 @@ class DistributedScorer:
             mesh_data_placer,
             prepare_device_evaluators,
         )
-        from photon_ml_tpu.parallel.distributed import _host_scores
+        from photon_ml_tpu.parallel.multihost import default_put
 
         evaluators = [
             parse_evaluator(s) if isinstance(s, str) else s
@@ -428,21 +446,61 @@ class DistributedScorer:
             weights=np.asarray(dataset.host_array("weights")),
             ids=dataset.ids,
         )
-        data, params, n_true = self.prepare(dataset)
         if self.mesh is not None:
             device_evals = prepare_device_evaluators(
-                evaluators, eval_data,
-                n_pad=int(data["offsets"].shape[0]),
-                place=mesh_data_placer(self.mesh),
+                evaluators, eval_data, n_pad=n_pad,
+                place=mesh_data_placer(self.mesh, put_fn=default_put()),
             )
-            with self.mesh:
-                scores = self._jit_score(data, params)
         else:
             # single device: the exact host evaluators, nothing to avoid
             device_evals = [None] * len(evaluators)
-            scores = self._jit_score(data, params)
         values = evaluate_prepared(
-            evaluators, device_evals, scores, eval_data,
-            lambda: _host_scores(scores, n_true),
+            evaluators, device_evals, scores, eval_data, host_scores_fn
         )
         return {ev.name: v for ev, v in zip(evaluators, values)}
+
+    def score_dataset(self, dataset: GameDataset) -> np.ndarray:
+        """[n] host scores INCLUDING offsets (GameTransformer semantics) —
+        gathered across processes, mesh padding rows dropped."""
+        from photon_ml_tpu.parallel.distributed import _host_scores
+
+        data, params, n_true = self.prepare(dataset)
+        return _host_scores(self._score_prepared(data, params), n_true)
+
+    def evaluate_dataset(
+        self, dataset: GameDataset, evaluator_specs
+    ) -> dict[str, float]:
+        """Score + evaluate WITHOUT gathering [n] scores to the host
+        (validation-style runs that never write scores)."""
+        from photon_ml_tpu.parallel.distributed import _host_scores
+
+        data, params, n_true = self.prepare(dataset)
+        scores = self._score_prepared(data, params)
+        return self._evaluate_scores(
+            scores, dataset, evaluator_specs,
+            n_pad=int(data["offsets"].shape[0]),
+            host_scores_fn=lambda: _host_scores(scores, n_true),
+        )
+
+    def score_and_evaluate(
+        self, dataset: GameDataset, evaluator_specs=()
+    ) -> tuple[np.ndarray, dict[str, float]]:
+        """(host scores, metrics) from ONE data-preparation/scoring pass —
+        what GameTransformer.transform consumes when scores must be
+        written anyway. Device-form metrics still reduce on-mesh (bitwise
+        the trainer's validation math); the single host gather is shared
+        with the returned score vector."""
+        from photon_ml_tpu.parallel.distributed import _host_scores
+
+        data, params, n_true = self.prepare(dataset)
+        scores = self._score_prepared(data, params)
+        host = _host_scores(scores, n_true)
+        evaluations = (
+            self._evaluate_scores(
+                scores, dataset, evaluator_specs,
+                n_pad=int(data["offsets"].shape[0]),
+                host_scores_fn=lambda: host,
+            )
+            if evaluator_specs else {}
+        )
+        return host, evaluations

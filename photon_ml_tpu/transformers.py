@@ -44,6 +44,7 @@ class GameTransformer:
     fe_feature_sharded: "bool | str" = False
 
     def transform(self, dataset: GameDataset) -> ScoredDataset:
+        evaluations: dict[str, float] = {}
         if self.mesh is not None or self.fe_feature_sharded:
             from photon_ml_tpu.parallel.scoring import DistributedScorer
 
@@ -51,13 +52,18 @@ class GameTransformer:
                 self.model, self.mesh,
                 fe_feature_sharded=self.fe_feature_sharded,
             )
-            scores = scorer.score_dataset(dataset)  # includes offsets
+            # one prepare/score pass: scores (incl. offsets) gather for the
+            # caller, while device-form metrics reduce ON the mesh — the
+            # executor-side evaluation of the reference's scoring path
+            # (GameScoringDriver.scala:260-281, Evaluator.scala:39-49)
+            scores, evaluations = scorer.score_and_evaluate(
+                dataset, self.evaluator_specs
+            )
         else:
             scores = np.asarray(self.model.score_dataset(dataset)) + np.asarray(
                 dataset.offsets
             )
-        evaluations: dict[str, float] = {}
-        if self.evaluator_specs:
+        if self.evaluator_specs and not evaluations:
             data = EvaluationData(
                 labels=np.asarray(dataset.host_array("labels")),
                 offsets=np.asarray(dataset.host_array("offsets")),

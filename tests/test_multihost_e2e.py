@@ -327,3 +327,193 @@ def test_two_process_driver_end_to_end(tmp_path):
     # rank 0 owns the real output; the worker wrote to its scratch subdir
     assert (tmp_path / "out" / "best" / "model-metadata.json").exists()
     assert (tmp_path / "out" / ".worker-1").is_dir()
+
+
+SCORE_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    from photon_ml_tpu.parallel import multihost
+
+    pid, port, data_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    multihost.initialize(
+        coordinator_address=f"127.0.0.1:{{port}}", num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2 and len(jax.devices()) == 8
+    import json
+    from photon_ml_tpu.cli.game_scoring_driver import main
+
+    summary = main([
+        "--input-data-path", data_dir + "/val",
+        "--model-input-dir", data_dir + "/out/best",
+        "--output-dir", data_dir + f"/score-rank{{jax.process_index()}}",
+        "--index-maps-dir", data_dir + "/out/index-maps",
+        "--feature-shard-configurations",
+        "name=global,feature.bags=features,intercept=true",
+        "--feature-shard-configurations",
+        "name=perUser,feature.bags=entityFeatures,intercept=false",
+        "--evaluators", "RMSE",
+        "--mesh", "data=4,model=2",
+    ])
+    print("SCORE " + json.dumps({{
+        "rmse": summary["evaluations"]["RMSE"],
+        "n": summary["num_scored"],
+        "rank": jax.process_index(),
+    }}), flush=True)
+    """
+)
+
+
+def test_two_process_scoring_driver_end_to_end(tmp_path):
+    """VERDICT r4 next #5: `game_scoring_driver --mesh` across two REAL OS
+    processes (the multi-host analogue of GameScoringDriver.scala:260-281).
+    Every rank runs the SPMD scoring collectives (4x2 data×model mesh over
+    the process boundary, ring-rotation dense-RE path included); ONLY rank 0
+    writes scores, and they match the single-process scoring driver."""
+    import json
+
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+
+    # same data shape as the training e2e; train the model the workers will
+    # score — single-process, in this test process
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io import photon_schemas as schemas
+
+    schema = {
+        "name": "MhScoringExampleAvro", "type": "record",
+        "fields": [
+            {"name": "uid", "type": ["string", "null"]},
+            {"name": "label", "type": "double"},
+            {"name": "features",
+             "type": {"type": "array", "items": schemas.FEATURE_AVRO}},
+            {"name": "entityFeatures",
+             "type": {"type": "array", "items": "FeatureAvro"}},
+            {"name": "weight", "type": ["double", "null"], "default": None},
+            {"name": "offset", "type": ["double", "null"], "default": None},
+            {"name": "metadataMap",
+             "type": [{"type": "map", "values": "string"}, "null"],
+             "default": None},
+        ],
+    }
+
+    def records(n, seed):
+        rng = np.random.default_rng(seed)
+        out = []
+        for i in range(n):
+            xg = rng.normal(size=4)
+            xu = rng.normal(size=2)
+            out.append({
+                "uid": str(i), "label": float(xg.sum() + 0.1 * rng.normal()),
+                "features": [{"name": f"g{j}", "term": "", "value": float(xg[j])}
+                             for j in range(4)],
+                "entityFeatures": [{"name": f"u{j}", "term": "", "value": float(xu[j])}
+                                   for j in range(2)],
+                "weight": 1.0, "offset": 0.0,
+                "metadataMap": {"userId": f"user{int(rng.integers(0, 6))}"},
+            })
+        return out
+
+    for split, n, seed in (("train", 160, 1), ("val", 60, 2)):
+        os.makedirs(tmp_path / split, exist_ok=True)
+        avro_io.write_container(
+            str(tmp_path / split / "part-00000.avro"), schema, records(n, seed)
+        )
+
+    shard_args = [
+        "--feature-shard-configurations",
+        "name=global,feature.bags=features,intercept=true",
+        "--feature-shard-configurations",
+        "name=perUser,feature.bags=entityFeatures,intercept=false",
+    ]
+    from photon_ml_tpu.cli.game_training_driver import parse_args, run
+
+    run(parse_args([
+        "--input-data-path", str(tmp_path / "train"),
+        "--validation-data-path", str(tmp_path / "val"),
+        "--root-output-dir", str(tmp_path / "out"),
+        "--task-type", "LINEAR_REGRESSION",
+        *shard_args,
+        "--coordinate-configurations",
+        "name=fe,feature.shard=global,reg.weights=1,max.iter=5",
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=perUser,random.effect.type=userId,"
+        "reg.weights=1,max.iter=5",
+        "--coordinate-descent-iterations", "2",
+        "--evaluators", "RMSE",
+        "--override-output",
+    ]))
+
+    # single-process scoring reference
+    from photon_ml_tpu.cli import game_scoring_driver
+
+    ref = game_scoring_driver.main([
+        "--input-data-path", str(tmp_path / "val"),
+        "--model-input-dir", str(tmp_path / "out" / "best"),
+        "--output-dir", str(tmp_path / "score-ref"),
+        "--index-maps-dir", str(tmp_path / "out" / "index-maps"),
+        *shard_args,
+        "--evaluators", "RMSE",
+    ])
+
+    script = tmp_path / "score_worker.py"
+    script.write_text(SCORE_WORKER.format(repo=repo))
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append((p.returncode, out))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        _skip_or_fail("distributed coordinator rendezvous timed out in this env")
+
+    results = []
+    for rc, out in outs:
+        if rc != 0 and "initialize" in out:
+            _skip_or_fail(f"jax.distributed unavailable in this env: {out[-300:]}")
+        assert rc == 0, out
+        line = [l for l in out.splitlines() if l.startswith("SCORE ")]
+        assert line, out
+        results.append(json.loads(line[0][len("SCORE "):]))
+
+    # every rank computed the identical (replicated, on-mesh-collective)
+    # evaluation, matching the single-process driver
+    assert results[0]["rmse"] == pytest.approx(results[1]["rmse"], rel=1e-9)
+    assert results[0]["rmse"] == pytest.approx(ref["evaluations"]["RMSE"], rel=1e-6)
+    assert results[0]["n"] == results[1]["n"] == ref["num_scored"] == 60
+
+    # only rank 0 touched its output directory
+    rank0, rank1 = tmp_path / "score-rank0", tmp_path / "score-rank1"
+    assert (rank0 / "scoring-summary.json").exists()
+    assert sorted(os.listdir(rank1)) == []
+
+    # and the written scores are the single-process driver's, row for row
+    def read_scores(d):
+        recs = []
+        for part in sorted(os.listdir(d / "scores")):
+            recs += list(avro_io.read_container(d / "scores" / part))
+        return {r["uid"]: r["predictionScore"] for r in recs}
+
+    got, want = read_scores(rank0), read_scores(tmp_path / "score-ref")
+    assert got.keys() == want.keys()
+    np.testing.assert_allclose(
+        [got[k] for k in sorted(got)], [want[k] for k in sorted(want)],
+        rtol=1e-6, atol=1e-6,
+    )

@@ -203,3 +203,28 @@ def test_auto_mode_falls_back_under_vmap(monkeypatch):
     # un-vmapped on (pretend) TPU: the kernel engages
     v, g = objective.value_and_gradient(ws[0], batch)
     assert calls["pallas"] == 1
+
+
+def test_vmap_detection_canary():
+    """VERDICT r4 weak #6 canary: _under_vmap leans on the private
+    jax._src BatchTracer. Its fail-safe ("can't tell" -> treat as vmapped)
+    is the right failure mode, but it silently turns the one-pass kernel
+    OFF for every auto-mode solve. This test goes red the day a jax
+    upgrade moves the internal, so the degradation is a broken build, not
+    a quiet 2x perf loss."""
+    import photon_ml_tpu.ops.objective as objective_mod
+
+    assert objective_mod._BatchTracer is not None, (
+        "jax._src.interpreters.batching.BatchTracer import broke — "
+        "update _under_vmap in ops/objective.py for this jax version"
+    )
+    # and the detection itself still discriminates
+    batch = _batch(16, 4)
+    w = jnp.zeros(4)
+    assert not objective_mod._under_vmap(w, batch.features)
+    seen = []
+    jax.vmap(
+        lambda w_: seen.append(objective_mod._under_vmap(w_, batch.features))
+        or jnp.sum(w_)
+    )(jnp.zeros((2, 4)))
+    assert seen == [True]
