@@ -273,10 +273,19 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
     return out
 
 
-def bench_game_sweep() -> dict:
+def bench_game_sweep() -> list[dict]:
     """The flagship workload (SURVEY §3.1): one fused GAME CD sweep — FE +
     2 RE coordinates + rescoring — as marginal ms/sweep (sweep-count
-    differencing cancels dispatch + input-layout fixed costs)."""
+    differencing cancels dispatch + input-layout fixed costs).
+
+    Two rows: the historical metric (10 LBFGS iters/coordinate, unchanged
+    definition since r1) and the same sweep with the RE coordinates on the
+    r5 batched-Newton solver (optim/newton.py). The r5 decomposition
+    (experiments/sweep_decompose_r5.log) attributed ~87% of the sweep to
+    the two vmapped RE LBFGS solves (~2 ms per coordinate-iteration,
+    op-count-bound at ~40x the bucket's streaming cost); Newton does the
+    same per-entity convergence in ~4 fused ops per iteration and
+    converges small-d GLMs quadratically."""
     import jax
 
     from photon_ml_tpu.data.game_data import (
@@ -313,68 +322,95 @@ def bench_game_sweep() -> dict:
         for t in ("user", "item")
     }
     opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=10)
-    program = GameTrainProgram(
-        TaskType.LINEAR_REGRESSION,
-        FixedEffectStepSpec(feature_shard_id="global", optimizer=opt, l2_weight=1.0),
-        (
-            RandomEffectStepSpec("user", "per_entity", opt, l2_weight=1.0),
-            RandomEffectStepSpec("item", "per_entity", opt, l2_weight=1.0),
-        ),
-        use_pallas_fe=True,  # single chip: the FE solve takes the kernel
-    )
+    newton = OptimizerConfig(optimizer_type=OptimizerType.NEWTON,
+                             max_iterations=10)
 
-    data, buckets = program.prepare_inputs(dataset, re_datasets, None)
-    base_state = program.init_state(dataset, re_datasets, None)
-
-    def perturbed(seed):
-        # fresh warm start per rep: identical repeat executions can be
-        # served from a backend cache (see module docstring)
-        key = jax.random.PRNGKey(seed)
-        keys = jax.random.split(key, 1 + len(base_state.re_tables))
-        return GameTrainState(
-            fe_coefficients=base_state.fe_coefficients
-            + 1e-3 * jax.random.normal(keys[0], base_state.fe_coefficients.shape),
-            re_tables={
-                t: tab + 1e-3 * jax.random.normal(k, tab.shape)
-                for k, (t, tab) in zip(keys[1:], base_state.re_tables.items())
-            },
-            mf_rows=dict(base_state.mf_rows),
-            mf_cols=dict(base_state.mf_cols),
+    def make_program(re_opt):
+        return GameTrainProgram(
+            TaskType.LINEAR_REGRESSION,
+            FixedEffectStepSpec(feature_shard_id="global", optimizer=opt,
+                                l2_weight=1.0),
+            (
+                RandomEffectStepSpec("user", "per_entity", re_opt, l2_weight=1.0),
+                RandomEffectStepSpec("item", "per_entity", re_opt, l2_weight=1.0),
+            ),
+            use_pallas_fe=True,  # single chip: the FE solve takes the kernel
         )
 
-    def timed(k, seed):
-        # k dispatches enqueue asynchronously (no host read between sweeps),
-        # so per-call dispatch overlaps device execution and the K-step
-        # differencing isolates true per-sweep device time
-        state = perturbed(seed)
-        t0 = time.perf_counter()
-        for _ in range(k):
-            state, loss = program.step(data, buckets, state)
-        float(np.asarray(state.fe_coefficients)[0])  # host read: hard sync
-        return time.perf_counter() - t0
+    def measure(program):
+        data, buckets = program.prepare_inputs(dataset, re_datasets, None)
+        base_state = program.init_state(dataset, re_datasets, None)
 
-    timed(1, 0)  # compile + sync
-    seed = [0]
+        def perturbed(seed):
+            # fresh warm start per rep: identical repeat executions can be
+            # served from a backend cache (see module docstring)
+            key = jax.random.PRNGKey(seed)
+            keys = jax.random.split(key, 1 + len(base_state.re_tables))
+            return GameTrainState(
+                fe_coefficients=base_state.fe_coefficients
+                + 1e-3 * jax.random.normal(keys[0], base_state.fe_coefficients.shape),
+                re_tables={
+                    t: tab + 1e-3 * jax.random.normal(k, tab.shape)
+                    for k, (t, tab) in zip(keys[1:], base_state.re_tables.items())
+                },
+                mf_rows=dict(base_state.mf_rows),
+                mf_cols=dict(base_state.mf_cols),
+            )
 
-    def once():
-        s0 = seed[0]
-        seed[0] += 10
-        lo = min(timed(1, s0 + s) for s in (1, 2))
-        hi = min(timed(5, s0 + s) for s in (3, 4))
-        return max((hi - lo) / 4, 1e-6)
+        def timed(k, seed):
+            # k dispatches enqueue asynchronously (no host read between
+            # sweeps), so per-call dispatch overlaps device execution and
+            # the K-step differencing isolates true per-sweep device time
+            state = perturbed(seed)
+            t0 = time.perf_counter()
+            for _ in range(k):
+                state, loss = program.step(data, buckets, state)
+            float(np.asarray(state.fe_coefficients)[0])  # host read: hard sync
+            return time.perf_counter() - t0
 
-    per_sweep, sp = median_spread(once)
-    return {
-        "metric": "fused_game_sweep_ms",
-        "value": round(per_sweep * 1e3, 1),
-        "spread": [round(s * 1e3, 1) for s in sp],
-        "unit": (
-            f"marginal ms per fused GAME CD sweep (FE d={d_fe} + "
-            f"{n_users}+{n_items}-entity REs d={d_re} + rescoring, "
-            f"n={n}, 10 LBFGS iters/coordinate; sweep-count differencing; "
-            f"median-of-{GATE_REPS}, spread=[min,max])"
-        ),
-    }
+        timed(1, 0)  # compile + sync
+        seed = [0]
+
+        def once():
+            s0 = seed[0]
+            seed[0] += 10
+            lo = min(timed(1, s0 + s) for s in (1, 2))
+            hi = min(timed(5, s0 + s) for s in (3, 4))
+            return max((hi - lo) / 4, 1e-6)
+
+        return median_spread(once)
+
+    per_sweep, sp = measure(make_program(opt))
+    newton_sweep, newton_sp = measure(make_program(newton))
+    shape = (
+        f"FE d={d_fe} + {n_users}+{n_items}-entity REs d={d_re} + "
+        f"rescoring, n={n}"
+    )
+    return [
+        {
+            "metric": "fused_game_sweep_ms",
+            "value": round(per_sweep * 1e3, 1),
+            "spread": [round(s * 1e3, 1) for s in sp],
+            "unit": (
+                f"marginal ms per fused GAME CD sweep ({shape}, 10 LBFGS "
+                f"iters/coordinate; sweep-count differencing; "
+                f"median-of-{GATE_REPS}, spread=[min,max])"
+            ),
+        },
+        {
+            "metric": "fused_game_sweep_newton_ms",
+            "value": round(newton_sweep * 1e3, 1),
+            "spread": [round(s * 1e3, 1) for s in newton_sp],
+            "unit": (
+                f"same sweep with the RE coordinates on the r5 "
+                f"batched-Newton solver (optim/newton.py; <=10 iters to "
+                f"the 1e-7 gradient tolerance — exact in one step for "
+                f"this squared loss; FE unchanged: 10 LBFGS iters + "
+                f"kernel; {shape}; median-of-{GATE_REPS}, "
+                f"spread=[min,max])"
+            ),
+        },
+    ]
 
 
 def bench_sparse_fe() -> dict:
@@ -567,7 +603,7 @@ def main():
 
     tpu_time, tpu_spread, lane_iters = bench_tpu(x, y)
     extra = bench_hot_loop_bandwidth(x[: 1 << 17], y[: 1 << 17])
-    extra.append(bench_game_sweep())
+    extra.extend(bench_game_sweep())
     extra.append(bench_sparse_fe())
     extra.append(bench_sparse_fe_1e8())
     cpu_rate = bench_cpu_scipy(x[:CPU_SUBSAMPLE], y[:CPU_SUBSAMPLE])

@@ -1,0 +1,154 @@
+"""r5: where does a batched-Newton RE iteration spend its time on TPU?
+
+Pieces, each K-differenced inside one jit (lax.scan, carry-dependent so
+nothing hoists): batched 16x16 Cholesky+solve, LU solve, hand-rolled
+Gauss elimination, the Hessian einsum, one bucket value pass, and
+minimize_newton at fixed iteration counts. Decides whether the 81 ms
+newton sweep (newton_sweep_probe_r5.log) is solver-algebra-bound or
+no-early-exit-bound.
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    print(f"backend={jax.default_backend()}")
+    rng = np.random.default_rng(0)
+    e, cap, d = 2000, 128, 16
+    x = rng.normal(size=(e, cap, d)).astype(np.float32)
+    yv = rng.normal(size=(e, cap)).astype(np.float32)
+    h0 = np.einsum("ncd,nce->nde", x, x).astype(np.float32)
+    h0 += np.eye(d, dtype=np.float32)[None] * cap  # well-conditioned PD
+    g0 = rng.normal(size=(e, d)).astype(np.float32)
+
+    def timed(fn, *args, k_lo=8, k_hi=64):
+        @partial(jax.jit, static_argnums=(0,))
+        def run(k, *a):
+            def step(carry, _):
+                out = fn(carry, *a)
+                return out, 0.0
+            c, _ = jax.lax.scan(step, jnp.zeros((e, d), jnp.float32), None,
+                                length=k)
+            return c.sum()
+
+        float(run(k_lo, *args)); float(run(k_hi, *args))  # compile
+        best = {}
+        for k in (k_lo, k_hi):
+            vals = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(run(k, *args))
+                vals.append(time.perf_counter() - t0)
+            best[k] = min(vals)
+        return max((best[k_hi] - best[k_lo]) / (k_hi - k_lo), 1e-9)
+
+    h_d, g_d, x_d, y_d = map(jnp.asarray, (h0, g0, x, yv))
+
+    # 1. batched cholesky + cho_solve (carry-coupled so it can't hoist)
+    def chol_solve(carry, h, g):
+        gg = g + carry * 1e-30
+        l_ = jnp.linalg.cholesky(h)
+        return jax.scipy.linalg.cho_solve((l_, True), gg)
+
+    # 2. batched LU solve
+    def lu_solve(carry, h, g):
+        return jnp.linalg.solve(h, (g + carry * 1e-30)[..., None])[..., 0]
+
+    # 3. hand-rolled Gauss-Jordan elimination (vectorized over e, fori over d)
+    def gauss(carry, h, g):
+        gg = g + carry * 1e-30
+        a = jnp.concatenate([h, gg[:, :, None]], axis=2)  # [e, d, d+1]
+
+        def elim(i, a):
+            piv = a[:, i, :] / a[:, i, i][:, None]  # [e, d+1]
+            factors = a[:, :, i]  # [e, d]
+            a = a - factors[:, :, None] * piv[:, None, :]
+            a = a.at[:, i, :].set(piv)
+            return a
+
+        a = jax.lax.fori_loop(0, d, elim, a)
+        return a[:, :, d]
+
+    # 4. hessian einsum
+    def hess(carry, x_, y_):
+        w = carry * 1e-30
+        m = jnp.einsum("ecd,ed->ec", x_, w + 1.0)
+        dz = m - y_
+        return jnp.einsum("ec,ecd->ed", dz, x_)  # grad-ish pass
+
+    def hess_full(carry, x_):
+        h = jnp.einsum("ncd,nce->nde", x_ + carry[:, None, :] * 1e-30, x_)
+        return h[:, :, 0]
+
+    for name, fn, args in (
+        ("cholesky+cho_solve [e,16,16]", chol_solve, (h_d, g_d)),
+        ("lu jnp.linalg.solve", lu_solve, (h_d, g_d)),
+        ("hand gauss-jordan", gauss, (h_d, g_d)),
+        ("value/grad bucket pass", hess, (x_d, y_d)),
+        ("hessian einsum", hess_full, (x_d,)),
+    ):
+        t = timed(fn, *args)
+        print(f"{name:32s} {t * 1e3:8.3f} ms/call")
+
+    # 6. minimize_newton at pinned iteration counts on a real bucket solve
+    from photon_ml_tpu.data.batch import LabeledPointBatch
+    from photon_ml_tpu.ops.losses import SquaredLoss
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.optim.newton import minimize_newton
+    from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+
+    obj = GLMObjective(SquaredLoss(), l2_weight=1.0)
+    w8 = jnp.asarray(rng.uniform(0.5, 1.0, size=(e, cap)).astype(np.float32))
+    off = jnp.zeros((e, cap), jnp.float32)
+
+    def newton_k(iters):
+        def solve_one(f, l, o, wt, w0, tol):
+            b = LabeledPointBatch(features=f, labels=l, offsets=o, weights=wt)
+            bound = obj.bind(b)
+            return minimize_newton(bound.value_and_grad, bound.hessian_matrix,
+                                   w0, value_fn=bound.value, max_iter=iters,
+                                   tolerance=tol).coefficients
+
+        def fn(carry, x_, y_, o_, w_):
+            w0 = carry * 1e-3
+            return jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, None))(
+                x_, y_, o_, w_, w0, 0.0)
+
+        return fn
+
+    def lbfgs_k(iters):
+        def solve_one(f, l, o, wt, w0):
+            b = LabeledPointBatch(features=f, labels=l, offsets=o, weights=wt)
+            bound = obj.bind(b)
+            return minimize_lbfgs(bound.value_and_grad, w0, max_iter=iters,
+                                  tolerance=0.0).coefficients
+
+        def fn(carry, x_, y_, o_, w_):
+            w0 = carry * 1e-3
+            return jax.vmap(solve_one)(x_, y_, o_, w_, w0)
+
+        return fn
+
+    for name, fn in (
+        ("newton 1 iter", newton_k(1)),
+        ("newton 2 iters", newton_k(2)),
+        ("newton 10 iters", newton_k(10)),
+        ("lbfgs 1 iter", lbfgs_k(1)),
+        ("lbfgs 10 iters", lbfgs_k(10)),
+    ):
+        t = timed(fn, x_d, y_d, off, w8, k_lo=4, k_hi=16)
+        print(f"bucket solve {name:20s} {t * 1e3:8.3f} ms/call")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""r5 probe: fused sweep with Newton RE solves vs the LBFGS-10 baseline.
+
+Same workload and interleaved marginal methodology as sweep_decompose_r5.py;
+answers "did the batched-Newton solver (optim/newton.py) collapse the RE
+coordinates' ~43 ms?" before the full bench run. Also cross-checks the two
+programs' converged states agree (same subproblems, different solver).
+"""
+
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+
+    from photon_ml_tpu.data.game_data import (
+        build_game_dataset,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+    from photon_ml_tpu.parallel.distributed import (
+        FixedEffectStepSpec,
+        GameTrainProgram,
+        GameTrainState,
+        RandomEffectStepSpec,
+    )
+    from photon_ml_tpu.types import TaskType
+
+    print(f"backend={jax.default_backend()}")
+    rng = np.random.default_rng(0)
+    n, d_fe, d_re = 1 << 17, 256, 16
+    n_users, n_items = 2000, 1500
+    users = np.array([f"u{i}" for i in rng.integers(0, n_users, size=n)])
+    items = np.array([f"i{i}" for i in rng.integers(0, n_items, size=n)])
+    x_fe = rng.normal(size=(n, d_fe)).astype(np.float32)
+    x_re = rng.normal(size=(n, d_re)).astype(np.float32)
+    y = (x_fe @ rng.normal(size=d_fe).astype(np.float32) / np.sqrt(d_fe)
+         + rng.normal(size=n).astype(np.float32))
+    dataset = build_game_dataset(
+        labels=y,
+        feature_shards={"global": x_fe, "per_entity": x_re},
+        entity_keys={"user": users, "item": items},
+        dtype=np.float32,
+    )
+    re_datasets = {
+        t: build_random_effect_dataset(dataset, t, "per_entity",
+                                       bucket_sizes=(128,))
+        for t in ("user", "item")
+    }
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=10)
+    newton = OptimizerConfig(optimizer_type=OptimizerType.NEWTON,
+                             max_iterations=10)
+
+    def make(re_opt):
+        program = GameTrainProgram(
+            TaskType.LINEAR_REGRESSION,
+            FixedEffectStepSpec(feature_shard_id="global", optimizer=opt,
+                                l2_weight=1.0),
+            (
+                RandomEffectStepSpec("user", "per_entity", re_opt, l2_weight=1.0),
+                RandomEffectStepSpec("item", "per_entity", re_opt, l2_weight=1.0),
+            ),
+            use_pallas_fe=True,
+        )
+        data, buckets = program.prepare_inputs(dataset, re_datasets, None)
+        base = program.init_state(dataset, re_datasets, None)
+        return program, data, buckets, base
+
+    variants = {"lbfgs10": make(opt), "newton": make(newton)}
+
+    # numerics cross-check: 3 sweeps from the same init must land both
+    # programs on (near-)identical states — same subproblems, solved to
+    # (at least) the same quality
+    states = {}
+    for v, (program, data, buckets, base) in variants.items():
+        s = base
+        for _ in range(3):
+            s, loss = program.step(data, buckets, s)
+        states[v] = (np.asarray(s.fe_coefficients),
+                     {t: np.asarray(tab) for t, tab in s.re_tables.items()},
+                     float(loss))
+    fe_d = np.max(np.abs(states["lbfgs10"][0] - states["newton"][0]))
+    print(f"after 3 sweeps: loss lbfgs={states['lbfgs10'][2]:.8f} "
+          f"newton={states['newton'][2]:.8f}  max|dfe|={fe_d:.2e}")
+    for t in states["lbfgs10"][1]:
+        d = np.max(np.abs(states["lbfgs10"][1][t] - states["newton"][1][t]))
+        print(f"  max|d re[{t}]| = {d:.2e}")
+
+    def perturbed(base, seed):
+        key = jax.random.PRNGKey(seed)
+        keys = jax.random.split(key, 1 + len(base.re_tables))
+        return GameTrainState(
+            fe_coefficients=base.fe_coefficients
+            + 1e-3 * jax.random.normal(keys[0], base.fe_coefficients.shape),
+            re_tables={
+                t: tab + 1e-3 * jax.random.normal(k, tab.shape)
+                for k, (t, tab) in zip(keys[1:], base.re_tables.items())
+            },
+            mf_rows=dict(base.mf_rows),
+            mf_cols=dict(base.mf_cols),
+        )
+
+    def timed(v, k, seed):
+        program, data, buckets, base = variants[v]
+        state = perturbed(base, seed)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            state, loss = program.step(data, buckets, state)
+        float(np.asarray(state.fe_coefficients)[0])
+        return time.perf_counter() - t0
+
+    seed = [100]
+
+    def once(v):
+        s0 = seed[0]
+        seed[0] += 10
+        lo = min(timed(v, 1, s0 + s) for s in (1, 2))
+        hi = min(timed(v, 5, s0 + s) for s in (3, 4))
+        return max((hi - lo) / 4, 1e-6)
+
+    reps = {v: [] for v in variants}
+    for r in range(3):
+        for v in variants:
+            reps[v].append(once(v))
+        print(f"rep {r}: " +
+              " ".join(f"{v}={reps[v][-1] * 1e3:.1f}ms" for v in variants),
+              flush=True)
+    for v in reps:
+        med = statistics.median(reps[v]) * 1e3
+        print(f"{v}: median {med:.1f} ms  "
+              f"[{min(reps[v]) * 1e3:.1f}, {max(reps[v]) * 1e3:.1f}]")
+
+
+if __name__ == "__main__":
+    main()

@@ -31,10 +31,14 @@ Run from the repo root on the TPU (no PYTHONPATH), nothing else on the host.
 """
 
 import json
+import os
 import statistics
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():

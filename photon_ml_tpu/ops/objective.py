@@ -256,6 +256,9 @@ class BoundObjective:
     def hessian_vector(self, w: Array, v: Array) -> Array:
         return self.objective.hessian_vector(w, v, self.batch)
 
+    def hessian_matrix(self, w: Array) -> Array:
+        return self.objective.hessian_matrix(w, self.batch)
+
 
 ValueAndGradFn = Callable[[Array], tuple[Array, Array]]
 HessianVectorFn = Callable[[Array, Array], Array]

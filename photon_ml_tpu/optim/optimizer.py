@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from photon_ml_tpu.ops.objective import BoundObjective
 from photon_ml_tpu.optim.common import SolverResult
 from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+from photon_ml_tpu.optim.newton import minimize_newton
 from photon_ml_tpu.optim.owlqn import minimize_owlqn
 from photon_ml_tpu.optim.tron import minimize_tron
 
@@ -26,12 +27,15 @@ Array = jax.Array
 
 
 class OptimizerType(enum.Enum):
-    """Reference: photon-lib optimization/OptimizerType.scala."""
+    """Reference: photon-lib optimization/OptimizerType.scala. NEWTON is a
+    TPU-first extension with no reference analogue (optim/newton.py): the
+    op-minimal solver for small-d vmapped per-entity solves."""
 
     LBFGS = "LBFGS"
     OWLQN = "OWLQN"
     LBFGSB = "LBFGSB"
     TRON = "TRON"
+    NEWTON = "NEWTON"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,6 +121,30 @@ def solve(
             max_iter=config.max_iterations,
             tolerance=config.tolerance,
             max_cg_iter=config.max_cg_iterations,
+        )
+    if t == OptimizerType.NEWTON:
+        loss = objective.objective.loss
+        if not loss.twice_differentiable:
+            raise ValueError(
+                f"NEWTON requires a twice-differentiable loss, got "
+                f"{type(loss).__name__} (same restriction as TRON)"
+            )
+        # the generic BoundObjective always has the method; what matters is
+        # whether the UNDERLYING objective can produce a dense [d, d] H
+        inner = getattr(objective, "objective", objective)
+        if not hasattr(inner, "hessian_matrix"):
+            raise ValueError(
+                "NEWTON needs an explicit [d, d] Hessian; "
+                f"{type(inner).__name__} does not expose one — NEWTON is "
+                "meant for small-d dense (per-entity) solves"
+            )
+        return minimize_newton(
+            objective.value_and_grad,
+            objective.hessian_matrix,
+            w0,
+            value_fn=objective.value,
+            max_iter=config.max_iterations,
+            tolerance=config.tolerance,
         )
     raise ValueError(f"Unknown optimizer type {t}")
 

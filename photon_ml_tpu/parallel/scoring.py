@@ -417,7 +417,7 @@ class DistributedScorer:
 
     def _evaluate_scores(
         self, scores: Array, dataset: GameDataset, evaluator_specs,
-        n_pad: int, host_scores_fn,
+        n_pad: int, host_scores_fn, use_device_forms: bool = True,
     ) -> dict[str, float]:
         """Evaluate still-sharded scores: metrics with a device form
         (evaluation/sharded.py — RMSE, MAE, the losses, AUC, per-query
@@ -446,13 +446,14 @@ class DistributedScorer:
             weights=np.asarray(dataset.host_array("weights")),
             ids=dataset.ids,
         )
-        if self.mesh is not None:
+        if self.mesh is not None and use_device_forms:
             device_evals = prepare_device_evaluators(
                 evaluators, eval_data, n_pad=n_pad,
                 place=mesh_data_placer(self.mesh, put_fn=default_put()),
             )
         else:
-            # single device: the exact host evaluators, nothing to avoid
+            # exact host evaluators (single device, or the scores were
+            # gathered anyway): nothing to avoid
             device_evals = [None] * len(evaluators)
         values = evaluate_prepared(
             evaluators, device_evals, scores, eval_data, host_scores_fn
@@ -487,9 +488,11 @@ class DistributedScorer:
     ) -> tuple[np.ndarray, dict[str, float]]:
         """(host scores, metrics) from ONE data-preparation/scoring pass —
         what GameTransformer.transform consumes when scores must be
-        written anyway. Device-form metrics still reduce on-mesh (bitwise
-        the trainer's validation math); the single host gather is shared
-        with the returned score vector."""
+        written anyway. The gather happens regardless (the scores are the
+        product), so metrics use the EXACT host evaluators on it — a
+        device-side approximation (histogram AUC) would trade exactness
+        for a gather that is not avoided. evaluate_dataset is the entry
+        that skips the gather."""
         from photon_ml_tpu.parallel.distributed import _host_scores
 
         data, params, n_true = self.prepare(dataset)
@@ -500,6 +503,7 @@ class DistributedScorer:
                 scores, dataset, evaluator_specs,
                 n_pad=int(data["offsets"].shape[0]),
                 host_scores_fn=lambda: host,
+                use_device_forms=False,
             )
             if evaluator_specs else {}
         )

@@ -52,10 +52,10 @@ class GameTransformer:
                 self.model, self.mesh,
                 fe_feature_sharded=self.fe_feature_sharded,
             )
-            # one prepare/score pass: scores (incl. offsets) gather for the
-            # caller, while device-form metrics reduce ON the mesh — the
-            # executor-side evaluation of the reference's scoring path
-            # (GameScoringDriver.scala:260-281, Evaluator.scala:39-49)
+            # one prepare/score pass; the scores gather regardless (they
+            # are the product), so metrics use the exact host evaluators
+            # on the gathered vector — gather-free on-mesh evaluation is
+            # evaluate_dataset's job (validation-style runs)
             scores, evaluations = scorer.score_and_evaluate(
                 dataset, self.evaluator_specs
             )
