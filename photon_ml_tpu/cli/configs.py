@@ -118,6 +118,9 @@ class CoordinateCliConfig:
 
     name: str
     feature_shard: str
+    #: LBFGS (default) | OWLQN | LBFGSB | TRON (the reference's set,
+    #: OptimizerType.scala) | NEWTON (TPU-first batched small-d solver,
+    #: optim/newton.py — the fast choice for RE/MF coordinates)
     optimizer: OptimizerType = OptimizerType.LBFGS
     max_iterations: int = 100
     tolerance: float = 1e-7

@@ -310,6 +310,42 @@ class TestDistributedProjectorsAndMF:
         # tables persist in ORIGINAL space (projector-agnostic scoring)
         assert res.model.get("per-user").coefficients.shape[1] == 4
 
+    def test_newton_projected_re_through_estimator(self, data):
+        """NEWTON × INDEX_MAP-projected RE: the batched-Newton solver's
+        Hessian rides the projected per-entity feature blocks through the
+        same solve() facade — fused-vs-CD agreement pins the combination
+        (the solver sees scratch-column index-map batches, the least
+        trivial RE solve shape)."""
+        import dataclasses as dc
+
+        from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+        from photon_ml_tpu.projector.projectors import ProjectorType
+
+        train, val = data
+        nopt = dc.replace(
+            OPT,
+            optimizer=OptimizerConfig(
+                optimizer_type=OptimizerType.NEWTON, max_iterations=10
+            ),
+        )
+        configs = {
+            "fe": CONFIGS["fe"],
+            "per-user": RandomEffectCoordinateConfig(
+                "userId", "per", nopt,
+                projector_type=ProjectorType.INDEX_MAP,
+            ),
+        }
+        res = _fit(train, val, make_mesh(), configs=configs, num_iterations=2)
+        cd = _fit(train, val, None, configs=configs, num_iterations=2)
+        assert np.isclose(res.best_metric, cd.best_metric, rtol=5e-3)
+        lb = _fit(train, val, None, configs={
+            "fe": CONFIGS["fe"],
+            "per-user": RandomEffectCoordinateConfig(
+                "userId", "per", OPT, projector_type=ProjectorType.INDEX_MAP,
+            ),
+        }, num_iterations=2)
+        assert np.isclose(cd.best_metric, lb.best_metric, rtol=5e-3)
+
     def test_mf_coordinate_through_estimator(self, data):
         """A matrix-factorization coordinate trains inside the distributed
         estimator alongside FE + RE."""
