@@ -17,8 +17,8 @@ python -m photon_ml_tpu.cli.game_training_driver \
   --feature-shard-configurations "name=userShard,feature.bags=userFeatures,intercept=false" \
   --feature-shard-configurations "name=itemShard,feature.bags=itemFeatures,intercept=false" \
   --coordinate-configurations "name=fe,feature.shard=global,reg.weights=0.01|1" \
-  --coordinate-configurations "name=per-user,feature.shard=userShard,random.effect.type=userId,reg.weights=1" \
-  --coordinate-configurations "name=per-item,feature.shard=itemShard,random.effect.type=itemId,reg.weights=1" \
+  --coordinate-configurations "name=per-user,feature.shard=userShard,random.effect.type=userId,reg.weights=1,optimizer=NEWTON" \
+  --coordinate-configurations "name=per-item,feature.shard=itemShard,random.effect.type=itemId,reg.weights=1,optimizer=NEWTON" \
   --coordinate-configurations "name=mf,mf.row.effect.type=userId,mf.col.effect.type=itemId,mf.latent.factors=4,reg.weights=0.01" \
   --coordinate-descent-iterations 3 \
   --evaluators "RMSE,RMSE:queryId" \

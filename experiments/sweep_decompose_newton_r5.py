@@ -1,0 +1,156 @@
+"""r5b: decompose the NEWTON-RE fused sweep's remaining ~15-20 ms.
+
+Follow-up to sweep_decompose_r5.py (which attributed ~87% of the LBFGS-10
+sweep to the vmapped RE solves) after optim/newton.py collapsed those:
+where does the Newton sweep spend its time, and what is the next floor?
+
+Variants (same workload, interleaved, marginal 5-vs-1, median-of-3):
+- fe_only_1 / fe_only_10: the FE coordinate floor + LBFGS slope (kernel-fed)
+- full_newton:  FE LBFGS-10 + both REs on Newton (the bench newton row)
+- fe_user_newton: drop the item RE -> one Newton RE coordinate's marginal
+- full_newton_fe1: FE at 1 iter -> FE slope inside the Newton sweep
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+
+    from photon_ml_tpu.data.game_data import (
+        build_game_dataset,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+    from photon_ml_tpu.parallel.distributed import (
+        FixedEffectStepSpec,
+        GameTrainProgram,
+        GameTrainState,
+        RandomEffectStepSpec,
+    )
+    from photon_ml_tpu.types import TaskType
+
+    print(f"backend={jax.default_backend()}")
+    rng = np.random.default_rng(0)
+    n, d_fe, d_re = 1 << 17, 256, 16
+    n_users, n_items = 2000, 1500
+    users = np.array([f"u{i}" for i in rng.integers(0, n_users, size=n)])
+    items = np.array([f"i{i}" for i in rng.integers(0, n_items, size=n)])
+    x_fe = rng.normal(size=(n, d_fe)).astype(np.float32)
+    x_re = rng.normal(size=(n, d_re)).astype(np.float32)
+    y = (x_fe @ rng.normal(size=d_fe).astype(np.float32) / np.sqrt(d_fe)
+         + rng.normal(size=n).astype(np.float32))
+    dataset = build_game_dataset(
+        labels=y,
+        feature_shards={"global": x_fe, "per_entity": x_re},
+        entity_keys={"user": users, "item": items},
+        dtype=np.float32,
+    )
+    re_datasets = {
+        t: build_random_effect_dataset(dataset, t, "per_entity",
+                                       bucket_sizes=(128,))
+        for t in ("user", "item")
+    }
+
+    def opt(t, iters):
+        return OptimizerConfig(optimizer_type=t, max_iterations=iters)
+
+    LB = OptimizerType.LBFGS
+    NT = OptimizerType.NEWTON
+
+    def make(fe_iters, re_opt, res):
+        program = GameTrainProgram(
+            TaskType.LINEAR_REGRESSION,
+            FixedEffectStepSpec(feature_shard_id="global",
+                                optimizer=opt(LB, fe_iters), l2_weight=1.0),
+            tuple(
+                RandomEffectStepSpec(t, "per_entity", re_opt, l2_weight=1.0)
+                for t in res
+            ),
+            use_pallas_fe=True,
+        )
+        rds = {t: re_datasets[t] for t in res}
+        data, buckets = program.prepare_inputs(dataset, rds, None)
+        base = program.init_state(dataset, rds, None)
+        return program, data, buckets, base
+
+    variants = {
+        "fe_only_1": make(1, opt(NT, 10), ()),
+        "fe_only_10": make(10, opt(NT, 10), ()),
+        "fe_user_newton": make(10, opt(NT, 10), ("user",)),
+        "full_newton": make(10, opt(NT, 10), ("user", "item")),
+        "full_newton_fe1": make(1, opt(NT, 10), ("user", "item")),
+    }
+
+    def perturbed(base, seed):
+        key = jax.random.PRNGKey(seed)
+        keys = jax.random.split(key, 1 + max(len(base.re_tables), 1))
+        return GameTrainState(
+            fe_coefficients=base.fe_coefficients
+            + 1e-3 * jax.random.normal(keys[0], base.fe_coefficients.shape),
+            re_tables={
+                t: tab + 1e-3 * jax.random.normal(k, tab.shape)
+                for k, (t, tab) in zip(keys[1:], base.re_tables.items())
+            },
+            mf_rows=dict(base.mf_rows),
+            mf_cols=dict(base.mf_cols),
+        )
+
+    def timed(v, k, seed):
+        program, data, buckets, base = variants[v]
+        state = perturbed(base, seed)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            state, loss = program.step(data, buckets, state)
+        float(np.asarray(state.fe_coefficients)[0])
+        return time.perf_counter() - t0
+
+    seed = [0]
+
+    def once(v):
+        s0 = seed[0]
+        seed[0] += 10
+        lo = min(timed(v, 1, s0 + s) for s in (1, 2))
+        hi = min(timed(v, 5, s0 + s) for s in (3, 4))
+        return max((hi - lo) / 4, 1e-6)
+
+    for v in variants:
+        timed(v, 1, 0)
+        print(f"compiled {v}")
+
+    reps = {v: [] for v in variants}
+    for r in range(3):
+        for v in variants:
+            reps[v].append(once(v))
+        print(f"rep {r}: " +
+              " ".join(f"{v}={reps[v][-1] * 1e3:.1f}ms" for v in variants),
+              flush=True)
+
+    med = {v: statistics.median(reps[v]) * 1e3 for v in reps}
+    sp = {v: [min(reps[v]) * 1e3, max(reps[v]) * 1e3] for v in reps}
+    print("\n=== medians (ms/sweep, spread=[min,max]) ===")
+    for v in med:
+        print(f"{v:16s} {med[v]:7.1f}  {sp[v][0]:7.1f} .. {sp[v][1]:7.1f}")
+    print("\n=== decomposition (medians) ===")
+    print(f"FE fixed (1-iter sweep):        {med['fe_only_1']:6.2f} ms")
+    print(f"FE LBFGS slope x9:              "
+          f"{med['fe_only_10'] - med['fe_only_1']:6.2f} ms")
+    print(f"user RE (Newton) marginal:      "
+          f"{med['fe_user_newton'] - med['fe_only_10']:6.2f} ms")
+    print(f"item RE (Newton) marginal:      "
+          f"{med['full_newton'] - med['fe_user_newton']:6.2f} ms")
+    print(f"FE slope inside full x9:        "
+          f"{med['full_newton'] - med['full_newton_fe1']:6.2f} ms")
+    print(json.dumps({"medians_ms": med, "spread_ms": sp}))
+
+
+if __name__ == "__main__":
+    main()
