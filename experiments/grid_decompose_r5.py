@@ -1,0 +1,144 @@
+"""r5: what bounds the vmapped λ-grid (the PRIMARY bench metric)?
+
+The r4 bf16 probe (grid_bf16_probe.py) found halving X bytes gains only
+1.09x per grid — so the grid is not X-bandwidth-bound and a one-pass
+multi-lane kernel (2x fewer X bytes) would be building the wrong thing.
+This probe separates the grid's per-lane-iteration cost into:
+
+1. raw vmapped value+grad eval over the 32 lanes (K-scan differenced);
+2. a value-only eval (the line search's extra evaluations are value+grad
+   here too — LBFGS calls vg everywhere — so (1) is the eval unit);
+3. the full vmapped-LBFGS grid marginal per lockstep iteration
+   (max_iter-differenced: 30 vs 10 iters, tolerance=0 so every lane runs
+   exactly max_iter outer iterations);
+4. (3) with history=5 vs 10 — is the two-loop recursion visible?
+
+solver-per-iter minus (evals-per-iter x eval cost) = line-search lockstep +
+two-loop + bookkeeping overhead. Decides whether the next grid attack is a
+lane kernel (eval-bound) or solver-shape work (overhead-bound).
+"""
+
+import os
+import statistics
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import LabeledPointBatch
+    from photon_ml_tpu.ops.losses import LogisticLoss
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+
+    print(f"backend={jax.default_backend()}")
+    rng = np.random.default_rng(0)
+    n, d, L = 1 << 18, 512, 32
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32) / np.sqrt(d)
+    logits = x @ w_true
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float32)
+    batch = LabeledPointBatch.create(jax.device_put(x), jax.device_put(y))
+    objective = GLMObjective(LogisticLoss(), l2_weight=0.0, use_pallas=False)
+    bound = objective.bind(batch)
+    l2v = jnp.asarray(np.logspace(-2, 2, L), jnp.float32)
+    xbytes = n * d * 4
+
+    # --- 1. raw vmapped value+grad eval rate (K-scan differenced) --------
+    @partial(jax.jit, static_argnums=(2,))
+    def eval_scan(w0s, b, k):
+        def step(ws, _):
+            def one(w, l2):
+                v, g = objective.value_and_gradient(w, b)
+                return w - 1e-6 * (g + l2 * w), v
+            ws, vs = jax.vmap(one)(ws, l2v)
+            return ws, vs.sum()
+        ws, vs = jax.lax.scan(step, w0s, None, length=k)
+        return ws.sum() + vs.sum()
+
+    def timed_scan(fn, k, *args):
+        float(fn(*args, k))
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(fn(*args, k))
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    w0s = jnp.asarray(rng.normal(size=(L, d)).astype(np.float32)) * 1e-3
+
+    def once_eval():
+        lo = timed_scan(eval_scan, 8, w0s, batch)
+        hi = timed_scan(eval_scan, 64, w0s, batch)
+        return max((hi - lo) / 56, 1e-9)
+
+    ev = [once_eval() for _ in range(3)]
+    ev_med = statistics.median(ev)
+    print(f"vmapped 32-lane value+grad eval: {ev_med * 1e3:.2f} ms "
+          f"[{min(ev) * 1e3:.2f}, {max(ev) * 1e3:.2f}] "
+          f"({2 * xbytes / ev_med / 1e9:.0f} GB/s two-X-pass-equivalent)")
+
+    # --- 2. full grid marginal per lockstep iteration --------------------
+    # batch rides as a jit ARGUMENT — closing over it serializes 537 MB of
+    # constants into the remote-compile request (the CLAUDE.md HTTP-413
+    # landmine; the first cut of this probe broke the tunnel exactly so)
+    @partial(jax.jit, static_argnums=(2, 3))
+    def run_grid(seed, b, iters, history):
+        bnd = objective.bind(b)
+
+        def solve_one(l2, key):
+            def vg(w):
+                v, g = bnd.value_and_grad(w)
+                return v + 0.5 * l2 * jnp.vdot(w, w), g + l2 * w
+            w0 = 1e-4 * jax.random.normal(key, (d,), jnp.float32)
+            return minimize_lbfgs(vg, w0, max_iter=iters, history=history,
+                                  tolerance=0.0)
+        keys = jax.random.split(jax.random.PRNGKey(seed), L)
+        rs = jax.vmap(solve_one)(l2v, keys)
+        return rs.iterations.sum(), rs.value.sum()
+
+    def timed_grid(iters, history, seed):
+        float(run_grid(seed, batch, iters, history)[1])
+        best = None
+        best_iters = 0
+        for s in range(3):
+            t0 = time.perf_counter()
+            it, v = run_grid(seed + s + 1, batch, iters, history)
+            float(v)
+            el = time.perf_counter() - t0
+            if best is None or el < best:
+                best, best_iters = el, int(it)
+        return best, best_iters
+
+    for history in (10, 5):
+        seed = [history * 1000]
+
+        def once():
+            s0 = seed[0]
+            seed[0] += 10
+            lo, it_lo = timed_grid(10, history, s0)
+            hi, it_hi = timed_grid(30, history, s0 + 5)
+            # lockstep: every lane runs exactly max_iter outer iterations
+            return max((hi - lo) / 20, 1e-9), (it_hi - it_lo) / 20
+
+        rs = [once() for _ in range(3)]
+        per_iter = statistics.median([r[0] for r in rs])
+        lane_iters = statistics.median([r[1] for r in rs])
+        print(f"grid per lockstep iter (history={history}): "
+              f"{per_iter * 1e3:.2f} ms "
+              f"[{min(r[0] for r in rs) * 1e3:.2f}, "
+              f"{max(r[0] for r in rs) * 1e3:.2f}] "
+              f"(~{lane_iters:.1f} lane-iters per lockstep iter)")
+    print(f"\neval is the unit above; solver-per-iter / eval = evals+overhead")
+
+
+if __name__ == "__main__":
+    main()
