@@ -36,26 +36,29 @@ Measurement notes (tunneled/remote TPU backends):
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
+# the measurement discipline (median-of-K, K_hi/K_lo differencing, stream
+# calibration) lives in the telemetry library since r6 — bench.py is one
+# consumer; probes imports no jax at module load, so the platform choice
+# below still happens first
+from photon_ml_tpu.telemetry.probes import (
+    GATE_REPS,  # median-of-K for every gate metric (chip-lottery pool:
+                # single-shot numbers swing ~2x between back-to-back reps —
+                # BASELINE.md tenancy study; VERDICT r3 #8)
+    MarginalTimer,
+    median_spread,
+    read_scalar,
+    scan_step_marginal,
+    stream_calibration,
+)
+
 N, D, MAX_ITER, GRID = 1 << 18, 512, 30, 32
 CPU_SUBSAMPLE = 1 << 15
 HBM_ROOFLINE_GBPS = 819.0  # v5e
-GATE_REPS = 3  # median-of-K for every gate metric (chip-lottery pool:
-               # single-shot numbers swing ~2x between back-to-back reps —
-               # BASELINE.md tenancy study; VERDICT r3 #8)
-
-
-def median_spread(measure_once, reps: int = GATE_REPS):
-    """Run a marginal measurement ``reps`` times; return
-    (median, [min, max]). The spread is the honest error bar for
-    round-over-round comparisons on the shared-chip pool."""
-    import statistics
-
-    vals = [measure_once() for _ in range(reps)]
-    return statistics.median(vals), [min(vals), max(vals)]
 
 
 def _make_data(n: int, d: int, seed: int = 0):
@@ -187,26 +190,8 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
     rng = np.random.default_rng(7)
 
     def marginal_of(step_fn, b):
-        def timed(k):
-            @jax.jit
-            def run(w0, bb):
-                w, vs = jax.lax.scan(
-                    lambda w, _: step_fn(w, bb), w0, None, length=k
-                )
-                return vs.sum() + w.sum()
-
-            float(run(jnp.zeros(d, jnp.float32), b))  # compile+sync
-            best = None
-            for _ in range(4):
-                w0 = jnp.asarray(rng.normal(size=d).astype(np.float32)) * 0.01
-                t0 = time.perf_counter()
-                float(run(w0, b))
-                el = time.perf_counter() - t0
-                best = el if best is None or el < best else best
-            return best
-
-        return median_spread(
-            lambda: max((timed(k_hi) - timed(k_lo)) / (k_hi - k_lo), 1e-6)
+        return scan_step_marginal(
+            step_fn, b, d, k_lo=k_lo, k_hi=k_hi, reps=GATE_REPS, rng=rng
         )
 
     # Same-run stream calibration (one X read per step): the tunnel pool's
@@ -214,15 +199,14 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
     # fractions are only meaningful against THIS run's chip. Note the probe
     # is an XLA matvec and slightly UNDERESTIMATES peak (the r4 kernel
     # sustains ~1.1x it), so fractions >1.0 are real.
-    stream_m, stream_sp = marginal_of(
-        lambda w, b: (w + jnp.sum(b.features @ w) * 1e-30, jnp.float32(0)),
-        batch,
+    cal = stream_calibration(
+        batch.features, k_lo=k_lo, k_hi=k_hi, reps=GATE_REPS, rng=rng
     )
-    stream_gbps = xbytes / stream_m / 1e9
+    stream_gbps = cal["gbps"]
     out = [{
         "metric": "fe_hot_loop_stream_gbps",
         "value": round(stream_gbps, 1),
-        "spread": [round(xbytes / s / 1e9, 1) for s in stream_sp[::-1]],
+        "spread": [round(s, 1) for s in cal["spread_gbps"]],
         "unit": (
             f"same-run calibration: one [n, d]-matvec X read per step "
             f"(n={n}, d={d}; nominal v5e roofline {HBM_ROOFLINE_GBPS} GB/s; "
@@ -365,20 +349,20 @@ def bench_game_sweep() -> list[dict]:
             t0 = time.perf_counter()
             for _ in range(k):
                 state, loss = program.step(data, buckets, state)
-            float(np.asarray(state.fe_coefficients)[0])  # host read: hard sync
+            read_scalar(state.fe_coefficients)  # host read: hard sync
             return time.perf_counter() - t0
 
         timed(1, 0)  # compile + sync
         seed = [0]
 
-        def once():
+        def timed_k(k):
+            # two fresh-seed attempts per K, keep the best (dispatch noise)
             s0 = seed[0]
-            seed[0] += 10
-            lo = min(timed(1, s0 + s) for s in (1, 2))
-            hi = min(timed(5, s0 + s) for s in (3, 4))
-            return max((hi - lo) / 4, 1e-6)
+            seed[0] += 5
+            return min(timed(k, s0 + s) for s in (1, 2))
 
-        return median_spread(once)
+        result = MarginalTimer(k_lo=1, k_hi=5, reps=GATE_REPS).measure(timed_k)
+        return result.median, result.spread
 
     per_sweep, sp = measure(make_program(opt))
     newton_sweep, newton_sp = measure(make_program(newton))
@@ -609,7 +593,7 @@ def main():
     cpu_rate = bench_cpu_scipy(x[:CPU_SUBSAMPLE], y[:CPU_SUBSAMPLE])
 
     rate = N * lane_iters / tpu_time
-    print(json.dumps({
+    report = {
         "metric": "glm_lambda_grid_example_iters_per_sec",
         "value": round(rate, 1),
         "spread": [round(N * lane_iters / s, 1) for s in tpu_spread[::-1]],
@@ -623,7 +607,25 @@ def main():
         ),
         "vs_baseline": round(rate / cpu_rate, 2),
         "extra_metrics": extra,
-    }))
+    }
+    # optional structured journal (stdout contract unchanged: ONE JSON line).
+    # Calibration rows are chip-lottery-sensitive — compare fractions of the
+    # same-run stream probe, never absolute GB/s across journals.
+    telemetry_dir = os.environ.get("PHOTON_TELEMETRY_DIR")
+    if telemetry_dir:
+        from photon_ml_tpu.telemetry import RunJournal
+
+        with RunJournal(telemetry_dir, filename="bench-journal.jsonl") as journal:
+            journal.record("config", n=N, d=D, grid=GRID, max_iter=MAX_ITER)
+            for row in extra:
+                kind = (
+                    "calibration" if "stream" in row["metric"] else "bench_metric"
+                )
+                journal.record(kind, **row)
+            journal.record("bench_metric", **{
+                k: v for k, v in report.items() if k != "extra_metrics"
+            })
+    print(json.dumps(report))
 
 
 if __name__ == "__main__":

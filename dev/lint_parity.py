@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Static parity-convention lints for photon_ml_tpu (CLAUDE.md conventions).
+
+Two checks, both pure-AST (no jax import; runs in milliseconds):
+
+1. **Docstring citations** — every ``photon_ml_tpu/**/*.py`` module (except
+   ``__init__.py`` re-export shims) must carry a module docstring that
+   either cites a reference source file (``Foo.scala``, ``*.avsc``,
+   ``*.java``) or explicitly declares "no reference analogue". This is the
+   convention the parity judge checks against SURVEY.md §2.
+
+2. **Forbidden batched decompositions** — XLA's batched small
+   decompositions serialize per matrix on TPU (cholesky+cho_solve on
+   [2000, 16, 16] = 3.4 ms, LU = 9.0 ms, vs 0.09 ms for the hand-rolled
+   vectorized Gauss-Jordan in optim/newton.py — BASELINE.md r5 study), so
+   ``jnp.linalg.cholesky`` / ``jnp.linalg.solve`` / ``jnp.linalg.inv`` and
+   ``jax.scipy.linalg.cho_*`` calls are banned outside the approved
+   modules: ops/variance.py (single-Hessian reference-fidelity path with
+   its own size gates) and algorithm/coordinates.py (one shared [k, k]
+   Gram solve, not a batch).
+
+Exit status 0 = clean; 1 = violations (printed one per line as
+``path:lineno: message``). Run from the repo root:
+
+    python dev/lint_parity.py
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+PACKAGE = "photon_ml_tpu"
+
+#: a docstring satisfies the convention if it names a reference source file
+#: (Foo.scala:NN and friends; dev-scripts/*.py is the reference's one Python
+#: tool — a bare .py mention is NOT enough, else self-citations of this
+#: package's own modules would pass), a reference module directory
+#: (photon-diagnostics diagnostics/hl/ — used by subsystem-level ports), or
+#: explicitly declares there is none
+CITATION_RE = re.compile(
+    r"\.(scala|avsc|java)\b"
+    r"|dev-scripts/[\w./-]+\.py\b"
+    r"|photon-(lib|api|client|diagnostics|test-utils)\s+[\w./-]+/"
+    r"|no reference analogue",
+    re.IGNORECASE,
+)
+
+#: modules allowed to call the banned decompositions (see module docstring)
+LINALG_ALLOWED = {
+    f"{PACKAGE}/ops/variance.py",
+    f"{PACKAGE}/algorithm/coordinates.py",
+}
+
+#: jnp.linalg attributes that batch-serialize on TPU. Host-side numpy
+#: (np.linalg.*) is NOT banned — the measured pathology is TPU-only.
+BANNED_LINALG = {"cholesky", "solve", "inv", "cho_factor", "cho_solve"}
+
+#: attribute-chain roots that resolve to jax (import jax / import jax.numpy
+#: as jnp / import jax.scipy as jsp conventions in this repo)
+JAX_ROOTS = {"jax", "jnp", "jsp"}
+
+
+def _jax_linalg_aliases(tree: ast.AST) -> set[str]:
+    """Names bound to a jax linalg MODULE (``from jax.numpy import linalg``
+    / ``from jax.scipy import linalg as jla``) — calls through these would
+    otherwise produce 2-element chains that escape the root check."""
+    aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+            "jax.numpy", "jax.scipy", "jax"
+        ):
+            for a in node.names:
+                if a.name == "linalg":
+                    aliases.add(a.asname or a.name)
+    return aliases
+
+
+def _attribute_chain(node: ast.Attribute) -> list[str]:
+    """`jnp.linalg.solve` -> ["jnp", "linalg", "solve"]."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return parts[::-1]
+
+
+def check_docstring_citations(root: pathlib.Path) -> list[str]:
+    problems = []
+    for path in sorted((root / PACKAGE).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if path.name == "__init__.py":
+            continue  # re-export shims; parity docs live in the modules
+        tree = ast.parse(path.read_text())
+        doc = ast.get_docstring(tree) or ""
+        if not CITATION_RE.search(doc):
+            problems.append(
+                f"{rel}:1: module docstring cites no reference file "
+                "(want e.g. 'Foo.scala:NN' or an explicit "
+                "'no reference analogue')"
+            )
+    return problems
+
+
+def check_banned_linalg(root: pathlib.Path) -> list[str]:
+    problems = []
+    for path in sorted((root / PACKAGE).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if rel in LINALG_ALLOWED:
+            continue
+        tree = ast.parse(path.read_text())
+        aliases = _jax_linalg_aliases(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            chain = _attribute_chain(node)
+            if len(chain) < 2 or chain[-1] not in BANNED_LINALG:
+                continue
+            # jnp.linalg.solve / jax.numpy.linalg.solve / jsp.linalg.cho_solve
+            via_root = (
+                len(chain) >= 3 and chain[-2] == "linalg"
+                and chain[0] in JAX_ROOTS
+            )
+            # from jax.numpy import linalg [as X]; X.solve(...)
+            via_alias = len(chain) == 2 and chain[0] in aliases
+            if via_root or via_alias:
+                problems.append(
+                    f"{rel}:{node.lineno}: {'.'.join(chain)} — batched "
+                    "small decompositions serialize per matrix on TPU; use "
+                    "the vectorized Gauss-Jordan path (optim/newton.py / "
+                    "ops/variance.py) or add this module to the lint "
+                    "allowlist with a measured justification"
+                )
+    return problems
+
+
+def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
+    root = pathlib.Path(root) if root else pathlib.Path(__file__).resolve().parents[1]
+    return check_docstring_citations(root) + check_banned_linalg(root)
+
+
+def main() -> int:
+    problems = run_lints()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"lint_parity: {len(problems)} violation(s)", file=sys.stderr)
+        return 1
+    print("lint_parity: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

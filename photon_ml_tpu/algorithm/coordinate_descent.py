@@ -53,6 +53,7 @@ def run_coordinate_descent(
     checkpoint_every: int = 1,
     resume: bool = True,
     check_finite: bool = True,
+    telemetry=None,
 ) -> CoordinateDescentResult:
     """Run block coordinate descent.
 
@@ -69,6 +70,13 @@ def run_coordinate_descent(
 
     check_finite: raise ``io.checkpoint.DivergenceError`` the moment a
     coordinate update produces non-finite scores, instead of training on.
+
+    telemetry: optional ``telemetry.SolverTelemetry``. Every coordinate
+    update reports its solver info — a SolverResult (fixed effect) or
+    per-entity LaneTraces (vmapped random-effect buckets) — as journal
+    convergence rows / OptimizationLogEvents keyed by (coordinate,
+    outer iteration), the parity hook for the reference's per-coordinate
+    OptimizationStatesTracker reporting (CoordinateDescent.scala:198-255).
     """
     from photon_ml_tpu.io.checkpoint import (
         DivergenceError,
@@ -149,7 +157,10 @@ def run_coordinate_descent(
                 finite = bool(jnp.isfinite(jnp.asarray(scores[cid])).all())
                 if finite and _info is not None and hasattr(_info, "value"):
                     # a failed solve can leave finite warm-start coefficients
-                    # but a non-finite objective (e.g. NaN labels) — catch too
+                    # but a non-finite objective (e.g. NaN labels) — catch
+                    # too. Scalar solver results only: vmapped RE lane
+                    # traces (LaneTraces) are telemetry-only and rely on
+                    # the device-side score check above, as before.
                     finite = bool(np.isfinite(float(_info.value)))
             if not finite:
                 raise DivergenceError(
@@ -183,6 +194,10 @@ def run_coordinate_descent(
             if metrics:
                 logger.info("CD iter %d coord %s: %s", iteration, cid, metrics)
                 history.append({"iteration": iteration, "coordinate": cid, **metrics})
+            if telemetry is not None:
+                telemetry.record_coordinate(
+                    cid, iteration, _info, metrics=metrics or None
+                )
 
             if checkpointer is not None and (
                 (slot + 1) % max(1, checkpoint_every) == 0

@@ -53,6 +53,7 @@ from photon_ml_tpu.ops.variance import (
     resolve_variance_mode_for,
     validate_variance_mode,
 )
+from photon_ml_tpu.optim.common import LaneTrace, LaneTraces
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType, solve
 from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.types import TaskType
@@ -340,36 +341,40 @@ class RandomEffectCoordinate(Coordinate):
         else:
             table = norm.from_model_space(model.coefficients, self.intercept_index)
 
+        traces: list[LaneTrace] = []
         if projector == ProjectorType.INDEX_MAP:
             # extra scratch column absorbs the padding scatter/gather slots
             table_ext = jnp.concatenate(
                 [table, jnp.zeros((table.shape[0], 1), table.dtype)], axis=1
             )
             for bucket in self.re_dataset.buckets:
-                table_ext = _jitted_re_bucket_solve_indexmap(
+                table_ext, trace = _jitted_re_bucket_solve_indexmap(
                     objective, opt,
                     bucket.features, bucket.labels, bucket.weights,
                     bucket.sample_rows, bucket.entity_rows, bucket.col_index,
                     full_offsets, table_ext,
                 )
+                traces.append(trace)
             table = table_ext[:, :-1]
         elif projector == ProjectorType.RANDOM:
             matrix = jnp.asarray(self.re_dataset.projection.matrix, dtype=table.dtype)
             for bucket in self.re_dataset.buckets:
-                table = _jitted_re_bucket_solve_random(
+                table, trace = _jitted_re_bucket_solve_random(
                     objective, opt,
                     bucket.features, bucket.labels, bucket.weights,
                     bucket.sample_rows, bucket.entity_rows,
                     matrix, full_offsets, table,
                 )
+                traces.append(trace)
         else:
             for bucket in self.re_dataset.buckets:
-                table = _jitted_re_bucket_solve(
+                table, trace = _jitted_re_bucket_solve(
                     objective, opt,
                     bucket.features, bucket.labels, bucket.weights,
                     bucket.sample_rows, bucket.entity_rows,
                     full_offsets, table,
                 )
+                traces.append(trace)
         variances = None
         if self.config.compute_variance:
             # per-entity diag(H⁻¹): one batched Cholesky per bucket
@@ -465,7 +470,15 @@ class RandomEffectCoordinate(Coordinate):
             if compact_cols is not None
             else norm.to_model_space(table, self.intercept_index)
         )
-        return dataclasses.replace(model, coefficients=table, variances=variances), None
+        # info = the per-bucket lane traces: the coordinate-descent loop
+        # hands them to telemetry (convergence-reason tallies over every
+        # vmapped entity lane). LaneTraces keeps the device arrays unmerged —
+        # no eager concatenate dispatches — so an update with no telemetry
+        # attached pays nothing; consumers merge host-side.
+        info = LaneTraces(traces) if traces else None
+        return dataclasses.replace(
+            model, coefficients=table, variances=variances
+        ), info
 
     def score(self, model: RandomEffectModel) -> Array:
         return model.score_dataset(self.dataset)
@@ -484,14 +497,33 @@ def _solve_bucket_entities(
     weights: Array,  # [e, cap]
     offsets: Array,  # [e, cap]
     w0s: Array,  # [e, k]
-) -> Array:
-    """vmapped per-entity solves: [e, k] solved coefficients."""
+) -> tuple[Array, LaneTrace]:
+    """vmapped per-entity solves: ([e, k] solved coefficients, [e] trace).
+
+    The trace carries each lane's final iteration count / convergence reason
+    / value — tiny extra outputs XLA computes anyway; consumers that only
+    want the table drop it (DCE removes the cost)."""
 
     def solve_one(f, l, o, w, w0):
         batch = LabeledPointBatch(features=f, labels=l, offsets=o, weights=w)
-        return solve(opt, objective.bind(batch), w0).coefficients
+        result = solve(opt, objective.bind(batch), w0)
+        trace = LaneTrace(
+            iterations=result.iterations,
+            reason=result.reason,
+            value=result.value,
+            gradient_norm=result.gradient_norm,
+            valid=jnp.asarray(True),
+        )
+        return result.coefficients, trace
 
     return jax.vmap(solve_one)(features, labels, offsets, weights, w0s)
+
+
+def _mask_padding_lanes(trace: LaneTrace, entity_rows: Array, num_rows: int) -> LaneTrace:
+    """Mark padding lanes invalid: OOB-sentinel entity rows (gathers clamp,
+    scatters drop) solve all-zero-weight batches whose iteration counts and
+    reasons must not pollute convergence tallies."""
+    return trace.replace(valid=(entity_rows >= 0) & (entity_rows < num_rows))
 
 
 def solve_entity_bucket(
@@ -511,11 +543,33 @@ def solve_entity_bucket(
     mesh-sharded full-GAME train step (parallel/distributed.py), where the
     entity axis shards over the mesh's "data" axis.
     """
+    table, _trace = solve_entity_bucket_traced(
+        objective, opt, features, labels, weights, sample_rows, entity_rows,
+        full_offsets, table,
+    )
+    return table
+
+
+def solve_entity_bucket_traced(
+    objective: GLMObjective,
+    opt: OptimizerConfig,
+    features: Array,
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    full_offsets: Array,
+    table: Array,
+) -> tuple[Array, LaneTrace]:
+    """:func:`solve_entity_bucket` + per-lane convergence trace (padding
+    lanes masked invalid). The fused mesh path keeps using the untraced
+    variant; the CD path returns the trace to telemetry."""
     offsets = _bucket_offsets(sample_rows, full_offsets)
-    solved = _solve_bucket_entities(
+    solved, trace = _solve_bucket_entities(
         objective, opt, features, labels, weights, offsets, table[entity_rows]
     )
-    return table.at[entity_rows].set(solved)
+    trace = _mask_padding_lanes(trace, entity_rows, table.shape[0])
+    return table.at[entity_rows].set(solved), trace
 
 
 @partial(jax.jit, static_argnums=(0, 1))
@@ -530,7 +584,7 @@ def _jitted_re_bucket_solve(
     full_offsets: Array,
     table: Array,
 ):
-    return solve_entity_bucket(
+    return solve_entity_bucket_traced(
         objective, opt, features, labels, weights, sample_rows, entity_rows,
         full_offsets, table,
     )
@@ -658,13 +712,34 @@ def solve_entity_bucket_indexmap(
     used by the single-chip jit wrapper below and by the mesh-sharded
     fused step (parallel/distributed.py), where the entity axis shards
     over "data"."""
+    table_ext, _trace = solve_entity_bucket_indexmap_traced(
+        objective, opt, features, labels, weights, sample_rows, entity_rows,
+        col_index, full_offsets, table_ext,
+    )
+    return table_ext
+
+
+def solve_entity_bucket_indexmap_traced(
+    objective: GLMObjective,
+    opt: OptimizerConfig,
+    features: Array,
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    col_index: Array,
+    full_offsets: Array,
+    table_ext: Array,
+) -> tuple[Array, LaneTrace]:
+    """:func:`solve_entity_bucket_indexmap` + per-lane convergence trace."""
     offsets = _bucket_offsets(sample_rows, full_offsets)
     w0s = table_ext[entity_rows[:, None], col_index]
-    solved = _solve_bucket_entities(
+    solved, trace = _solve_bucket_entities(
         objective, opt, features, labels, weights, offsets, w0s
     )
+    trace = _mask_padding_lanes(trace, entity_rows, table_ext.shape[0])
     table_ext = table_ext.at[entity_rows[:, None], col_index].set(solved)
-    return table_ext.at[:, -1].set(0.0)
+    return table_ext.at[:, -1].set(0.0), trace
 
 
 @partial(jax.jit, static_argnums=(0,))
@@ -771,12 +846,33 @@ def solve_entity_bucket_random(
     """Random-projected bucket solve: warm start Pᵀw (the adjoint projection,
     ≈ the projected coefficients since E[PᵀP]=I), back-project P w_k.
     Pure/traceable, shared with the fused step like its index-map twin."""
+    table, _trace = solve_entity_bucket_random_traced(
+        objective, opt, features, labels, weights, sample_rows, entity_rows,
+        matrix, full_offsets, table,
+    )
+    return table
+
+
+def solve_entity_bucket_random_traced(
+    objective: GLMObjective,
+    opt: OptimizerConfig,
+    features: Array,
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    matrix: Array,
+    full_offsets: Array,
+    table: Array,
+) -> tuple[Array, LaneTrace]:
+    """:func:`solve_entity_bucket_random` + per-lane convergence trace."""
     offsets = _bucket_offsets(sample_rows, full_offsets)
     w0s = table[entity_rows] @ matrix
-    solved = _solve_bucket_entities(
+    solved, trace = _solve_bucket_entities(
         objective, opt, features, labels, weights, offsets, w0s
     )
-    return table.at[entity_rows].set(solved @ matrix.T)
+    trace = _mask_padding_lanes(trace, entity_rows, table.shape[0])
+    return table.at[entity_rows].set(solved @ matrix.T), trace
 
 
 @partial(jax.jit, static_argnums=(0, 1))
@@ -792,7 +888,7 @@ def _jitted_re_bucket_solve_indexmap(
     full_offsets: Array,
     table_ext: Array,
 ):
-    return solve_entity_bucket_indexmap(
+    return solve_entity_bucket_indexmap_traced(
         objective, opt, features, labels, weights, sample_rows, entity_rows,
         col_index, full_offsets, table_ext,
     )
@@ -811,7 +907,7 @@ def _jitted_re_bucket_solve_random(
     full_offsets: Array,
     table: Array,
 ):
-    return solve_entity_bucket_random(
+    return solve_entity_bucket_random_traced(
         objective, opt, features, labels, weights, sample_rows, entity_rows,
         matrix, full_offsets, table,
     )

@@ -200,7 +200,7 @@ def solve_mf_side_bucket(
     pad = sample_rows < 0
     feats = jnp.where(pad[..., None] | (oidx < 0)[..., None], 0.0, feats)
     offsets = _bucket_offsets(sample_rows, full_offsets)
-    solved = _solve_bucket_entities(
+    solved, _trace = _solve_bucket_entities(
         objective, opt, feats, labels, weights, offsets, table[entity_rows]
     )
     return table.at[entity_rows].set(solved)

@@ -57,6 +57,9 @@ from photon_ml_tpu.io.model_io import (
     write_feature_stats,
 )
 from photon_ml_tpu.ops.normalization import NormalizationType
+from photon_ml_tpu.telemetry import RunJournal, SolverTelemetry, default_registry
+from photon_ml_tpu.telemetry.probes import CompileMonitor, live_buffer_bytes
+from photon_ml_tpu.telemetry.solver_trace import reset_solver_metrics
 from photon_ml_tpu.types import TaskType
 from photon_ml_tpu.util import (
     EventEmitter,
@@ -125,6 +128,10 @@ class GameTrainingParams:
     #: devices on "data".
     distributed: bool = False
     mesh_shape: dict[str, int] | None = None
+    #: structured-telemetry output dir: a rank-0 JSONL run journal (config
+    #: summary, phase timings, per-coordinate convergence rows, compile and
+    #: HBM gauges) finalized on completion; None = disabled
+    telemetry_dir: str | None = None
 
     def validate(self) -> None:
         """Cross-parameter checks (reference validateParams:196-298)."""
@@ -230,22 +237,54 @@ def run(params: GameTrainingParams) -> dict:
         )
     os.makedirs(out, exist_ok=True)
 
-    reset_timings()  # per-run phase timings (a sweep may call run() repeatedly)
+    # per-run phase timings + solver tallies (a sweep may call run() repeatedly)
+    reset_timings()
+    reset_solver_metrics()
     events.send(TrainingStartEvent(job_name="game-training"))
     job_log = PhotonLogger(os.path.join(out, "driver.log"))
+    # rank-gated journal: inert on worker ranks, so telemetry calls below
+    # are unconditional (collectives must still run on EVERY rank). The
+    # journal + registry sinks are opt-in via --telemetry-dir; the emitter
+    # rides along for any registered listener. With no live sink,
+    # SolverTelemetry skips row-building entirely, so default runs pay no
+    # per-coordinate device-to-host reads (~100 ms dispatch each on the
+    # tunneled TPU — CLAUDE.md).
+    journal = RunJournal(params.telemetry_dir) if params.telemetry_dir else None
+    telemetry = SolverTelemetry(
+        journal=journal,
+        emitter=events,
+        # registry only where the journal will persist it (rank 0): worker
+        # ranks would otherwise pay the row-building host reads for metrics
+        # nobody reads
+        registry=default_registry() if journal and journal.active else None,
+    )
+    compiles = CompileMonitor()
     try:
         from photon_ml_tpu.util.timed import profile_trace
 
-        with profile_trace(params.profile_dir):
-            return _run_inner(params, job_log)
+        with profile_trace(params.profile_dir), compiles:
+            summary = _run_inner(params, job_log, telemetry)
+        return summary
     except Exception:
         events.send(TrainingFinishEvent(job_name="game-training", succeeded=False))
         raise
     finally:
+        # journal phase timings / gauges on failure too — a failed run's
+        # journal is the one that most needs them
+        if journal is not None:
+            journal.record_timings(timing_summary())
+            journal.record_gauge("jax/backend_compile_count", compiles.count)
+            journal.record_gauge("device/live_buffer_bytes", live_buffer_bytes())
+            journal.record_metrics(default_registry().snapshot())
+            journal.close()
         job_log.close()
 
 
-def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
+def _run_inner(
+    params: GameTrainingParams,
+    job_log: PhotonLogger,
+    telemetry: SolverTelemetry | None = None,
+) -> dict:
     out = params.root_output_dir
     entity_columns = {
         c.random_effect_type
@@ -388,6 +427,7 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
             resume=params.resume,
             mesh=mesh,
             fe_feature_sharded=model_axis > 1,
+            telemetry=telemetry,
         )
 
     def make_checkpointer(config_index: int, reg_weights):
@@ -409,6 +449,21 @@ def _run_inner(params: GameTrainingParams, job_log: PhotonLogger) -> dict:
 
     grid = expand_reg_weight_grid(params.coordinates)
     job_log.info("expanded λ grid to %d configurations", len(grid))
+    if telemetry is not None and telemetry.journal is not None:
+        telemetry.journal.record(
+            "config",
+            task_type=params.task_type.name,
+            distributed=mesh is not None,
+            num_configurations=len(grid),
+            coordinate_configurations={
+                name: format_coordinate_config(cfg)
+                for name, cfg in params.coordinates.items()
+            },
+            update_sequence=list(
+                params.update_sequence or params.coordinates.keys()
+            ),
+            coordinate_descent_iterations=params.coordinate_descent_iterations,
+        )
     first_evaluator = parse_evaluator(params.evaluators[0]) if params.evaluators else None
 
     results = []
@@ -608,6 +663,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="ignore existing checkpoints (fresh run)")
     p.add_argument("--profile-dir",
                    help="write a jax.profiler (TensorBoard) trace here")
+    p.add_argument("--telemetry-dir",
+                   help="write a rank-0 JSONL run journal (config, phase "
+                        "timings, per-coordinate convergence rows, compile/"
+                        "HBM gauges) here")
     p.add_argument("--compact-random-effect-threshold", type=int,
                    default=DEFAULT_COMPACT_RE_THRESHOLD,
                    help="warm-start RE models over this feature-space size "
@@ -666,6 +725,7 @@ def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
         checkpoint_every=args.checkpoint_every,
         resume=not args.no_resume,
         profile_dir=args.profile_dir,
+        telemetry_dir=args.telemetry_dir,
         compact_random_effect_threshold=args.compact_random_effect_threshold,
         distributed=args.distributed or bool(args.mesh),
         mesh_shape=_parse_mesh_shape(args.mesh),

@@ -30,10 +30,27 @@ from photon_ml_tpu.io.data_reader import FeatureShardConfiguration, read_merged
 from photon_ml_tpu.io.model_io import write_glm_text
 from photon_ml_tpu.ops.normalization import NormalizationType, build_normalization
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+from photon_ml_tpu.telemetry import RunJournal, SolverTelemetry, default_registry
+from photon_ml_tpu.telemetry.probes import CompileMonitor
+from photon_ml_tpu.telemetry.solver_trace import reset_solver_metrics
 from photon_ml_tpu.types import TaskType
-from photon_ml_tpu.util import PhotonLogger, Timed
+from photon_ml_tpu.util import (
+    EventEmitter,
+    PhotonLogger,
+    SetupEvent,
+    Timed,
+    TrainingFinishEvent,
+    TrainingStartEvent,
+)
+from photon_ml_tpu.util.timed import reset_timings, timing_summary
 
 logger = logging.getLogger(__name__)
+
+#: process-wide emitter; external telemetry registers listeners here — the
+#: reference emitted PhotonSetupEvent/TrainingStart/Finish and per-update
+#: PhotonOptimizationLogEvents from Driver.scala:120-393, which this driver
+#: previously had no wiring for (only the GAME driver did)
+events = EventEmitter()
 
 
 class DriverStage(enum.Enum):
@@ -80,6 +97,10 @@ class GLMDriverParams:
     #: name/term (+ optional lowerBound/upperBound), "*" wildcards allowed
     coefficient_box_constraints: str | None = None
     input_format: str = "avro"
+    #: structured-telemetry output dir: a JSONL run journal (phase timings,
+    #: per-λ convergence rows, compile-count gauge) finalized on completion;
+    #: None = disabled
+    telemetry_dir: str | None = None
 
 
 @dataclasses.dataclass
@@ -116,6 +137,54 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
             "normalization"
         )
     os.makedirs(params.output_dir, exist_ok=True)
+    # per-run phase timings + solver tallies (sweeps may call run() repeatedly)
+    reset_timings()
+    reset_solver_metrics()
+    journal = (
+        RunJournal(params.telemetry_dir) if params.telemetry_dir else None
+    )
+    # journal + registry are opt-in via --telemetry-dir; the emitter rides
+    # along unconditionally (per-λ OptimizationLogEvents for any registered
+    # listener). SolverTelemetry builds nothing — paying no host reads —
+    # unless one of those sinks would actually consume the record.
+    telemetry = SolverTelemetry(
+        journal=journal,
+        emitter=events,
+        registry=default_registry() if journal and journal.active else None,
+    )
+    config_summary = {
+        "task_type": params.task_type.name,
+        "optimizer": params.optimizer.name,
+        "regularization_weights": list(params.regularization_weights),
+        "grid_parallel": params.grid_parallel,
+        "max_iterations": params.max_iterations,
+        "tolerance": params.tolerance,
+        "normalization": params.normalization.name,
+    }
+    events.send(SetupEvent(config_summary=json.dumps(config_summary)))
+    events.send(TrainingStartEvent(job_name="glm-training"))
+    if journal is not None:
+        journal.record("config", **config_summary)
+    compiles = CompileMonitor()
+    try:
+        with compiles:
+            result = _run_stages(params, telemetry)
+        events.send(TrainingFinishEvent(job_name="glm-training", succeeded=True))
+        return result
+    except Exception:
+        events.send(TrainingFinishEvent(job_name="glm-training", succeeded=False))
+        raise
+    finally:
+        # journal phase timings / gauges on failure too — a failed run's
+        # journal is the one that most needs them
+        if journal is not None:
+            journal.record_timings(timing_summary())
+            journal.record_gauge("jax/backend_compile_count", compiles.count)
+            journal.record_metrics(default_registry().snapshot())
+            journal.close()
+
+
+def _run_stages(params: GLMDriverParams, telemetry: SolverTelemetry) -> GLMDriverResult:
     stage = DriverStage.INIT
     shard_cfg = {"features": FeatureShardConfiguration(feature_bags=("features",))}
 
@@ -163,7 +232,7 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
                 params.coefficient_box_constraints, index_maps["features"]
             )
 
-        def fit(b: LabeledPointBatch, lams) -> dict:
+        def fit(b: LabeledPointBatch, lams, tel=None) -> dict:
             trainer = train_glm_grid if params.grid_parallel else train_glm
             return trainer(
                 b,
@@ -176,10 +245,13 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
                 compute_variance=params.compute_variance,
                 lower_bounds=lower_bounds,
                 upper_bounds=upper_bounds,
+                telemetry=tel,
             )
 
         with Timed("glm train"):
-            models = fit(batch, params.regularization_weights)
+            # telemetry only on the primary grid: diagnostics re-fits below
+            # would repeat per-λ convergence rows
+            models = fit(batch, params.regularization_weights, tel=telemetry)
         stage = DriverStage.TRAINED
         write_glm_text(
             os.path.join(params.output_dir, "models-text"),
@@ -297,6 +369,9 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
                         '"term": "", "lowerBound": 0}]\'; "*" wildcards '
                         "match all features / all terms of a name")
     p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
+    p.add_argument("--telemetry-dir",
+                   help="write a JSONL run journal (phase timings, per-λ "
+                        "convergence rows, compile counts) here")
     args = p.parse_args(argv)
     return run(
         GLMDriverParams(
@@ -319,6 +394,7 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
             grid_parallel=args.grid_parallel,
             coefficient_box_constraints=args.coefficient_box_constraints,
             input_format=args.input_format,
+            telemetry_dir=args.telemetry_dir,
         )
     )
 

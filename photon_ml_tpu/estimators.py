@@ -165,6 +165,10 @@ class GameEstimator:
     #: True forces it (interpret mode off-TPU; what the virtual-mesh tests
     #: use), False disables it.
     use_pallas: bool | None = None
+    #: optional telemetry.SolverTelemetry: per-coordinate, per-outer-
+    #: iteration convergence rows / OptimizationLogEvents from the CD loop
+    #: (the drivers thread their run journal + event emitter through here)
+    telemetry: object | None = None
 
     def fit(
         self,
@@ -285,6 +289,7 @@ class GameEstimator:
             checkpoint_every=self.checkpoint_every,
             resume=self.resume,
             check_finite=self.check_finite,
+            telemetry=self.telemetry,
         )
 
     def _fit_distributed(
@@ -697,6 +702,14 @@ class GameEstimator:
             to_game_model(result.best_state)
             if result.best_state is not None else final_model
         )
+        if self.telemetry is not None:
+            # the fused step carries no per-lane solver state out of the
+            # SPMD program; report what the sweep loop does surface —
+            # per-sweep evaluation metrics under a synthetic coordinate id
+            for i, m in enumerate(result.metric_history or []):
+                self.telemetry.record_coordinate(
+                    "fused-sweep", i, None, metrics=m
+                )
         return CoordinateDescentResult(
             model=final_model,
             best_model=best_model,
@@ -772,9 +785,14 @@ def train_glm_grid(
     variance_mode: str = "auto",
     lower_bounds=None,
     upper_bounds=None,
+    telemetry=None,
 ) -> dict[float, GeneralizedLinearModel]:
     """Train the whole regularization grid *simultaneously* with vmapped
     solver lanes.
+
+    telemetry: optional ``telemetry.SolverTelemetry`` — reports per-λ-lane
+    convergence rows plus the cross-lane convergence-reason tally (the
+    "every lane pays max_iter" pathology made visible, CLAUDE.md).
 
     TPU-native alternative to the reference's sequential warm-start fold
     (ModelTraining.scala:202-220, mirrored by :func:`train_glm`): all λ
@@ -843,6 +861,10 @@ def train_glm_grid(
         optimizer.max_iterations, optimizer.tolerance, batch, l2s, l1s,
         bounds,
     )
+    if telemetry is not None:
+        telemetry.record_lanes(
+            "glm-grid", results, keys=[{"lambda": lam} for lam in lams]
+        )
     norm = objective.normalization
     lane_variances = None
     if compute_variance:
@@ -950,6 +972,7 @@ def train_glm(
     variance_mode: str = "auto",
     lower_bounds=None,
     upper_bounds=None,
+    telemetry=None,
 ) -> dict[float, GeneralizedLinearModel]:
     """Single-GLM regularization path with warm starts.
 
@@ -958,6 +981,9 @@ def train_glm(
     elastic_net_alpha: fraction of λ on L1 (α λ ‖w‖₁ + (1-α) λ/2 ‖w‖²).
     Returned models are in original feature space (warm starts stay in
     normalized space internally).
+
+    telemetry: optional ``telemetry.SolverTelemetry`` — one convergence row
+    (iterations, reason, value history) per λ solve.
     """
     optimizer = optimizer or OptimizerConfig()
     validate_variance_mode(variance_mode)
@@ -991,6 +1017,8 @@ def train_glm(
             upper_bounds=None if upper_bounds is None else jnp.asarray(upper_bounds, batch.dtype),
         )
         w = result.coefficients
+        if telemetry is not None:
+            telemetry.record_solve("glm", result, extra={"lambda": lam})
         norm = objective.normalization
         means = norm.to_model_space(w, intercept_index)
         variances = None

@@ -1,5 +1,9 @@
 """Compile-on-demand loader for the native C++ libraries.
 
+No reference analogue: the reference shipped JVM bytecode and leaned on
+PalDB/off-heap JNI jars; this build's native components compile from
+vendored C++ at first use instead.
+
 Each .so is built once from its .cpp with the system g++ and cached next to
 the source (rebuilt when the source changes, keyed by mtime+size).
 Everything degrades gracefully: the ``*_available()`` probes return False

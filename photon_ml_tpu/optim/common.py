@@ -72,6 +72,59 @@ class SolverResult:
         return "\n".join(lines)
 
 
+@flax.struct.dataclass
+class LaneTrace:
+    """Per-lane convergence scalars of a vmapped solve (one entry per solver
+    lane: a λ-grid point or a random-effect entity).
+
+    The jittable skeleton of the reference's per-problem
+    OptimizationStatesTracker reporting (OptimizationStatesTracker.scala:
+    82-101): vmapped solves cannot keep per-iteration host-side state, but
+    XLA computes each lane's final iteration count / reason / value anyway —
+    these are those scalars surfaced as tiny extra outputs. ``valid`` masks
+    padding lanes (OOB-sentinel entity rows solve all-zero-weight batches
+    and must not pollute convergence tallies). Consumed by
+    telemetry/solver_trace.py for reason tallies across lanes — the
+    "every lane pays max_iter" pathology (CLAUDE.md) made visible.
+    """
+
+    iterations: Array  # [lanes] int32
+    reason: Array  # [lanes] int32 ConvergenceReason codes
+    value: Array  # [lanes] final objective values
+    gradient_norm: Array  # [lanes]
+    valid: Array  # [lanes] bool; False = padding lane
+
+
+class LaneTraces:
+    """Per-bucket LaneTraces held AS the device arrays the solves returned.
+
+    Deliberately not a pytree and never merged on device: eager
+    ``jnp.concatenate`` dispatches cost a ~100 ms tunnel round-trip each on
+    the remote-TPU platform (CLAUDE.md), so the merge happens host-side in
+    numpy — and only when a telemetry consumer actually reads the traces
+    (telemetry/solver_trace.py). A coordinate update with no telemetry
+    attached pays nothing for carrying this object.
+    """
+
+    def __init__(self, buckets):
+        self.buckets: tuple[LaneTrace, ...] = tuple(buckets)
+
+
+def lane_trace_of(result: SolverResult, valid: Array | None = None) -> LaneTrace:
+    """Build a LaneTrace from a (vmapped) SolverResult, dropping the
+    per-iteration histories that padding lanes would make meaningless."""
+    iterations = jnp.atleast_1d(result.iterations)
+    if valid is None:
+        valid = jnp.ones(iterations.shape, dtype=bool)
+    return LaneTrace(
+        iterations=iterations,
+        reason=jnp.atleast_1d(result.reason),
+        value=jnp.atleast_1d(result.value),
+        gradient_norm=jnp.atleast_1d(result.gradient_norm),
+        valid=jnp.atleast_1d(valid),
+    )
+
+
 def check_convergence(
     *,
     value: Array,

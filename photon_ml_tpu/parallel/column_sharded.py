@@ -35,6 +35,8 @@ from functools import partial
 import flax.struct
 import jax
 import jax.numpy as jnp
+
+from photon_ml_tpu.parallel.mesh import shard_map
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -265,7 +267,7 @@ class ColumnShardedGLMObjective:
                 )
             return total
 
-        return jax.shard_map(
+        return shard_map(
             f, out_specs=P(), **self._shard_spec()
         )(w.reshape(batch.num_blocks, batch.block), *self._batch_args(batch))
 
@@ -292,7 +294,7 @@ class ColumnShardedGLMObjective:
                 g_l = g_l + self.l2_weight * w_l[0]
             return total, g_l[None, :]
 
-        value, grad = jax.shard_map(
+        value, grad = shard_map(
             f, out_specs=(P(), P("model", None)), **self._shard_spec()
         )(w.reshape(batch.num_blocks, batch.block), *self._batch_args(batch))
         return value, grad.reshape(-1)
@@ -324,7 +326,7 @@ class ColumnShardedGLMObjective:
         spec = self._shard_spec()
         spec["in_specs"] = (P("model"),) + spec["in_specs"]
         k, b = batch.num_blocks, batch.block
-        hv = jax.shard_map(f, out_specs=P("model", None), **spec)(
+        hv = shard_map(f, out_specs=P("model", None), **spec)(
             w.reshape(k, b), v.reshape(k, b), *self._batch_args(batch)
         )
         return hv.reshape(-1)

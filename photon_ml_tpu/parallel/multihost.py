@@ -1,9 +1,11 @@
 """Multi-host / multi-slice process coordination and hybrid meshes.
 
-The reference's multi-node story is the Spark driver/executor runtime: YARN
-launches executors, the driver coordinates, and all communication is shuffle/
-broadcast/treeAggregate (SURVEY.md §2.5 — "Distributed communication
-backend"). The TPU-native equivalent is:
+No reference analogue as code: the reference's multi-node story is the
+Spark driver/executor runtime (cluster bootstrap belonged to spark-submit
+and YARN, not to any photon-ml source file) — YARN launches executors, the
+driver coordinates, and all communication is shuffle/broadcast/treeAggregate
+(SURVEY.md §2.5 — "Distributed communication backend"). The TPU-native
+equivalent is:
 
 - process coordination: ``jax.distributed.initialize`` — every host runs the
   same SPMD program, a coordinator rendezvouses them (this file);

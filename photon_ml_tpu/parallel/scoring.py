@@ -23,6 +23,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from photon_ml_tpu.parallel.mesh import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.data.game_data import GameDataset, pad_game_dataset
@@ -347,7 +349,7 @@ class DistributedScorer:
             )
             return accumulate(mesh_k - 1, blk, acc)
 
-        return jax.shard_map(
+        return shard_map(
             body,
             mesh=self.mesh,
             in_specs=(P("data", None), P("data", None), P("data")),

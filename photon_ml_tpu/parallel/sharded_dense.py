@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from photon_ml_tpu.parallel.mesh import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from photon_ml_tpu.data.batch import LabeledPointBatch
@@ -127,7 +129,7 @@ class ShardedDenseGLMObjective:
             local = self._local.value(w_, LabeledPointBatch(x, y, o, ws))
             return jax.lax.psum(local, self.data_axis)
 
-        total = jax.shard_map(f, out_specs=P(), **self._spec())(
+        total = shard_map(f, out_specs=P(), **self._spec())(
             w, *self._args(batch)
         )
         if self.l2_weight > 0.0:
@@ -148,7 +150,7 @@ class ShardedDenseGLMObjective:
                 jax.lax.psum(g, self.data_axis),
             )
 
-        value, grad = jax.shard_map(f, out_specs=(P(), P()), **self._spec())(
+        value, grad = shard_map(f, out_specs=(P(), P()), **self._spec())(
             w, *self._args(batch)
         )
         if self.l2_weight > 0.0:
@@ -169,7 +171,7 @@ class ShardedDenseGLMObjective:
 
         spec = self._spec()
         spec["in_specs"] = (P(),) + spec["in_specs"]
-        hv = jax.shard_map(f, out_specs=P(), **spec)(
+        hv = shard_map(f, out_specs=P(), **spec)(
             w, v, *self._args(batch)
         )
         if self.l2_weight > 0.0:

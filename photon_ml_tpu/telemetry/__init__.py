@@ -1,0 +1,77 @@
+"""Structured run telemetry: metrics registry, JSONL run journal, solver
+convergence tracing, device/runtime probes.
+
+Reference parity: the PhotonLogger / OptimizationStatesTracker /
+PhotonOptimizationLogEvent triple (photon-lib util/PhotonLogger.scala:34-90,
+OptimizationStatesTracker.scala:82-101, photon-client event/ emitted from
+Driver.scala:120-393) rebuilt as one subsystem the whole stack emits
+through — see each submodule's docstring for its slice of the map.
+"""
+
+from photon_ml_tpu.telemetry.journal import JOURNAL_FILENAME, RunJournal, json_safe
+from photon_ml_tpu.telemetry.probes import (
+    GATE_REPS,
+    CompileMonitor,
+    MarginalResult,
+    MarginalTimer,
+    compile_count,
+    install_compile_listener,
+    live_buffer_bytes,
+    median_spread,
+    read_scalar,
+    scan_step_marginal,
+    stream_calibration,
+)
+from photon_ml_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+# solver_trace pulls jax/flax (via optim.common); load it lazily so that
+# importing the registry/journal/probes side of telemetry — which util.timed
+# does on every import — stays jax-free (the drivers/conftest configure the
+# platform before jax ever loads).
+_LAZY = {
+    "SolverTelemetry": "photon_ml_tpu.telemetry.solver_trace",
+    "lane_rows": "photon_ml_tpu.telemetry.solver_trace",
+    "lane_summary": "photon_ml_tpu.telemetry.solver_trace",
+    "solver_result_row": "photon_ml_tpu.telemetry.solver_trace",
+}
+
+
+def __getattr__(name: str):
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)
+
+
+__all__ = [
+    "JOURNAL_FILENAME",
+    "RunJournal",
+    "json_safe",
+    "GATE_REPS",
+    "CompileMonitor",
+    "MarginalResult",
+    "MarginalTimer",
+    "compile_count",
+    "install_compile_listener",
+    "live_buffer_bytes",
+    "median_spread",
+    "read_scalar",
+    "scan_step_marginal",
+    "stream_calibration",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "SolverTelemetry",
+    "lane_rows",
+    "lane_summary",
+    "solver_result_row",
+]

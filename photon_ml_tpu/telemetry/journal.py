@@ -1,0 +1,168 @@
+"""Rank-0-only JSONL run journal, atomically finalized into the output dir.
+
+Reference parity: photon-lib util/PhotonLogger.scala:34-90 (spool locally,
+publish to the final destination on close) crossed with
+PhotonOptimizationLogEvent / OptimizationStatesTracker.scala:82-101 (the
+structured per-coordinate optimization telemetry the reference emitted to
+external listeners). Here both become one machine-parseable artifact: every
+driver/estimator/bench phase appends typed records (phase timings,
+convergence rows, calibration probes, config summaries) to a local spool
+file, and ``close()`` moves it atomically to ``<dir>/run-journal.jsonl``.
+
+Multi-process discipline (CLAUDE.md): only rank 0 touches shared output
+directories, while collectives must still run on EVERY rank — so a journal
+constructed on rank > 0 is inert (all methods are no-ops) and callers never
+need to branch on rank themselves (which would tempt them to skip
+collectives inside ``if journal:`` blocks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import math
+import os
+import tempfile
+import time
+
+JOURNAL_FILENAME = "run-journal.jsonl"
+
+
+def _process_index() -> int:
+    """Current rank; 0 when jax is absent or uninitialized (single host)."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def json_safe(obj):
+    """Recursively coerce to strict-JSON values: numpy/jax scalars and
+    arrays, enums, dataclasses; NaN/Inf become None (the driver summary
+    convention, cli/game_training_driver.py)."""
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, enum.Enum):
+        return obj.name
+    if isinstance(obj, dict):
+        return {str(k): json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [json_safe(v) for v in obj]
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return json_safe(dataclasses.asdict(obj))
+    # numpy / jax scalars and arrays without importing either eagerly
+    item = getattr(obj, "item", None)
+    shape = getattr(obj, "shape", None)
+    if item is not None and shape == ():
+        return json_safe(item())
+    tolist = getattr(obj, "tolist", None)
+    if tolist is not None:
+        return json_safe(tolist())
+    return str(obj)
+
+
+class RunJournal:
+    """``with RunJournal(out_dir) as j: j.record("phase_timing", ...)``.
+
+    Records are dicts with a ``kind`` plus caller fields; ``seq`` and ``ts``
+    are stamped automatically. Inactive (rank > 0, or ``directory=None``)
+    journals accept every call and write nothing.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike | None,
+        *,
+        filename: str = JOURNAL_FILENAME,
+        rank: int | None = None,
+    ):
+        self.directory = None if directory is None else str(directory)
+        self.filename = filename
+        self.rank = _process_index() if rank is None else int(rank)
+        self._seq = 0
+        self._spool = None
+        self._closed = False
+        if self.active:
+            self._spool = tempfile.NamedTemporaryFile(
+                mode="w", suffix=".jsonl", prefix="photon-journal-",
+                delete=False,
+            )
+            self.record("journal_open", pid=os.getpid(), rank=self.rank)
+
+    @property
+    def active(self) -> bool:
+        return self.directory is not None and self.rank == 0 and not self._closed
+
+    @property
+    def path(self) -> str | None:
+        """Final journal path (exists only after ``close()``)."""
+        if self.directory is None:
+            return None
+        return os.path.join(self.directory, self.filename)
+
+    def record(self, kind: str, **fields) -> None:
+        if not self.active:
+            return
+        row = {"kind": kind, "seq": self._seq, "ts": time.time()}
+        row.update(json_safe(fields))
+        self._seq += 1
+        self._spool.write(json.dumps(row, allow_nan=False) + "\n")
+        self._spool.flush()
+
+    def record_timings(self, timings: dict[str, dict[str, float]]) -> None:
+        """One ``phase_timing`` row per named phase — the shape
+        ``util.timed.timing_summary()`` returns."""
+        for name, summary in timings.items():
+            self.record("phase_timing", name=name, **summary)
+
+    def record_metrics(self, snapshot: dict) -> None:
+        """Persist a full ``MetricsRegistry.snapshot()``."""
+        self.record("metrics", snapshot=snapshot)
+
+    def record_gauge(self, name: str, value) -> None:
+        self.record("gauge", name=name, value=value)
+
+    def close(self) -> None:
+        """Atomically publish the spool as ``<directory>/<filename>``."""
+        if self._closed or self._spool is None:
+            self._closed = True
+            return
+        self.record("journal_close", records=self._seq)
+        self._closed = True
+        self._spool.flush()
+        os.fsync(self._spool.fileno())
+        self._spool.close()
+        os.makedirs(self.directory, exist_ok=True)
+        # stage into the destination directory first: os.replace is atomic
+        # only within one filesystem, and the spool lives in the system tmp
+        fd, staged = tempfile.mkstemp(
+            dir=self.directory, prefix=".journal-", suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "wb") as dst, open(self._spool.name, "rb") as src:
+                dst.write(src.read())
+            os.replace(staged, self.path)
+        except BaseException:
+            if os.path.exists(staged):
+                os.unlink(staged)
+            raise
+        finally:
+            os.unlink(self._spool.name)
+
+    def __enter__(self) -> "RunJournal":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    @staticmethod
+    def read(path: str | os.PathLike) -> list[dict]:
+        """Parse a finalized journal back into a list of record dicts."""
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]

@@ -1,0 +1,252 @@
+"""Device/runtime probes: compile counts, HBM bytes, marginal timing.
+
+Reference parity: no reference analogue — Photon-ML leaned on the Spark UI
+for executor/runtime attribution (SURVEY.md §5); on the tunneled TPU
+platform the measurement discipline itself is load-bearing and lives here
+as a library instead of inside ``bench.py``:
+
+- ``MarginalTimer`` / ``scan_step_marginal``: the BASELINE.md methodology —
+  K_hi-vs-K_lo differencing with host-read synchronization, because
+  per-call tunnel dispatch is ~80-110 ms with tens of ms of jitter and
+  ``block_until_ready`` does not synchronize on this platform (CLAUDE.md).
+- ``stream_calibration``: the same-run chip-speed probe
+  (``fe_hot_loop_stream_gbps``) as a callable, so ANY experiment can
+  normalize its marginals against this run's chip instead of comparing
+  absolute GB/s across the chip-lottery pool.
+- ``install_compile_listener`` / ``CompileMonitor``: jax.monitoring hook
+  counting backend compiles (recompilation storms are a classic silent
+  perf pathology under vmap/jit churn).
+- ``live_buffer_bytes``: live device-buffer HBM bytes (allocator stats on
+  real TPUs, live-array sum on backends without ``memory_stats``).
+
+Everything imports jax lazily so this module is safe to import before the
+platform is chosen (bench.py / driver startup order).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Callable
+
+import numpy as np
+
+from photon_ml_tpu.telemetry.registry import default_registry
+
+#: median-of-K reps for gate metrics (chip-lottery pool: single-shot numbers
+#: swing ~2x between back-to-back reps — BASELINE.md tenancy study)
+GATE_REPS = 3
+
+
+def median_spread(measure_once: Callable[[], float], reps: int = GATE_REPS):
+    """Run a marginal measurement ``reps`` times; return
+    (median, [min, max]). The spread is the honest error bar for
+    round-over-round comparisons on the shared-chip pool."""
+    vals = [measure_once() for _ in range(reps)]
+    return statistics.median(vals), [min(vals), max(vals)]
+
+
+def read_scalar(x) -> float:
+    """Host-read synchronization point: returns float(x), forcing the device
+    stream to drain. The ONLY reliable sync on tunneled platforms."""
+    return float(np.asarray(x).ravel()[0])
+
+
+@dataclasses.dataclass
+class MarginalResult:
+    median: float  # marginal seconds per unit of work
+    spread: list  # [min, max] across reps
+
+
+@dataclasses.dataclass
+class MarginalTimer:
+    """K_hi-vs-K_lo marginal differencing over an arbitrary timed unit.
+
+    ``measure(timed_k)`` calls ``timed_k(k)`` — which must run ``k`` units
+    of work and return elapsed seconds, ending on a host read (use
+    :func:`read_scalar`) — and returns the per-unit marginal
+    ``(t(k_hi) - t(k_lo)) / (k_hi - k_lo)`` as a median-of-``reps`` with
+    [min, max] spread. Differencing cancels the fixed per-call dispatch
+    cost; ``k_hi - k_lo`` must be large enough that device time dwarfs the
+    dispatch jitter (an 80-eval spread has produced NEGATIVE marginals —
+    CLAUDE.md)."""
+
+    k_lo: int = 1
+    k_hi: int = 5
+    reps: int = GATE_REPS
+    floor: float = 1e-6
+
+    def __post_init__(self):
+        if self.k_hi <= self.k_lo:
+            raise ValueError(f"k_hi ({self.k_hi}) must exceed k_lo ({self.k_lo})")
+
+    def measure(self, timed_k: Callable[[int], float]) -> MarginalResult:
+        def once() -> float:
+            lo = timed_k(self.k_lo)
+            hi = timed_k(self.k_hi)
+            return max((hi - lo) / (self.k_hi - self.k_lo), self.floor)
+
+        median, spread = median_spread(once, self.reps)
+        return MarginalResult(median=median, spread=spread)
+
+
+def scan_step_marginal(
+    step_fn,
+    operand,
+    dim: int,
+    *,
+    k_lo: int = 16,
+    k_hi: int = 256,
+    reps: int = GATE_REPS,
+    warmups: int = 4,
+    rng=None,
+) -> tuple[float, list]:
+    """Marginal seconds per evaluation of ``step_fn(w, operand) -> (w', v)``.
+
+    K evaluations run inside ONE jit via ``lax.scan`` (so the K_hi-K_lo
+    delta is pure device time), every step consumes the carry (defeats
+    XLA loop-invariant hoisting — CLAUDE.md), warm starts are perturbed per
+    rep (some backends cache repeat executions), and timing ends on a host
+    read. Returns ``(median, [min, max])`` like :func:`median_spread`."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7) if rng is None else rng
+
+    def timed(k: int) -> float:
+        @jax.jit
+        def run(w0, op):
+            w, vs = jax.lax.scan(
+                lambda w, _: step_fn(w, op), w0, None, length=k
+            )
+            return vs.sum() + w.sum()
+
+        float(run(jnp.zeros(dim, jnp.float32), operand))  # compile + sync
+        best = None
+        for _ in range(warmups):
+            w0 = jnp.asarray(rng.normal(size=dim).astype(np.float32)) * 0.01
+            t0 = time.perf_counter()
+            float(run(w0, operand))
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    return median_spread(
+        lambda: max((timed(k_hi) - timed(k_lo)) / (k_hi - k_lo), 1e-6), reps
+    )
+
+
+def stream_calibration(
+    features,
+    *,
+    k_lo: int = 16,
+    k_hi: int = 256,
+    reps: int = GATE_REPS,
+    rng=None,
+) -> dict:
+    """Same-run chip-speed calibration: achieved GB/s of one [n, d] matvec
+    X read per step. The pool's chips vary run to run (567-747 GB/s across
+    rounds of one process — BASELINE.md), so hot-loop fractions are only
+    meaningful against THIS probe measured in the same process. Note the
+    probe is an XLA matvec and slightly underestimates peak (the Pallas
+    kernel sustains ~1.1x it), so fractions > 1.0 are real."""
+    import jax.numpy as jnp
+
+    n, d = features.shape
+    xbytes = n * d * features.dtype.itemsize
+
+    def step(w, x):
+        return w + jnp.sum(x @ w) * 1e-30, jnp.float32(0)
+
+    marginal, spread = scan_step_marginal(
+        step, features, d, k_lo=k_lo, k_hi=k_hi, reps=reps, rng=rng
+    )
+    return {
+        "gbps": xbytes / marginal / 1e9,
+        "spread_gbps": [xbytes / s / 1e9 for s in spread[::-1]],
+        "marginal_sec": marginal,
+        "spread_sec": spread,
+        "bytes_per_eval": xbytes,
+        "n": int(n),
+        "d": int(d),
+    }
+
+
+# --- compile-event monitoring (jax.monitoring) ------------------------------
+
+_COMPILE_COUNTER = "jax/backend_compile_count"
+_COMPILE_SECONDS = "jax/backend_compile_seconds"
+#: registries that already have a listener feeding them (the listener holds
+#: a strong reference, so the id() stays unique for the registry's lifetime)
+_installed_registry_ids: set[int] = set()
+
+
+def install_compile_listener(registry=None) -> None:
+    """Idempotently (per registry) install a jax.monitoring duration
+    listener that counts backend compiles into the metrics registry.
+    jax.monitoring has no targeted unregister, so each listener installs
+    once per (process, registry) and stays."""
+    reg = registry or default_registry()
+    if id(reg) in _installed_registry_ids:
+        return
+    import jax.monitoring
+
+    def _on_duration(name: str, secs: float, **kw) -> None:
+        if "backend_compile" in name:
+            reg.counter(_COMPILE_COUNTER).inc()
+            reg.histogram(_COMPILE_SECONDS).observe(secs)
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _installed_registry_ids.add(id(reg))
+
+
+def compile_count(registry=None) -> int:
+    """Backend compiles observed since :func:`install_compile_listener`."""
+    reg = registry or default_registry()
+    return reg.counter(_COMPILE_COUNTER).value
+
+
+class CompileMonitor:
+    """``with CompileMonitor() as cm: ...; cm.count`` — compiles (and compile
+    seconds) attributable to the enclosed block."""
+
+    def __init__(self, registry=None):
+        self.registry = registry or default_registry()
+        # snapshot at construction too, so count/seconds are well-defined
+        # even when read from a finally block after __enter__ failed
+        self._count0 = self.registry.counter(_COMPILE_COUNTER).value
+        self._secs0 = self.registry.histogram(_COMPILE_SECONDS).total
+
+    def __enter__(self) -> "CompileMonitor":
+        install_compile_listener(self.registry)
+        self._count0 = self.registry.counter(_COMPILE_COUNTER).value
+        self._secs0 = self.registry.histogram(_COMPILE_SECONDS).total
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    @property
+    def count(self) -> int:
+        return self.registry.counter(_COMPILE_COUNTER).value - self._count0
+
+    @property
+    def seconds(self) -> float:
+        return self.registry.histogram(_COMPILE_SECONDS).total - self._secs0
+
+
+def live_buffer_bytes(device=None) -> int:
+    """Live device-buffer bytes: allocator ``bytes_in_use`` where the
+    backend exposes memory_stats (real TPUs), else the sum over
+    ``jax.live_arrays()`` (virtual CPU meshes)."""
+    import jax
+
+    dev = device or jax.local_devices()[0]
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    if stats and "bytes_in_use" in stats:
+        return int(stats["bytes_in_use"])
+    return int(sum(a.nbytes for a in jax.live_arrays()))

@@ -59,11 +59,23 @@ class EventEmitter:
     def __init__(self):
         self._listeners: list[EventListener] = []
 
+    @property
+    def has_listeners(self) -> bool:
+        """True when a send() would reach anyone — producers that must pay
+        real cost (host reads) to BUILD an event check this first."""
+        return bool(self._listeners)
+
     def register(self, listener: EventListener) -> None:
         self._listeners.append(listener)
 
     def unregister(self, listener: EventListener) -> None:
-        self._listeners.remove(listener)
+        """Idempotent: unregistering a never-registered (or already removed)
+        listener is a no-op — driver cleanup paths unregister defensively
+        and must not die on a ValueError."""
+        try:
+            self._listeners.remove(listener)
+        except ValueError:
+            pass
 
     def send(self, event: Event) -> None:
         for listener in self._listeners:

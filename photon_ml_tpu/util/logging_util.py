@@ -39,13 +39,16 @@ class PhotonLogger:
             logging.Formatter("%(asctime)s %(levelname)s %(name)s - %(message)s")
         )
         self._handler.setLevel(level)
-        self._attached: list[logging.Logger] = []
+        #: (logger, level it had before attach) — restored on close, so a
+        #: job log cannot permanently lower a captured logger's level
+        self._attached: list[tuple[logging.Logger, int]] = []
 
         def attach(lg: logging.Logger) -> None:
+            prior_level = lg.level
             if lg.level == logging.NOTSET or lg.level > level:
                 lg.setLevel(level)
             lg.addHandler(self._handler)
-            self._attached.append(lg)
+            self._attached.append((lg, prior_level))
 
         attach(logging.getLogger(capture_logger))
         self.logger = logging.getLogger(name)
@@ -69,8 +72,9 @@ class PhotonLogger:
         if self._closed:
             return
         self._closed = True
-        for lg in self._attached:
+        for lg, prior_level in self._attached:
             lg.removeHandler(self._handler)
+            lg.setLevel(prior_level)
         self._handler.close()
         os.makedirs(os.path.dirname(self.destination_path) or ".", exist_ok=True)
         shutil.copyfile(self._tmp.name, self.destination_path)

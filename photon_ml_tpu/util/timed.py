@@ -2,9 +2,10 @@
 
 Reference parity: photon-lib util/Timed.scala:33-77 — ``Timed("name"){...}``
 logs the duration of the block; used pervasively by the drivers and the
-coordinate-descent loop. Here a context manager / decorator; durations are
-also collected in a process-wide registry so drivers can print a phase
-summary, and each block emits a jax.profiler StepTraceAnnotation so phases
+coordinate-descent loop. Here a context manager / decorator; durations feed
+the process-wide metrics registry (telemetry/registry.py histograms under
+``timing/<name>``) so drivers can print a phase summary with distribution
+stats, and each block emits a jax.profiler StepTraceAnnotation so phases
 line up with device traces in TensorBoard.
 """
 
@@ -13,13 +14,14 @@ from __future__ import annotations
 import contextlib
 import logging
 import time
-from collections import defaultdict
 from functools import wraps
+
+from photon_ml_tpu.telemetry.registry import default_registry
 
 logger = logging.getLogger("photon_ml_tpu.timing")
 
-#: name -> list of durations (seconds)
-_TIMINGS: dict[str, list[float]] = defaultdict(list)
+#: registry namespace for phase timings
+_TIMING_PREFIX = "timing/"
 
 
 class Timed(contextlib.AbstractContextManager):
@@ -46,7 +48,9 @@ class Timed(contextlib.AbstractContextManager):
         self.duration = time.perf_counter() - self._start
         if self._annotation is not None:
             self._annotation.__exit__(exc_type, exc, tb)
-        _TIMINGS[self.name].append(self.duration)
+        default_registry().histogram(_TIMING_PREFIX + self.name).observe(
+            self.duration
+        )
         logger.log(self.log_level, "%s took %.3f s", self.name, self.duration)
         return False
 
@@ -88,17 +92,14 @@ def profile_trace(log_dir: str | None):
 
 
 def timing_summary() -> dict[str, dict[str, float]]:
-    """name -> {count, total, mean} over everything timed so far."""
+    """name -> {count, total, mean, min, max, p50, p95} over everything
+    timed so far (the ``timing/`` histograms of the metrics registry)."""
     return {
-        name: {
-            "count": len(durations),
-            "total": sum(durations),
-            "mean": sum(durations) / len(durations),
-        }
-        for name, durations in _TIMINGS.items()
-        if durations
+        name[len(_TIMING_PREFIX):]: hist.summary()
+        for name, hist in default_registry().histograms(_TIMING_PREFIX).items()
+        if hist.count
     }
 
 
 def reset_timings() -> None:
-    _TIMINGS.clear()
+    default_registry().remove_prefix(_TIMING_PREFIX)

@@ -1,0 +1,66 @@
+"""Tier-1 guard: the static parity lints (dev/lint_parity.py) stay clean.
+
+The lint enforces two CLAUDE.md conventions: every photon_ml_tpu module
+docstring cites its reference file (the SURVEY.md §2 parity contract), and
+no module calls the batch-serializing jnp.linalg decompositions outside the
+approved paths (BASELINE.md r5 Gauss-Jordan study).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_lint_parity_clean():
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "dev" / "lint_parity.py")],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"parity lint violations:\n{proc.stdout}{proc.stderr}"
+    )
+    assert "clean" in proc.stdout
+
+
+def test_lint_catches_banned_linalg(tmp_path):
+    """The AST check actually fires: a module calling jnp.linalg.cholesky
+    outside the allowlist is reported with file:line."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    pkg = tmp_path / "photon_ml_tpu" / "sub"
+    pkg.mkdir(parents=True)
+    (tmp_path / "photon_ml_tpu" / "good.py").write_text(
+        '"""Cites Foo.scala:12."""\n'
+        "import numpy as np\n"
+        "def g(h):\n"
+        "    return np.linalg.cholesky(h)  # host numpy: allowed\n"
+    )
+    (pkg / "bad.py").write_text(
+        '"""No reference analogue."""\n'
+        "import jax.numpy as jnp\n"
+        "def f(h):\n"
+        "    return jnp.linalg.cholesky(h)\n"
+    )
+    (pkg / "aliased.py").write_text(
+        '"""No reference analogue."""\n'
+        "from jax.numpy import linalg\n"
+        "def f(h, b):\n"
+        "    return linalg.solve(h, b)\n"
+    )
+    (pkg / "undocumented.py").write_text("x = 1\n")
+    problems = lint_parity.run_lints(tmp_path)
+    assert any("bad.py:4" in p and "cholesky" in p for p in problems)
+    assert any("aliased.py:4" in p and "solve" in p for p in problems)
+    assert any("undocumented.py:1" in p and "docstring" in p for p in problems)
+    assert not any("good.py" in p for p in problems)  # np.linalg not banned
