@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static parity-convention lints for photon_ml_tpu (CLAUDE.md conventions).
 
-Two checks, both pure-AST (no jax import; runs in milliseconds):
+Four checks, all pure-AST (no jax import; runs in milliseconds):
 
 1. **Docstring citations** — every ``photon_ml_tpu/**/*.py`` module (except
    ``__init__.py`` re-export shims) must carry a module docstring that
@@ -18,6 +18,22 @@ Two checks, both pure-AST (no jax import; runs in milliseconds):
    modules: ops/variance.py (single-Hessian reference-fidelity path with
    its own size gates) and algorithm/coordinates.py (one shared [k, k]
    Gram solve, not a batch).
+
+3. **Unconditional full reads in cli/** — CLI drivers must ingest through
+   the partitioned dispatcher (``io.partitioned_reader.read_partitioned``,
+   which delegates to ``read_merged`` single-process): a direct
+   ``read_merged`` in a driver silently multiplies the full-input decode
+   by the process count on multi-host runs (the r5 host-periphery
+   finding; ISSUE 2).
+
+4. **O(n) score gathers** — ``process_allgather`` funnels its operand
+   through every host; on score-sized ([n]) arrays that undoes the mesh's
+   parallelism and peaks host memory at global size. Calls are banned
+   outside the model-sized allowlisted helpers in parallel/distributed.py
+   (``_host_scores`` — the documented legacy gather for callers that want
+   the full vector — and the ``to_host`` state gathers); new score paths
+   go through ``parallel.scoring.DistributedScorer.score_partitioned`` +
+   ``io.score_writer.ShardedScoreWriter``.
 
 Exit status 0 = clean; 1 = violations (printed one per line as
 ``path:lineno: message``). Run from the repo root:
@@ -138,9 +154,86 @@ def check_banned_linalg(root: pathlib.Path) -> list[str]:
     return problems
 
 
+#: (file, function) pairs whose process_allgather calls are model-sized
+#: and reviewed: _host_scores (the documented legacy full-vector gather)
+#: and the nested to_host state gathers — a same-named function in any
+#: OTHER module does not inherit the exemption
+ALLGATHER_ALLOWED = {
+    (f"{PACKAGE}/parallel/distributed.py", "_host_scores"),
+    (f"{PACKAGE}/parallel/distributed.py", "to_host"),
+}
+
+
+def check_cli_full_reads(root: pathlib.Path) -> list[str]:
+    problems = []
+    for path in sorted((root / PACKAGE / "cli").glob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            hit = None
+            if isinstance(node, ast.ImportFrom) and any(
+                a.name == "read_merged" for a in node.names
+            ):
+                hit = "import of read_merged"
+            elif isinstance(node, ast.Name) and node.id == "read_merged":
+                hit = "read_merged"
+            elif isinstance(node, ast.Attribute) and node.attr == "read_merged":
+                hit = "read_merged"
+            if hit:
+                problems.append(
+                    f"{rel}:{node.lineno}: {hit} — CLI drivers must ingest "
+                    "through io.partitioned_reader.read_partitioned (it "
+                    "delegates to read_merged single-process; a direct "
+                    "call multiplies the full decode by the process count)"
+                )
+    return problems
+
+
+def check_score_allgathers(root: pathlib.Path) -> list[str]:
+    problems = []
+    for path in sorted((root / PACKAGE).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        tree = ast.parse(path.read_text())
+
+        stack: list[str] = []
+        hits: list[int] = []
+
+        def visit(node):
+            is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            if is_fn:
+                stack.append(node.name)
+            if (
+                (isinstance(node, ast.Attribute)
+                 and node.attr == "process_allgather")
+                or (isinstance(node, ast.Name)
+                    and node.id == "process_allgather")
+            ) and not (stack and (rel, stack[-1]) in ALLGATHER_ALLOWED):
+                hits.append(node.lineno)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_fn:
+                stack.pop()
+
+        visit(tree)
+        for lineno in hits:
+            problems.append(
+                f"{rel}:{lineno}: process_allgather outside the allowlisted "
+                "model-sized helpers — an O(n) score gather funnels the "
+                "global vector through every host; use "
+                "DistributedScorer.score_partitioned + ShardedScoreWriter, "
+                "or put a model-sized gather in an allowlisted helper"
+            )
+    return problems
+
+
 def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
     root = pathlib.Path(root) if root else pathlib.Path(__file__).resolve().parents[1]
-    return check_docstring_citations(root) + check_banned_linalg(root)
+    return (
+        check_docstring_citations(root)
+        + check_banned_linalg(root)
+        + check_cli_full_reads(root)
+        + check_score_allgathers(root)
+    )
 
 
 def main() -> int:

@@ -21,8 +21,8 @@ from photon_ml_tpu.cli.configs import (
     parse_feature_shard_config,
 )
 from photon_ml_tpu.cli.game_training_driver import _parse_mesh_shape
-from photon_ml_tpu.io.data_reader import read_merged
 from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.partitioned_reader import read_partitioned
 from photon_ml_tpu.io.model_io import DEFAULT_COMPACT_RE_THRESHOLD, load_game_model, write_scores
 from photon_ml_tpu.models.game import RandomEffectModel
 from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
@@ -46,6 +46,7 @@ def run(
     distributed: bool = False,
     mesh_shape: dict | None = None,
     fe_feature_sharded: bool = False,
+    partitioned_io: bool = False,
 ) -> dict:
     """Score ``input_data_path`` with the model at ``model_input_dir``.
 
@@ -60,8 +61,32 @@ def run(
     shards the FE coordinate's feature/coefficient axis over "model"
     (mesh model>1 implies it), so column-sharded giant-d models score
     without replicating the coefficient vector.
+
+    partitioned_io: multi-process runs decode only ~1/P of the input per
+    rank (io/partitioned_reader.py) and every rank writes its OWN
+    part-NNNNN.avro score shard into the shared output directory
+    (io/score_writer.ShardedScoreWriter — the reference's per-partition
+    ScoreProcessingUtils layout), replacing the process_allgather score
+    funnel. ``output_dir`` is then one SHARED directory; evaluators are
+    not supported on this path yet. Single-process runs are unaffected.
     """
-    os.makedirs(output_dir, exist_ok=True)
+    import jax
+
+    partitioned = partitioned_io and jax.process_count() > 1
+    if partitioned and not (distributed or mesh_shape):
+        raise ValueError("--partitioned-io requires --distributed or --mesh")
+    if partitioned and evaluators:
+        raise ValueError(
+            "--partitioned-io does not support --evaluators yet; evaluate "
+            "through the non-partitioned scoring path"
+        )
+    from photon_ml_tpu.parallel.multihost import default_exchange
+
+    exchange = default_exchange() if partitioned else None
+    if not partitioned or jax.process_index() == 0:
+        os.makedirs(output_dir, exist_ok=True)
+    if exchange is not None:
+        exchange.barrier("scoring/output_dir")
     if index_maps_dir is None:
         candidate = os.path.join(os.path.dirname(model_input_dir.rstrip("/")), "index-maps")
         index_maps_dir = candidate if os.path.isdir(candidate) else None
@@ -124,17 +149,6 @@ def run(
             set_vocab(m.col_effect_type, m.col_keys)
     re_columns = tuple(sorted(entity_vocabs))
 
-    with Timed("read scoring data"):
-        data = read_merged(
-            input_data_path,
-            feature_shards,
-            index_maps=index_maps or None,
-            random_effect_id_columns=re_columns,
-            evaluation_id_columns=evaluation_id_columns(evaluators),
-            entity_vocabs=entity_vocabs,
-            fmt=input_format,
-        )
-
     mesh = None
     if distributed or mesh_shape:
         from photon_ml_tpu.parallel.multihost import make_hybrid_mesh
@@ -148,6 +162,73 @@ def run(
             dict(zip(mesh.axis_names, mesh.devices.shape)), mesh.devices.size,
         )
 
+    pad_multiple = 1
+    if exchange is not None:
+        data_axis = int(mesh.shape["data"])
+        if data_axis % exchange.num_ranks:
+            raise ValueError(
+                f"--partitioned-io: mesh data axis {data_axis} must be a "
+                f"multiple of the process count {exchange.num_ranks}"
+            )
+        pad_multiple = data_axis // exchange.num_ranks
+
+    with Timed("read scoring data"):
+        part = read_partitioned(
+            input_data_path,
+            feature_shards,
+            exchange=exchange,
+            index_maps=index_maps or None,
+            random_effect_id_columns=re_columns,
+            evaluation_id_columns=evaluation_id_columns(evaluators),
+            entity_vocabs=entity_vocabs,
+            fmt=input_format,
+            pad_multiple=pad_multiple,
+        )
+        data = part.result
+    partition = part.partition
+
+    if partition.num_ranks > 1:
+        # partitioned scoring: the [n] score vector stays mesh-sharded end
+        # to end; each rank device-gets only its rows and writes its own
+        # part file — no process_allgather funnel, no rank-0 encode of the
+        # full output (ScoreProcessingUtils.scala per-partition layout)
+        from photon_ml_tpu.io.score_writer import ShardedScoreWriter
+        from photon_ml_tpu.parallel.scoring import DistributedScorer
+
+        with Timed("score"):
+            scorer = DistributedScorer(
+                model, mesh, fe_feature_sharded=fe_feature_sharded
+            )
+            local_scores = scorer.score_partitioned(
+                {partition.rank: data.dataset}, partition
+            )[partition.rank]
+        n_local = partition.local_n
+        with Timed("save scores"):
+            ShardedScoreWriter(
+                os.path.join(output_dir, "scores"), exchange=exchange
+            ).write(
+                local_scores,
+                model_id=model_id,
+                uids=np.asarray(data.dataset.unique_ids)[:n_local],
+                labels=np.asarray(data.dataset.host_array("labels"))[:n_local],
+                weights=np.asarray(data.dataset.host_array("weights"))[:n_local],
+            )
+        summary = {
+            "num_scored": partition.total_true_rows,
+            "num_scored_local": n_local,
+            "bytes_decoded_local": part.bytes_decoded,
+            "input_bytes_total": part.input_bytes_total,
+            "evaluations": {},
+        }
+        if jax.process_index() == 0:
+            with open(
+                os.path.join(output_dir, "scoring-summary.json"), "w"
+            ) as f:
+                from photon_ml_tpu.cli.game_training_driver import _json_safe
+
+                json.dump(_json_safe(summary), f, indent=2, default=float)
+        return summary
+
     with Timed("score"):
         scored = GameTransformer(
             model=model, evaluator_specs=tuple(evaluators),
@@ -158,8 +239,6 @@ def run(
     # multi-process rule: every rank participated in the scoring collectives
     # above (DistributedScorer gathers across processes); only rank 0
     # touches the shared output directory
-    import jax
-
     if jax.process_index() == 0:
         with Timed("save scores"):
             write_scores(
@@ -201,6 +280,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "--distributed; model>1 shards the fixed-effect "
                         "feature/coefficient axis — required for "
                         "column-sharded giant-d models)")
+    p.add_argument("--partitioned-io", action="store_true",
+                   help="multi-process runs: each rank decodes ~1/P of the "
+                        "input and writes its own part-NNNNN.avro score "
+                        "shard into the SHARED --output-dir (no "
+                        "process_allgather funnel; no --evaluators yet)")
     return p
 
 
@@ -224,6 +308,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
         compact_random_effect_threshold=args.compact_random_effect_threshold,
         distributed=args.distributed,
         mesh_shape=_parse_mesh_shape(args.mesh),
+        partitioned_io=args.partitioned_io,
     )
 
 

@@ -48,8 +48,8 @@ from photon_ml_tpu.hyperparameter.game_glue import (
     load_prior_observations,
     save_tuned_config,
 )
-from photon_ml_tpu.io.data_reader import read_merged
 from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.partitioned_reader import read_partitioned
 from photon_ml_tpu.io.model_io import (
     DEFAULT_COMPACT_RE_THRESHOLD,
     load_game_model,
@@ -132,6 +132,14 @@ class GameTrainingParams:
     #: summary, phase timings, per-coordinate convergence rows, compile and
     #: HBM gauges) finalized on completion; None = disabled
     telemetry_dir: str | None = None
+    #: partitioned host I/O (io/partitioned_reader.py): on a multi-process
+    #: run each rank decodes only ~1/P of the input bytes and feeds its
+    #: local block as addressable shards of the global arrays. Opt-in:
+    #: v1 supports dense shards + IDENTITY random effects without
+    #: normalization/validation riders, and entities spanning rank
+    #: partitions solve per-rank (entity-cluster the input for exact
+    #: full-read parity). Single-process runs are unaffected.
+    partitioned_io: bool = False
 
     def validate(self) -> None:
         """Cross-parameter checks (reference validateParams:196-298)."""
@@ -316,25 +324,85 @@ def _run_inner(
         # validate() already checked existence + shard coverage.
         prebuilt_maps = IndexMap.load_directory(params.index_maps_dir)
 
+    # the mesh exists BEFORE ingestion: partitioned reads align their
+    # per-rank blocks with the mesh's addressable shards
+    import jax
+
+    mesh = None
+    model_axis = 1
+    if params.distributed or params.mesh_shape:
+        # the multi-chip entry point: one ("data", "model") mesh over all
+        # (possibly multi-process) devices, topology-aware across slices
+        from photon_ml_tpu.parallel.multihost import make_hybrid_mesh
+
+        shape = dict(params.mesh_shape or {})
+        model_axis = int(shape.get("model", 1))
+        mesh = make_hybrid_mesh(
+            data=shape.get("data"), model=model_axis
+        )
+        job_log.info(
+            "distributed mode: mesh %s over %d devices",
+            dict(zip(mesh.axis_names, mesh.devices.shape)), mesh.devices.size,
+        )
+
+    # partitioned host I/O: each rank decodes ~1/P of the bytes
+    # (io/partitioned_reader.py). exchange/pad_multiple resolve to the
+    # trivial single-rank values unless --partitioned-io on a multi-process
+    # run, so the single-process path reads byte-identically to before.
+    exchange = None
+    pad_multiple = 1
+    if params.partitioned_io and jax.process_count() > 1:
+        from photon_ml_tpu.parallel.multihost import default_exchange
+
+        if mesh is None:
+            raise ValueError(
+                "--partitioned-io requires --distributed or --mesh (the "
+                "partitioned blocks feed a mesh's addressable shards)"
+            )
+        exchange = default_exchange()
+        data_axis = int(mesh.shape["data"])
+        if data_axis % exchange.num_ranks:
+            raise ValueError(
+                f"--partitioned-io: mesh data axis {data_axis} must be a "
+                f"multiple of the process count {exchange.num_ranks}"
+            )
+        pad_multiple = data_axis // exchange.num_ranks
+        if params.validation_data_path:
+            raise ValueError(
+                "--partitioned-io does not support validation data yet; "
+                "score + evaluate with the partitioned scoring driver"
+            )
+
     with Timed("read training data"):
-        train = read_merged(
+        train_part = read_partitioned(
             resolve(params.input_data_path, params.input_date_range),
             params.feature_shards,
+            exchange=exchange,
             index_maps=prebuilt_maps,
             random_effect_id_columns=re_columns,
             evaluation_id_columns=eval_columns,
             fmt=params.input_format,
+            pad_multiple=pad_multiple,
+            tag="train",
         )
+        train = train_part.result
+    partition = train_part.partition
     job_log.info(
-        "read %d training samples, shards %s",
+        "read %d training samples%s, shards %s",
         train.dataset.num_samples,
+        (
+            f" (rank {partition.rank}/{partition.num_ranks}, "
+            f"{train_part.bytes_decoded}/{train_part.input_bytes_total} "
+            "bytes decoded)"
+            if partition.num_ranks > 1 else ""
+        ),
         {k: v.size for k, v in train.index_maps.items()},
     )
 
     validation = None
     if params.validation_data_path:
         with Timed("read validation data"):
-            validation = read_merged(
+            validation = read_partitioned(
                 resolve(
                     params.validation_data_path, params.validation_data_date_range
                 ),
@@ -344,7 +412,8 @@ def _run_inner(
                 evaluation_id_columns=eval_columns,
                 entity_vocabs=train.dataset.entity_vocabs,
                 fmt=params.input_format,
-            )
+                tag="validation",
+            ).result
 
     with Timed("validate data"):
         validate_game_dataset(train.dataset, params.task_type, params.data_validation)
@@ -356,7 +425,14 @@ def _run_inner(
     with Timed("feature shard stats"):
         from photon_ml_tpu.io.index_map import IdentityIndexMap
 
-        for shard_id, features in train.dataset.feature_shards.items():
+        if partition.num_ranks > 1:
+            # rank-local rows: a per-rank stats file would summarize 1/P of
+            # the data and masquerade as global statistics
+            logger.info("partitioned ingest: skipping feature stats "
+                        "(rank-local rows)")
+        for shard_id, features in (
+            {} if partition.num_ranks > 1 else train.dataset.feature_shards
+        ).items():
             imap = train.index_maps[shard_id]
             if isinstance(imap, IdentityIndexMap) and imap.size > (1 << 20):
                 # pre-indexed giant-d space: a per-column stats file would
@@ -393,21 +469,15 @@ def _run_inner(
         if isinstance(imap, IndexMap):
             imap.save(os.path.join(out, "index-maps"), shard_id)
 
-    mesh = None
-    model_axis = 1
-    if params.distributed or params.mesh_shape:
-        # the multi-chip entry point: one ("data", "model") mesh over all
-        # (possibly multi-process) devices, topology-aware across slices
-        from photon_ml_tpu.parallel.multihost import make_hybrid_mesh
+    estimator_partition = None
+    if partition.num_ranks > 1:
+        from photon_ml_tpu.estimators import TrainPartition
 
-        shape = dict(params.mesh_shape or {})
-        model_axis = int(shape.get("model", 1))
-        mesh = make_hybrid_mesh(
-            data=shape.get("data"), model=model_axis
-        )
-        job_log.info(
-            "distributed mode: mesh %s over %d devices",
-            dict(zip(mesh.axis_names, mesh.devices.shape)), mesh.devices.size,
+        estimator_partition = TrainPartition(
+            info=partition,
+            exchange=exchange,
+            lane_multiple=pad_multiple,
+            entity_rank_presence=train_part.entity_rank_presence,
         )
 
     def make_estimator(reg_weights, checkpointer=None) -> GameEstimator:
@@ -428,6 +498,7 @@ def _run_inner(
             mesh=mesh,
             fe_feature_sharded=model_axis > 1,
             telemetry=telemetry,
+            partition=estimator_partition,
         )
 
     def make_checkpointer(config_index: int, reg_weights):
@@ -678,6 +749,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="device mesh layout 'data=8,model=1' (implies "
                         "--distributed; model>1 shards the fixed-effect "
                         "feature axis)")
+    p.add_argument("--partitioned-io", action="store_true",
+                   help="multi-process runs: each rank decodes only ~1/P "
+                        "of the input bytes (per-rank partitioned Avro "
+                        "ingestion; dense IDENTITY configs, no validation "
+                        "riders — see io/partitioned_reader.py)")
     return p
 
 
@@ -729,6 +805,7 @@ def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
         compact_random_effect_threshold=args.compact_random_effect_threshold,
         distributed=args.distributed or bool(args.mesh),
         mesh_shape=_parse_mesh_shape(args.mesh),
+        partitioned_io=args.partitioned_io,
     )
 
 

@@ -137,8 +137,26 @@ def pad_game_dataset(dataset: GameDataset, multiple: int) -> tuple[GameDataset, 
     """
     n = dataset.num_samples
     pad = (-n) % max(1, int(multiple))
+    return _pad_game_dataset_rows(dataset, pad), n
+
+
+def pad_game_dataset_to(dataset: GameDataset, length: int) -> tuple[GameDataset, int]:
+    """Pad the sample axis with zero-weight rows to EXACTLY ``length`` rows
+    (same padding contract as :func:`pad_game_dataset`). The partitioned
+    ingestion path uses this to make every rank's local block the agreed
+    common length — including ranks that decoded zero rows."""
+    n = dataset.num_samples
+    if length < n:
+        raise ValueError(
+            f"cannot pad a {n}-row dataset down to {length} rows"
+        )
+    return _pad_game_dataset_rows(dataset, length - n), n
+
+
+def _pad_game_dataset_rows(dataset: GameDataset, pad: int) -> GameDataset:
+    n = dataset.num_samples
     if pad == 0:
-        return dataset, n
+        return dataset
 
     def padded_vec(name: str) -> tuple[np.ndarray, Array]:
         arr = dataset.host_array(name)
@@ -181,19 +199,16 @@ def pad_game_dataset(dataset: GameDataset, multiple: int) -> tuple[GameDataset, 
         [np.asarray(dataset.unique_ids),
          -(np.arange(pad, dtype=np.int64) + 1 + np.abs(dataset.unique_ids).max(initial=0))]
     )
-    return (
-        dataclasses.replace(
-            dataset,
-            unique_ids=unique_ids,
-            labels=labels_d,
-            offsets=offsets_d,
-            weights=weights_d,
-            feature_shards=shards,
-            entity_idx=entity_idx,
-            ids=ids,
-            host_cache=host_cache,
-        ),
-        n,
+    return dataclasses.replace(
+        dataset,
+        unique_ids=unique_ids,
+        labels=labels_d,
+        offsets=offsets_d,
+        weights=weights_d,
+        feature_shards=shards,
+        entity_idx=entity_idx,
+        ids=ids,
+        host_cache=host_cache,
     )
 
 
@@ -596,6 +611,133 @@ def build_random_effect_dataset(
         projector_type=projector_type,
         projection=projection,
         pre_normalized=normalization is not None,
+    )
+
+
+def build_random_effect_dataset_partitioned(
+    dataset: GameDataset,
+    re_type: str,
+    shard_id: str,
+    *,
+    partition,
+    exchange,
+    active_data_upper_bound: int | None = None,
+    active_data_lower_bound: int | None = None,
+    bucket_sizes: Sequence[int] = (8, 32, 128, 512, 2048),
+    seed: int = 0,
+    lane_multiple: int = 1,
+    entity_rank_presence: np.ndarray | None = None,
+    tag: str | None = None,
+) -> RandomEffectDataset:
+    """Rank-local random-effect view over a partitioned ingest.
+
+    ``dataset`` is this rank's LOCAL padded block from
+    io/partitioned_reader.py (entity indices already in the GLOBAL vocab;
+    padding rows carry entity -1 and are excluded here as everywhere).
+    Buckets are built from the local samples only; global consistency
+    comes from ONE small metadata allgather of per-capacity entity counts
+    (the entity ids + counts themselves were exchanged by the reader) —
+    never from re-reading other ranks' bytes:
+
+    - every rank agrees on the bucket-capacity list and pads its per-
+      capacity entity block to the common lane count (padding lanes carry
+      weight 0 and an out-of-range entity row — the established scatter-
+      drop convention), so the concatenation of rank blocks is one global
+      bucket tensor each rank can feed as its addressable shard;
+    - ``sample_rows`` are shifted by the rank's base row, so in-step
+      residual gathers index the GLOBAL sample axis.
+
+    Semantics note (the partitioned deviation): an entity whose samples
+    span ranks gets one lane PER rank, each solving on that rank's samples
+    only — the later block's solve wins the table row, unlike the
+    full-read path where all its samples share one lane. Entity-clustered
+    inputs (the layout the reference's partitioner produces,
+    RandomEffectDataSetPartitioner.scala) keep every entity on one rank
+    and match the full read exactly; ``entity_rank_presence`` (from the
+    reader) triggers a warning when that does not hold. Dense IDENTITY
+    coordinates only — projected/compact coordinates read full.
+    """
+    shard = dataset.feature_shards[shard_id]
+    if isinstance(shard, SparseShard):
+        raise ValueError(
+            f"random-effect coordinate '{re_type}': sparse (compact) "
+            "shards are not supported by the partitioned path; use the "
+            "full reader"
+        )
+    if entity_rank_presence is not None:
+        spanning = int(np.sum(np.asarray(entity_rank_presence) > 1))
+        if spanning:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "random-effect coordinate '%s': %d entities have samples "
+                "on multiple ranks; their per-rank partial solves deviate "
+                "from the full-read result (entity-cluster the input for "
+                "exact parity)", re_type, spanning,
+            )
+
+    local = build_random_effect_dataset(
+        dataset, re_type, shard_id,
+        active_data_upper_bound=active_data_upper_bound,
+        active_data_lower_bound=active_data_lower_bound,
+        bucket_sizes=bucket_sizes,
+        seed=seed,
+    )
+    by_cap = {b.capacity: b for b in local.buckets}
+    payload = {str(cap): b.num_entities for cap, b in by_cap.items()}
+    gathered = exchange.allgather(
+        f"re_partitioned/{tag or re_type}", payload
+    )
+    all_caps = sorted(
+        {int(c) for g in gathered for c in g},
+        key=lambda c: (list(bucket_sizes).index(c)
+                       if c in bucket_sizes else len(bucket_sizes), c),
+    )
+    dim = local.dim
+    base_row = partition.base_row
+    oob_entity = np.iinfo(np.int32).max
+    labels_dtype = np.asarray(dataset.host_array("labels")).dtype
+    weights_dtype = np.asarray(dataset.host_array("weights")).dtype
+    feat_dtype = np.asarray(dataset.host_array(f"shard/{shard_id}")).dtype
+
+    buckets: list[EntityBucket] = []
+    for cap in all_caps:
+        e_max = max(int(g.get(str(cap), 0)) for g in gathered)
+        e_pad = -(-e_max // max(1, lane_multiple)) * max(1, lane_multiple)
+        b = by_cap.get(cap)
+        e_local = 0 if b is None else b.num_entities
+        if b is not None:
+            bf = np.asarray(b.features)
+            bl = np.asarray(b.labels)
+            bw = np.asarray(b.weights)
+            bs = np.asarray(b.sample_rows)
+            be = np.asarray(b.entity_rows)
+        else:
+            bf = np.zeros((0, cap, dim), dtype=feat_dtype)
+            bl = np.zeros((0, cap), dtype=labels_dtype)
+            bw = np.zeros((0, cap), dtype=weights_dtype)
+            bs = np.full((0, cap), -1, dtype=np.int32)
+            be = np.zeros((0,), dtype=np.int32)
+        pad = e_pad - e_local
+        if pad:
+            bf = np.concatenate([bf, np.zeros((pad, cap, dim), bf.dtype)])
+            bl = np.concatenate([bl, np.zeros((pad, cap), bl.dtype)])
+            bw = np.concatenate([bw, np.zeros((pad, cap), bw.dtype)])
+            bs = np.concatenate([bs, np.full((pad, cap), -1, np.int32)])
+            be = np.concatenate([be, np.full(pad, oob_entity, np.int32)])
+        # local -> global sample rows (padding slots stay -1)
+        bs = np.where(bs >= 0, bs + base_row, -1).astype(np.int32)
+        buckets.append(EntityBucket(
+            features=bf, labels=bl, weights=bw,
+            entity_rows=be, sample_rows=bs,
+        ))
+    return RandomEffectDataset(
+        random_effect_type=re_type,
+        feature_shard_id=shard_id,
+        buckets=buckets,
+        num_entities=local.num_entities,
+        dim=dim,
+        projector_type=ProjectorType.IDENTITY,
     )
 
 

@@ -125,6 +125,28 @@ CoordinateConfig = (
 
 
 @dataclasses.dataclass
+class TrainPartition:
+    """Partitioned-ingest context for ``GameEstimator`` (multi-process
+    runs where ``fit`` receives this rank's LOCAL padded block from
+    io/partitioned_reader.py instead of the full dataset).
+
+    info: the reader's PartitionInfo (rank geometry).
+    exchange: the run's MetadataExchange (RE bucket structure rides it).
+    lane_multiple: per-rank device count along the mesh "data" axis —
+        keeps bucket/sample blocks aligned with addressable shards.
+    entity_rank_presence: reader diagnostics (RE type -> ranks-per-entity)
+        forwarded to the rank-local RE builder's cross-rank warning.
+    """
+
+    info: object
+    exchange: object
+    lane_multiple: int = 1
+    entity_rank_presence: Mapping[str, np.ndarray] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass
 class GameEstimator:
     """Trains a GAME model: ordered coordinates, block coordinate descent."""
 
@@ -169,6 +191,12 @@ class GameEstimator:
     #: iteration convergence rows / OptimizationLogEvents from the CD loop
     #: (the drivers thread their run journal + event emitter through here)
     telemetry: object | None = None
+    #: partitioned-ingest context (TrainPartition): fit() receives this
+    #: rank's LOCAL block and trains through train_partitioned — each rank
+    #: feeds only its addressable shards. Requires ``mesh``; v1 supports
+    #: dense FE + IDENTITY REs without normalization/validation riders
+    #: (see _fit_distributed's guard for the full list).
+    partition: "TrainPartition | None" = None
 
     def fit(
         self,
@@ -176,6 +204,13 @@ class GameEstimator:
         validation_dataset: GameDataset | None = None,
         initial_model: GameModel | None = None,
     ) -> CoordinateDescentResult:
+        if self.partition is not None and self.mesh is None:
+            # the CD path would silently train a full model on this rank's
+            # 1/P block — fail before any work
+            raise ValueError(
+                "partitioned training requires a mesh (the per-rank blocks "
+                "feed its addressable shards); pass GameEstimator(mesh=...)"
+            )
         if self.mesh is not None:
             return self._fit_distributed(dataset, validation_dataset, initial_model)
         sequence = list(self.update_sequence or self.coordinate_configs.keys())
@@ -292,6 +327,56 @@ class GameEstimator:
             telemetry=self.telemetry,
         )
 
+    def _check_partition_supported(
+        self, sequence, locked, dataset, validation_dataset
+    ) -> None:
+        """The partitioned-training v1 surface (dense FE + IDENTITY REs,
+        no global-statistics riders) — anything outside it must fail
+        loudly BEFORE any rank-local work could silently diverge from the
+        full-read semantics."""
+        problems: list[str] = []
+        if self.mesh is None:
+            problems.append("a mesh is required")
+        if locked:
+            problems.append("locked coordinates")
+        if validation_dataset is not None:
+            problems.append(
+                "validation datasets (score + evaluate partitioned via "
+                "parallel/scoring.py instead)"
+            )
+        if self.normalization != NormalizationType.NONE:
+            problems.append(
+                "normalization (feature stats would be rank-local)"
+            )
+        if self.checkpointer is not None:
+            problems.append("checkpointing")
+        for cid in sequence:
+            cfg = self.coordinate_configs[cid]
+            if isinstance(cfg, MatrixFactorizationCoordinateConfig):
+                problems.append(f"matrix-factorization coordinate '{cid}'")
+                continue
+            if isinstance(cfg, RandomEffectCoordinateConfig) and (
+                cfg.projector_type != ProjectorType.IDENTITY
+                or cfg.features_to_samples_ratio is not None
+            ):
+                problems.append(
+                    f"projected/feature-selected random effect '{cid}'"
+                )
+            if cfg.optimization.down_sampling_rate < 1.0:
+                problems.append(f"down-sampling on '{cid}'")
+            if cfg.optimization.compute_variance:
+                problems.append(f"compute_variance on '{cid}'")
+            if isinstance(
+                dataset.feature_shards.get(cfg.feature_shard_id), SparseShard
+            ):
+                problems.append(f"sparse feature shard on '{cid}'")
+        if problems:
+            raise ValueError(
+                "partitioned training does not support: "
+                + "; ".join(sorted(set(problems)))
+                + " — use the full-read path for these"
+            )
+
     def _fit_distributed(
         self,
         dataset: GameDataset,
@@ -331,6 +416,7 @@ class GameEstimator:
             game_model_to_state,
             state_to_game_model,
             train_distributed,
+            train_partitioned,
         )
 
         sequence = list(self.update_sequence or self.coordinate_configs.keys())
@@ -339,6 +425,11 @@ class GameEstimator:
             raise ValueError(
                 "locked coordinates require an initial model "
                 "(partial retraining needs a pre-trained model)"
+            )
+        partition = self.partition
+        if partition is not None:
+            self._check_partition_supported(
+                sequence, locked, dataset, validation_dataset
             )
 
         fe_ids = [
@@ -474,15 +565,35 @@ class GameEstimator:
                     "coefficient tables by RE type; merge or rename"
                 )
             re_cid_of_type[re_type] = cid
-            re_datasets[re_type] = build_random_effect_dataset(
-                dataset, re_type, cfg.feature_shard_id,
-                active_data_upper_bound=cfg.active_data_upper_bound,
-                active_data_lower_bound=cfg.active_data_lower_bound,
-                projector_type=cfg.projector_type,
-                projected_dim=cfg.projected_dim,
-                features_to_samples_ratio=cfg.features_to_samples_ratio,
-                normalization=_build_normalization_for(cfg, dataset, norms),
-            )
+            if partition is not None:
+                # rank-local buckets with exchanged global structure — the
+                # guard above already limited the surface to dense IDENTITY
+                from photon_ml_tpu.data.game_data import (
+                    build_random_effect_dataset_partitioned,
+                )
+
+                re_datasets[re_type] = build_random_effect_dataset_partitioned(
+                    dataset, re_type, cfg.feature_shard_id,
+                    partition=partition.info,
+                    exchange=partition.exchange,
+                    active_data_upper_bound=cfg.active_data_upper_bound,
+                    active_data_lower_bound=cfg.active_data_lower_bound,
+                    lane_multiple=partition.lane_multiple,
+                    entity_rank_presence=(
+                        partition.entity_rank_presence.get(re_type)
+                    ),
+                    tag=cid,
+                )
+            else:
+                re_datasets[re_type] = build_random_effect_dataset(
+                    dataset, re_type, cfg.feature_shard_id,
+                    active_data_upper_bound=cfg.active_data_upper_bound,
+                    active_data_lower_bound=cfg.active_data_lower_bound,
+                    projector_type=cfg.projector_type,
+                    projected_dim=cfg.projected_dim,
+                    features_to_samples_ratio=cfg.features_to_samples_ratio,
+                    normalization=_build_normalization_for(cfg, dataset, norms),
+                )
             norm = norms.get(cfg.feature_shard_id)
             if norm is not None:
                 re_normalizations[re_type] = norm
@@ -623,25 +734,41 @@ class GameEstimator:
                 ids=validation_dataset.ids,
             )
 
-        result = train_distributed(
-            program,
-            train_ds,
-            re_datasets,
-            mf_datasets=mf_datasets,
-            mesh=self.mesh,
-            num_iterations=self.num_iterations,
-            fe_feature_sharded=self.fe_feature_sharded,
-            state=warm_state,
-            checkpointer=self.checkpointer,
-            checkpoint_every=self.checkpoint_every,
-            resume=self.resume,
-            validation_dataset=val_ds if val_eval_data is not None else None,
-            validation_evaluators=evaluators,
-            validation_eval_data=val_eval_data,
-            training_evaluator=default_evaluator_for_task(self.task),
-            training_eval_data=train_eval_data,
-            check_finite=self.check_finite,
-        )
+        if partition is not None:
+            # this rank contributes only its local block; the fused step
+            # sees the assembled global arrays. No validation/metric riders
+            # (the guard rejected them) — score + evaluate partitioned via
+            # parallel/scoring.py instead.
+            result = train_partitioned(
+                program,
+                {partition.info.rank: (train_ds, re_datasets)},
+                self.mesh,
+                partition.info.num_ranks,
+                num_iterations=self.num_iterations,
+                state=warm_state,
+                fe_feature_sharded=self.fe_feature_sharded,
+                check_finite=self.check_finite,
+            )
+        else:
+            result = train_distributed(
+                program,
+                train_ds,
+                re_datasets,
+                mf_datasets=mf_datasets,
+                mesh=self.mesh,
+                num_iterations=self.num_iterations,
+                fe_feature_sharded=self.fe_feature_sharded,
+                state=warm_state,
+                checkpointer=self.checkpointer,
+                checkpoint_every=self.checkpoint_every,
+                resume=self.resume,
+                validation_dataset=val_ds if val_eval_data is not None else None,
+                validation_evaluators=evaluators,
+                validation_eval_data=val_eval_data,
+                training_evaluator=default_evaluator_for_task(self.task),
+                training_eval_data=train_eval_data,
+                check_finite=self.check_finite,
+            )
 
         trainable_cids = {} if fe_cid is None else {fe_shard: fe_cid}
         trainable_cids.update(extra_fe_cid_of_shard)

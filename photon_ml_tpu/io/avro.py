@@ -417,6 +417,64 @@ def read_container(path: str | os.PathLike) -> Iterator[dict]:
                 raise AvroError(f"{path}: sync marker mismatch")
 
 
+def scan_block_index(path: str | os.PathLike) -> list[tuple[int, int, int]]:
+    """The container's block index: [(record_count, payload_bytes,
+    payload_offset), ...] — scanned by SEEKING past every payload, so the
+    cost is header decode + one seek per block, not a data read. This is
+    what makes block-level partitioned ingestion cheap to plan
+    (io/partitioned_reader.py splits few-large-files inputs by blocks)."""
+    blocks: list[tuple[int, int, int]] = []
+    with open(path, "rb") as inp:
+        if inp.read(4) != MAGIC:
+            raise AvroError(f"{path}: not an Avro container file")
+        BinaryDecoder(inp, SchemaRegistry()).read(_META_SCHEMA)
+        inp.read(16)  # sync
+        while True:
+            try:
+                n_records = read_long(inp)
+            except EOFError:
+                return blocks
+            size = read_long(inp)
+            blocks.append((n_records, size, inp.tell()))
+            inp.seek(size + 16, os.SEEK_CUR)  # payload + sync
+
+
+def read_container_block_range(
+    path: str | os.PathLike, start_block: int, num_blocks: int,
+    index: "list[tuple[int, int, int]] | None" = None,
+) -> Iterator[dict]:
+    """Iterate the records of blocks [start_block, start_block+num_blocks)
+    only — the partitioned reader's entry for a rank's block assignment.
+    Seeks directly to the first selected payload via the block index
+    (pass ``index`` from a prior :func:`scan_block_index` to skip the
+    re-scan — the partitioned planner already holds it)."""
+    if num_blocks <= 0:
+        return
+    if index is None:
+        index = scan_block_index(path)
+    selected = index[start_block:start_block + num_blocks]
+    if len(selected) != num_blocks:
+        raise AvroError(
+            f"{path}: block range [{start_block}, "
+            f"{start_block + num_blocks}) exceeds {len(index)} blocks"
+        )
+    with open(path, "rb") as inp:
+        inp.seek(4)
+        meta = BinaryDecoder(inp, SchemaRegistry()).read(_META_SCHEMA)
+        schema, registry = parse_schema(meta["avro.schema"].decode("utf-8"))
+        codec = meta.get("avro.codec", b"null").decode("utf-8")
+        for n_records, size, offset in selected:
+            inp.seek(offset)
+            payload = inp.read(size)
+            if codec == "deflate":
+                payload = zlib.decompress(payload, -15)
+            elif codec != "null":
+                raise AvroError(f"unsupported codec {codec!r}")
+            dec = BinaryDecoder(_io.BytesIO(payload), registry)
+            for _ in range(n_records):
+                yield dec.read(schema)
+
+
 def read_container_schema(path: str | os.PathLike) -> dict:
     with open(path, "rb") as inp:
         if inp.read(4) != MAGIC:

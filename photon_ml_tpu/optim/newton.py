@@ -152,8 +152,12 @@ def minimize_newton(
     def body(state: _NewtonState):
         h = hessian_matrix_fn(state.w)
         # trace-scaled Levenberg jitter (f32 PD safety) + the adaptive LM
-        # damping carried in the state
-        scale = jnp.trace(h) / d
+        # damping carried in the state. The scale is floored so the damping
+        # still regularizes a zero-trace Hessian (all-zero H with l2=0,
+        # reachable outside the RE path): without the floor the jitter
+        # collapses to 1e-30 and damping growth multiplies zero, leaving
+        # the gradient fallback's 1e-12 divisor to produce huge steps.
+        scale = jnp.maximum(jnp.trace(h) / d, 1e-12)
         jitter = (1e-7 + state.damping) * scale + 1e-30
         p = -_solve_pd(h + jitter * jnp.eye(d, dtype=h.dtype), state.g)
         # degenerate Hessian (non-finite solve): steepest descent scaled
@@ -182,7 +186,15 @@ def minimize_newton(
             jnp.abs(vals[0]) + 1e-30
         )
         w_new = jnp.where(improved, state.w + alphas[best] * p, state.w)
-        f_new, g_new = value_and_grad_fn(w_new)
+        # rejected round: w_new == state.w, so the value+grad it carries is
+        # already exact — reuse it. lax.cond skips the pass entirely on
+        # un-vmapped solves; vmapped lanes lower to a select-both-branches
+        # (no worse than the unconditional recompute this replaces).
+        f_new, g_new = lax.cond(
+            improved,
+            lambda: value_and_grad_fn(w_new),
+            lambda: (state.f, state.g),
+        )
 
         # LM damping: a rejected round means the step overshot past the
         # alphas' 16x range — damp hard and retry; acceptance decays the
@@ -195,10 +207,13 @@ def minimize_newton(
 
         gnorm = jnp.linalg.norm(g_new)
         g0n = state.grad_norm_history[0]
-        # converged only on a clean (undamped-ish) flat round: heavy
-        # damping makes steps artificially tiny, which must not read as
-        # "function values within tolerance"
-        flat_round = f_delta_small & (state.damping <= 1e-3)
+        # converged only on a clean (undamped-ish) ACCEPTED flat round:
+        # heavy damping makes steps artificially tiny, and a rejected-but-
+        # flat round (best nonzero step within tolerance but slightly
+        # worse) must take one damped — more gradient-like — retry before
+        # declaring convergence, in case only the undamped Newton direction
+        # was poor
+        flat_round = f_delta_small & improved & (state.damping <= 1e-3)
         reason = jnp.where(
             gnorm <= tolerance * jnp.maximum(g0n, 1.0),
             jnp.int32(ConvergenceReason.GRADIENT_WITHIN_TOLERANCE),

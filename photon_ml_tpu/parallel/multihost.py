@@ -28,11 +28,14 @@ the standard scaling-book layout: chatty axes ride fast links.
 
 from __future__ import annotations
 
+import itertools
+import json
 import logging
+import threading
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding
 
 logger = logging.getLogger(__name__)
 
@@ -171,4 +174,219 @@ def global_put(arr, sharding):
     value = np.asarray(arr)  # zero-copy for host numpy inputs
     return jax.make_array_from_callback(
         value.shape, sharding, lambda idx: value[idx]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side metadata exchange (partitioned I/O)
+# ---------------------------------------------------------------------------
+#
+# The partitioned host-I/O layer (io/partitioned_reader.py,
+# io/score_writer.py) needs each rank to agree on SMALL metadata — feature
+# keys, entity vocabularies + counts, per-rank row counts, part numbering —
+# without any rank reading the other ranks' bytes. The reference gets this
+# from Spark's driver (a JVM object broadcast); the TPU-native equivalent
+# rides jax.distributed's coordination service KV store: a host-side
+# channel that works before (and independently of) any device computation,
+# so ingestion metadata can rendezvous while the accelerator program is
+# still being built. NOT for bulk data — payloads are JSON and should stay
+# well under a few MB; array-sized exchanges belong on the devices.
+
+
+class MetadataExchange:
+    """Rank-aware small-payload allgather + barrier for host-side I/O.
+
+    Every rank must make the SAME sequence of calls (SPMD discipline, like
+    collectives); tags are namespaced per call site and serialized with an
+    internal counter so repeated exchanges never collide.
+    """
+
+    rank: int = 0
+    num_ranks: int = 1
+
+    def allgather(self, tag: str, payload) -> list:
+        """All ranks' ``payload``s (JSON-able), ordered by rank."""
+        raise NotImplementedError
+
+    def barrier(self, tag: str) -> None:
+        """Block until every rank reaches this barrier."""
+        raise NotImplementedError
+
+
+class SingleProcessExchange(MetadataExchange):
+    """The trivial exchange: one rank, no waiting."""
+
+    def allgather(self, tag: str, payload) -> list:
+        return [payload]
+
+    def barrier(self, tag: str) -> None:
+        return None
+
+
+class InProcessExchange(MetadataExchange):
+    """N virtual ranks inside one process (threads) — the test/simulation
+    transport: lets the partitioned reader/writer run num_ranks>1 flows on
+    a single host, e.g. against the virtual CPU mesh."""
+
+    def __init__(self, store: dict, rank: int, num_ranks: int):
+        self._store = store
+        self.rank = rank
+        self.num_ranks = num_ranks
+        # per-instance call counter: repeated exchanges under the SAME tag
+        # stay distinct (every rank makes the same sequence of calls — the
+        # SPMD discipline — so counters agree), mirroring the KV transport
+        self._seq = 0
+
+    @classmethod
+    def create_group(cls, num_ranks: int) -> "list[InProcessExchange]":
+        store = {
+            "cond": threading.Condition(),
+            "gather": {},
+        }
+        return [cls(store, r, num_ranks) for r in range(num_ranks)]
+
+    def allgather(self, tag: str, payload) -> list:
+        key = (self._seq, tag)
+        self._seq += 1
+        cond, slot = self._store["cond"], self._store["gather"]
+        with cond:
+            entry = slot.setdefault(key, {})
+            entry[self.rank] = payload
+            cond.notify_all()
+            cond.wait_for(lambda: len(slot[key]) == self.num_ranks,
+                          timeout=120)
+            if len(slot[key]) != self.num_ranks:
+                raise TimeoutError(f"allgather {tag!r}: "
+                                   f"{len(slot[key])}/{self.num_ranks} ranks")
+            out = [slot[key][r] for r in range(self.num_ranks)]
+            # reclaim the slot once every rank has read it (payloads can
+            # be sizable — feature-key lists — and exchanges are many)
+            reads = self._store.setdefault("reads", {})
+            reads[key] = reads.get(key, 0) + 1
+            if reads[key] == self.num_ranks:
+                del slot[key]
+                del reads[key]
+            return out
+
+    def barrier(self, tag: str) -> None:
+        self.allgather(f"__barrier__/{tag}", None)
+
+
+#: process-global sequence for KV keys/barrier ids: the coordination
+#: service's namespace is process-wide, so two exchange INSTANCES in one
+#: process (e.g. a driver run() called twice) must never reuse a key or a
+#: barrier id. Every rank constructs/calls exchanges in the same order
+#: (SPMD discipline), so the counters agree across processes.
+_kv_seq = itertools.count().__next__
+
+
+class DistributedKVExchange(MetadataExchange):
+    """Multi-process transport over jax.distributed's coordination-service
+    key-value store (the same rendezvous channel ``initialize`` uses) —
+    host-side only, so partitioned ingestion metadata flows even before
+    the first device computation."""
+
+    def __init__(self, timeout_ms: int = 120_000):
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "DistributedKVExchange needs jax.distributed.initialize "
+                "(multihost.initialize) to have run first"
+            )
+        self._client = client
+        self._timeout_ms = timeout_ms
+        self.rank = jax.process_index()
+        self.num_ranks = jax.process_count()
+
+    def _key(self, tag: str, seq: int, rank: int) -> str:
+        return f"photon/xchg/{seq}/{tag}/{rank}"
+
+    def allgather(self, tag: str, payload) -> list:
+        seq = _kv_seq()
+        self._client.key_value_set(
+            self._key(tag, seq, self.rank), json.dumps(payload)
+        )
+        out = []
+        for r in range(self.num_ranks):
+            raw = self._client.blocking_key_value_get(
+                self._key(tag, seq, r), self._timeout_ms
+            )
+            out.append(json.loads(raw))
+        # every rank has read every key — reclaim our own entry so the
+        # coordinator's KV store does not retain one payload per exchange
+        # for the process lifetime (feature-key lists can be MBs)
+        self._client.wait_at_barrier(
+            f"photon/bar/xchg-read/{seq}", self._timeout_ms
+        )
+        self._client.key_value_delete(self._key(tag, seq, self.rank))
+        return out
+
+    def barrier(self, tag: str) -> None:
+        self._client.wait_at_barrier(
+            f"photon/bar/{_kv_seq()}/{tag}", self._timeout_ms
+        )
+
+
+def default_exchange() -> MetadataExchange:
+    """The transport for the current topology: coordination-service KV when
+    the program spans processes, the trivial exchange otherwise — the
+    metadata twin of :func:`default_put`."""
+    if jax.process_count() > 1:
+        return DistributedKVExchange()
+    return SingleProcessExchange()
+
+
+def assemble_partitioned(
+    blocks: "dict[int, np.ndarray]",
+    mesh: Mesh,
+    spec,
+    num_ranks: int,
+) -> jax.Array:
+    """Global sharded array whose axis 0 is ``num_ranks`` equal-length
+    per-rank blocks — each process supplies ONLY the blocks whose rows
+    live on its addressable devices, so nothing of global size is ever
+    materialized on one host (the partitioned twin of :func:`global_put`,
+    built on ``jax.make_array_from_single_device_arrays``).
+
+    blocks: rank -> [block_len, ...] host array; every provided block must
+    share shape/dtype. Multi-process callers pass {my_rank: local_block};
+    single-process simulations (virtual ranks on one host, tests) pass all
+    of them. Requires the device layout to align rank blocks with
+    addressable shards: the sharded axis size (num_ranks * block_len) must
+    split so no device shard crosses a rank boundary.
+    """
+    sample = next(iter(blocks.values()))
+    block_len = int(sample.shape[0])
+    global_shape = (num_ranks * block_len,) + tuple(sample.shape[1:])
+    sharding = NamedSharding(mesh, spec)
+    arrays = []
+    for dev, idx in sharding.addressable_devices_indices_map(
+        global_shape
+    ).items():
+        sl = idx[0]
+        start = 0 if sl.start is None else int(sl.start)
+        stop = global_shape[0] if sl.stop is None else int(sl.stop)
+        r = start // block_len if block_len else 0
+        if stop > (r + 1) * block_len:
+            raise ValueError(
+                f"device shard rows [{start}, {stop}) cross the rank-"
+                f"{r} block boundary (block_len={block_len}); pad each "
+                "rank's block to a multiple of its local device count"
+            )
+        if r not in blocks:
+            raise ValueError(
+                f"device {dev} holds rows of rank {r} but no block for "
+                f"that rank was provided (have {sorted(blocks)}); the "
+                "mesh's device order must be process-contiguous along the "
+                "sharded axis"
+            )
+        local = blocks[r][start - r * block_len: stop - r * block_len]
+        rest = tuple(idx[1:])
+        if rest:
+            local = local[(slice(None),) + rest]
+        arrays.append(jax.device_put(local, dev))
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, arrays
     )

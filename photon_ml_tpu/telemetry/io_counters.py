@@ -1,0 +1,49 @@
+"""Per-rank partitioned host-I/O counters: the observable 1/P.
+
+Reference parity: Spark surfaced per-executor input/output byte metrics in
+its task UI for AvroDataReader.scala / ScoreProcessingUtils.scala work;
+here the equivalent per-RANK evidence lives in the process-wide metrics
+registry, so the two-process e2e (and any run journal) can prove each of P
+ranks touched ~1/P of the input and output bytes instead of a silent
+full-read multiply.
+
+Names are constants so producers (io/partitioned_reader.py,
+io/score_writer.py) and consumers (tests, journals) cannot drift.
+"""
+
+from __future__ import annotations
+
+from photon_ml_tpu.telemetry.registry import default_registry
+
+#: bytes of input this RANK decoded (container file bytes in file mode,
+#: selected block payload bytes in block mode)
+BYTES_DECODED = "io/partitioned/bytes_decoded"
+#: total bytes of the input across all ranks (gauge — same on every rank)
+INPUT_BYTES_TOTAL = "io/partitioned/input_bytes_total"
+#: bytes of score output this RANK wrote (its own part files only)
+SCORE_BYTES_WRITTEN = "io/partitioned/score_bytes_written"
+
+
+def record_bytes_decoded(n: int) -> None:
+    default_registry().counter(BYTES_DECODED).inc(int(n))
+
+
+def set_input_bytes_total(n: int) -> None:
+    default_registry().gauge(INPUT_BYTES_TOTAL).set(int(n))
+
+
+def record_score_bytes_written(n: int) -> None:
+    default_registry().counter(SCORE_BYTES_WRITTEN).inc(int(n))
+
+
+def bytes_decoded() -> int:
+    return int(default_registry().counter(BYTES_DECODED).value)
+
+
+def input_bytes_total() -> int:
+    value = default_registry().gauge(INPUT_BYTES_TOTAL).value
+    return int(value or 0)
+
+
+def score_bytes_written() -> int:
+    return int(default_registry().counter(SCORE_BYTES_WRITTEN).value)

@@ -64,3 +64,54 @@ def test_lint_catches_banned_linalg(tmp_path):
     assert any("aliased.py:4" in p and "solve" in p for p in problems)
     assert any("undocumented.py:1" in p and "docstring" in p for p in problems)
     assert not any("good.py" in p for p in problems)  # np.linalg not banned
+
+
+def test_lint_catches_cli_full_reads_and_score_allgathers(tmp_path):
+    """The partitioned-I/O lints fire: direct read_merged in cli/ and
+    process_allgather outside the model-sized allowlist are reported;
+    the dispatcher call and allowlisted helpers stay clean."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    cli = tmp_path / "photon_ml_tpu" / "cli"
+    cli.mkdir(parents=True)
+    (cli / "bad_driver.py").write_text(
+        '"""Cites Foo.scala:1."""\n'
+        "from photon_ml_tpu.io.data_reader import read_merged\n"
+        "def run(p, cfg):\n"
+        "    return read_merged(p, cfg)\n"
+    )
+    (cli / "good_driver.py").write_text(
+        '"""Cites Foo.scala:1."""\n'
+        "from photon_ml_tpu.io.partitioned_reader import read_partitioned\n"
+        "def run(p, cfg):\n"
+        "    return read_partitioned(p, cfg)\n"
+    )
+    par = tmp_path / "photon_ml_tpu" / "parallel"
+    par.mkdir(parents=True)
+    (par / "funnel.py").write_text(
+        '"""No reference analogue."""\n'
+        "from jax.experimental import multihost_utils\n"
+        "def gather_scores(scores):\n"
+        "    return multihost_utils.process_allgather(scores, tiled=True)\n"
+        "def _host_scores(scores):\n"
+        "    # allowlisted NAME but wrong FILE: still banned\n"
+        "    return multihost_utils.process_allgather(scores, tiled=True)\n"
+    )
+    (par / "distributed.py").write_text(
+        '"""Cites Foo.scala:1."""\n'
+        "from jax.experimental import multihost_utils\n"
+        "def _host_scores(scores):\n"
+        "    return multihost_utils.process_allgather(scores, tiled=True)\n"
+    )
+    problems = lint_parity.run_lints(tmp_path)
+    assert any("bad_driver.py:2" in p and "read_merged" in p for p in problems)
+    assert any("bad_driver.py:4" in p for p in problems)
+    assert not any("good_driver.py" in p for p in problems)
+    assert any("funnel.py:4" in p and "process_allgather" in p
+               for p in problems)
+    assert any("funnel.py:7" in p for p in problems)  # wrong file
+    assert not any("distributed.py" in p for p in problems)  # allowlisted
