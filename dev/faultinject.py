@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Fault-injection harness for the resilience layer's chaos suite.
+
+Context-manager/callable injectors that manufacture the failure classes
+ISSUE 3 names — each maps to a recovery path the chaos tests
+(tests/test_resilience.py) drive end to end on the virtual CPU mesh:
+
+- :func:`flaky` / :class:`FlakyCallable` — fails N times then succeeds
+  (the transient-tunnel shape; exercises RetryPolicy).
+- :func:`truncate_avro_block` / :func:`corrupt_avro_block` /
+  :func:`break_avro_sync` — in-place container damage (exercises the
+  quarantine readers in io/avro.py).
+- :func:`crash_before_replace` — raises between the checkpoint's temp-dir
+  write and its ``os.replace`` publish (exercises save atomicity).
+- :func:`corrupt_checkpoint_step` — truncates a saved ``step_*`` dir's
+  files (exercises restore's newest-intact-step fallback).
+- :class:`WithholdingExchange` — a MetadataExchange wrapper whose rank
+  never publishes selected tags (exercises ExchangeTimeout attribution).
+- :func:`poison_coordinate_updates` — NaN-poisons the first K model
+  updates of one coordinate class (exercises DivergenceError +
+  checkpoint-restore recovery).
+
+Dev-tooling, not shipped API: lives next to dev/lint_parity.py and is
+imported only by tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Callable
+
+
+class InjectedCrash(RuntimeError):
+    """The harness's stand-in for a hard process death at a chosen point."""
+
+
+@dataclasses.dataclass
+class FlakyCallable:
+    """Calls ``fn`` but raises ``exc_factory()`` for the first
+    ``failures`` invocations — the flaky-then-succeeding callable."""
+
+    fn: Callable
+    failures: int
+    exc_factory: Callable[[], BaseException] = ConnectionError
+    calls: int = 0
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc_factory()
+        return self.fn(*args, **kwargs)
+
+
+def flaky(failures: int, exc_factory=ConnectionError, result=None):
+    """A FlakyCallable returning ``result`` once the failures run out."""
+    return FlakyCallable(fn=lambda: result, failures=failures,
+                         exc_factory=exc_factory)
+
+
+# ---------------------------------------------------------------------------
+# Avro container damage (in place, on a copy the test owns)
+# ---------------------------------------------------------------------------
+
+
+def _block_span(path: str | os.PathLike, block: int) -> tuple[int, int, int]:
+    """(payload_offset, payload_size, record_count) of block ``block``."""
+    from photon_ml_tpu.io.avro import scan_block_index
+
+    index = scan_block_index(path)
+    n_records, size, offset = index[block]
+    return offset, size, n_records
+
+
+def truncate_avro_block(path: str | os.PathLike, block: int = -1) -> None:
+    """Cut the file mid-way through ``block``'s payload (default: last
+    block) — the torn-write / partial-copy shape."""
+    from photon_ml_tpu.io.avro import scan_block_index
+
+    index = scan_block_index(path)
+    offset, size, _ = _block_span(path, block % len(index))
+    with open(path, "r+b") as f:
+        f.truncate(offset + max(size // 2, 1))
+
+
+def corrupt_avro_block(path: str | os.PathLike, block: int = 0,
+                       nbytes: int = 8) -> None:
+    """Overwrite the first ``nbytes`` of ``block``'s payload with 0xFF —
+    bit-rot inside an intact frame (framing/sync stay valid)."""
+    offset, size, _ = _block_span(path, block)
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        f.write(b"\xff" * min(nbytes, size))
+
+
+def break_avro_sync(path: str | os.PathLike, block: int = 0) -> None:
+    """Destroy the 16-byte sync marker TRAILING ``block`` — the following
+    block becomes unreachable (resync skips to the next intact marker)."""
+    offset, size, _ = _block_span(path, block)
+    with open(path, "r+b") as f:
+        f.seek(offset + size)
+        f.write(b"\xaa" * 16)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint damage
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def crash_before_replace():
+    """Patch ``os.replace`` to raise InjectedCrash — the save dies after
+    its temp-dir write, before the atomic publish (the window the
+    checkpointer's atomicity contract covers). Module-global patch;
+    restore is guaranteed on exit."""
+    real = os.replace
+
+    def boom(*args, **kwargs):
+        raise InjectedCrash(
+            "injected crash between temp-dir write and os.replace"
+        )
+
+    os.replace = boom
+    try:
+        yield
+    finally:
+        os.replace = real
+
+
+def corrupt_checkpoint_step(directory: str | os.PathLike, step: int,
+                            target: str = "arrays.npz") -> None:
+    """Truncate ``step_<k>/<target>`` to half — external damage to a
+    PUBLISHED checkpoint (the atomic save never produces this; a torn
+    disk/copy does)."""
+    path = os.path.join(str(directory), f"step_{step:08d}", target)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+
+
+# ---------------------------------------------------------------------------
+# Exchange withholding
+# ---------------------------------------------------------------------------
+
+
+class WithholdingExchange:
+    """Wraps a MetadataExchange; this rank never publishes (never calls)
+    exchanges whose tag contains any of ``withhold`` — simulating a rank
+    that crashed or skipped a collective. The OTHER ranks' deadline then
+    fires a rank-attributed ExchangeTimeout naming this rank."""
+
+    def __init__(self, inner, withhold: tuple[str, ...]):
+        self._inner = inner
+        self._withhold = tuple(withhold)
+        self.rank = inner.rank
+        self.num_ranks = inner.num_ranks
+
+    def _withheld(self, tag: str) -> bool:
+        return any(w in tag for w in self._withhold)
+
+    def allgather(self, tag: str, payload) -> list:
+        if self._withheld(tag):
+            raise InjectedCrash(
+                f"rank {self.rank} withheld allgather {tag!r}"
+            )
+        return self._inner.allgather(tag, payload)
+
+    def barrier(self, tag: str) -> None:
+        if self._withheld(tag):
+            raise InjectedCrash(
+                f"rank {self.rank} withheld barrier {tag!r}"
+            )
+        return self._inner.barrier(tag)
+
+
+# ---------------------------------------------------------------------------
+# NaN poisoning
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def poison_coordinate_updates(coordinate_cls, times: int = 1):
+    """Patch ``coordinate_cls.update_model`` so its first ``times`` calls
+    return a NaN-poisoned model — a diverged-lane stand-in the CD loop's
+    finite check must catch as DivergenceError. Subsequent calls behave
+    normally (so a checkpoint-restore retry succeeds)."""
+    import numpy as np
+
+    real = coordinate_cls.update_model
+    state = {"remaining": int(times)}
+
+    def poisoned(self, model, partial_scores):
+        out_model, info = real(self, model, partial_scores)
+        if state["remaining"] > 0:
+            state["remaining"] -= 1
+            poisoned_model = _nan_poison_model(out_model, np)
+            return poisoned_model, info
+        return out_model, info
+
+    coordinate_cls.update_model = poisoned
+    try:
+        yield state
+    finally:
+        coordinate_cls.update_model = real
+
+
+def _nan_poison_model(model, np):
+    """A copy of ``model`` with its leading coefficient array set to NaN
+    (enough for the coordinate's re-score to go non-finite)."""
+    import dataclasses as dc
+
+    from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+
+    if isinstance(model, FixedEffectModel):
+        coeffs = model.glm.coefficients
+        means = np.full_like(np.asarray(coeffs.means), np.nan)
+        return dc.replace(
+            model,
+            glm=dc.replace(
+                model.glm, coefficients=dc.replace(coeffs, means=means)
+            ),
+        )
+    if isinstance(model, RandomEffectModel):
+        poisoned = np.full_like(np.asarray(model.coefficients), np.nan)
+        return dc.replace(model, coefficients=poisoned)
+    raise TypeError(f"cannot NaN-poison model type {type(model)!r}")

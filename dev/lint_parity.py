@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static parity-convention lints for photon_ml_tpu (CLAUDE.md conventions).
 
-Four checks, all pure-AST (no jax import; runs in milliseconds):
+Five checks, all pure-AST (no jax import; runs in milliseconds):
 
 1. **Docstring citations** — every ``photon_ml_tpu/**/*.py`` module (except
    ``__init__.py`` re-export shims) must carry a module docstring that
@@ -34,6 +34,17 @@ Four checks, all pure-AST (no jax import; runs in milliseconds):
    the full vector — and the ``to_host`` state gathers); new score paths
    go through ``parallel.scoring.DistributedScorer.score_partitioned`` +
    ``io.score_writer.ShardedScoreWriter``.
+
+5. **Broad excepts** — bare ``except:`` / ``except Exception:`` /
+   ``except BaseException:`` silently swallow the very failures the
+   resilience layer exists to classify (photon_ml_tpu/resilience/errors
+   is the ONE reviewed transient-vs-fatal decision point; the r2 "compile
+   service flakiness" survived a whole round inside an unattributed catch).
+   A broad handler passes only when it RE-RAISES (a ``raise`` statement
+   anywhere in the handler — the cleanup-and-propagate pattern) or when
+   its (file, function) is on the resilience classifier's reviewed
+   allowlist below (capability probes, destructor guards, listener
+   isolation).
 
 Exit status 0 = clean; 1 = violations (printed one per line as
 ``path:lineno: message``). Run from the repo root:
@@ -226,6 +237,88 @@ def check_score_allgathers(root: pathlib.Path) -> list[str]:
     return problems
 
 
+#: the resilience classifier's allowlist: (file, function) pairs whose
+#: broad excepts are REVIEWED swallows — capability probes whose failure
+#: IS the answer, destructor/listener isolation, and the classifier
+#: consumers themselves (resilience/policy.py, resilience/recovery.py:
+#: their handlers consult classify_exception and re-raise fatal errors).
+#: Everything else must catch typed exceptions or re-raise.
+BROAD_EXCEPT_ALLOWED = {
+    (f"{PACKAGE}/resilience/policy.py", "call"),
+    (f"{PACKAGE}/resilience/recovery.py", "run_with_recovery"),
+    (f"{PACKAGE}/telemetry/probes.py", "live_buffer_bytes"),
+    (f"{PACKAGE}/telemetry/journal.py", "_process_index"),
+    (f"{PACKAGE}/io/offheap_index_map.py", "__del__"),
+    (f"{PACKAGE}/native/build.py", "native_available"),
+    (f"{PACKAGE}/native/build.py", "libsvm_native_available"),
+    (f"{PACKAGE}/native/build.py", "avro_native_available"),
+    (f"{PACKAGE}/util/timed.py", "__enter__"),
+    (f"{PACKAGE}/util/events.py", "send"),
+    (f"{PACKAGE}/cli/game_training_driver.py", "validate"),
+}
+
+_BROAD_NAMES = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    if isinstance(t, ast.Name) and t.id in _BROAD_NAMES:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD_NAMES for e in t.elts
+        )
+    return False
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """Any raise in the handler body: cleanup-and-propagate (bare
+    ``raise``) or typed transformation (``raise X(...) from e``) — the
+    original failure is not swallowed either way."""
+    return any(
+        isinstance(node, ast.Raise)
+        for stmt in handler.body
+        for node in ast.walk(stmt)
+    )
+
+
+def check_broad_excepts(root: pathlib.Path) -> list[str]:
+    problems = []
+    for path in sorted((root / PACKAGE).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        tree = ast.parse(path.read_text())
+
+        stack: list[str] = []
+
+        def visit(node):
+            is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            if is_fn:
+                stack.append(node.name)
+            if (
+                isinstance(node, ast.ExceptHandler)
+                and _is_broad(node)
+                and not _reraises(node)
+                and not (stack and (rel, stack[-1]) in BROAD_EXCEPT_ALLOWED)
+            ):
+                problems.append(
+                    f"{rel}:{node.lineno}: broad except "
+                    "(bare/Exception/BaseException) that swallows the "
+                    "error — catch typed exceptions, re-raise, or route "
+                    "the decision through resilience.classify_exception "
+                    "and add the (file, function) to the reviewed "
+                    "allowlist in dev/lint_parity.py"
+                )
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_fn:
+                stack.pop()
+
+        visit(tree)
+    return problems
+
+
 def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
     root = pathlib.Path(root) if root else pathlib.Path(__file__).resolve().parents[1]
     return (
@@ -233,6 +326,7 @@ def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
         + check_banned_linalg(root)
         + check_cli_full_reads(root)
         + check_score_allgathers(root)
+        + check_broad_excepts(root)
     )
 
 

@@ -110,6 +110,12 @@ def run_coordinate_descent(
             restored_model, best_model, best_metric, history = unpack_cd_state(ckpt)
             restored = restored_model.models
             start_slot = int(ckpt.step)
+            # journaled restore evidence (resilience/checkpoint_restores):
+            # both user-driven resume and driver-level crash recovery
+            # (resilience/recovery.py) pass through here
+            from photon_ml_tpu.telemetry import resilience_counters
+
+            resilience_counters.record_checkpoint_restore()
             logger.info(
                 "Resuming coordinate descent from checkpoint step %d", start_slot
             )

@@ -47,8 +47,18 @@ def run(
     mesh_shape: dict | None = None,
     fe_feature_sharded: bool = False,
     partitioned_io: bool = False,
+    on_corrupt: str = "raise",
+    telemetry_dir: str | None = None,
 ) -> dict:
     """Score ``input_data_path`` with the model at ``model_input_dir``.
+
+    on_corrupt: "raise" (strict, default) or "quarantine" — skip-and-count
+    corrupt Avro container blocks during ingestion (io/avro.py); spans and
+    the resilience/* counters land in the run journal.
+
+    telemetry_dir: rank-0 JSONL run journal (phase timings, io/resilience
+    counters) — written on the FAILURE path too, so a scoring run that
+    died mid-read still leaves its retry/quarantine evidence.
 
     Index maps default to the ones the training driver saved next to the
     model (<root>/index-maps); feature shard configs default to one shard
@@ -72,9 +82,73 @@ def run(
     """
     import jax
 
+    if on_corrupt not in ("raise", "quarantine"):
+        raise ValueError(
+            f"on_corrupt must be 'raise' or 'quarantine', got {on_corrupt!r}"
+        )
     partitioned = partitioned_io and jax.process_count() > 1
     if partitioned and not (distributed or mesh_shape):
         raise ValueError("--partitioned-io requires --distributed or --mesh")
+    from photon_ml_tpu.telemetry import RunJournal
+    from photon_ml_tpu.util.timed import reset_timings, timing_summary
+
+    reset_timings()
+    journal = RunJournal(telemetry_dir) if telemetry_dir else None
+    try:
+        summary = _run_inner(
+            input_data_path=input_data_path,
+            model_input_dir=model_input_dir,
+            output_dir=output_dir,
+            feature_shards=feature_shards,
+            index_maps_dir=index_maps_dir,
+            evaluators=evaluators,
+            model_id=model_id,
+            input_format=input_format,
+            compact_random_effect_threshold=compact_random_effect_threshold,
+            distributed=distributed,
+            mesh_shape=mesh_shape,
+            fe_feature_sharded=fe_feature_sharded,
+            partitioned=partitioned,
+            on_corrupt=on_corrupt,
+        )
+        if journal is not None:
+            journal.record("scoring_summary", **summary)
+        return summary
+    finally:
+        # failure-path journaling too: the resilience/* counters (retries,
+        # giveups, quarantined_blocks) and quarantine spans are exactly
+        # what a post-mortem of a dead scoring run needs
+        if journal is not None:
+            from photon_ml_tpu.telemetry import (
+                default_registry,
+                resilience_counters,
+            )
+
+            for event in resilience_counters.drain_quarantine_events():
+                journal.record("quarantined_block", **event)
+            journal.record_timings(timing_summary())
+            journal.record_metrics(default_registry().snapshot())
+            journal.close()
+
+
+def _run_inner(
+    *,
+    input_data_path: str,
+    model_input_dir: str,
+    output_dir: str,
+    feature_shards: dict | None,
+    index_maps_dir: str | None,
+    evaluators: Sequence[str],
+    model_id: str,
+    input_format: str,
+    compact_random_effect_threshold: int,
+    distributed: bool,
+    mesh_shape: dict | None,
+    fe_feature_sharded: bool,
+    partitioned: bool,
+    on_corrupt: str,
+) -> dict:
+    import jax
     if partitioned and evaluators:
         raise ValueError(
             "--partitioned-io does not support --evaluators yet; evaluate "
@@ -173,16 +247,28 @@ def run(
         pad_multiple = data_axis // exchange.num_ranks
 
     with Timed("read scoring data"):
-        part = read_partitioned(
-            input_data_path,
-            feature_shards,
-            exchange=exchange,
-            index_maps=index_maps or None,
-            random_effect_id_columns=re_columns,
-            evaluation_id_columns=evaluation_id_columns(evaluators),
-            entity_vocabs=entity_vocabs,
-            fmt=input_format,
-            pad_multiple=pad_multiple,
+        from photon_ml_tpu.resilience import default_io_policy
+
+        def _read():
+            return read_partitioned(
+                input_data_path,
+                feature_shards,
+                exchange=exchange,
+                index_maps=index_maps or None,
+                random_effect_id_columns=re_columns,
+                evaluation_id_columns=evaluation_id_columns(evaluators),
+                entity_vocabs=entity_vocabs,
+                fmt=input_format,
+                pad_multiple=pad_multiple,
+                on_corrupt=on_corrupt,
+            )
+
+        # transient-I/O retry only on the non-collective path: retrying one
+        # rank of an exchange-coordinated read would desynchronize the SPMD
+        # exchange sequence (the collective path has deadlines instead)
+        part = (
+            _read() if exchange is not None
+            else default_io_policy().call(_read, description="read scoring data")
         )
         data = part.result
     partition = part.partition
@@ -230,10 +316,21 @@ def run(
         return summary
 
     with Timed("score"):
-        scored = GameTransformer(
+        from photon_ml_tpu.resilience import default_dispatch_policy
+
+        transformer = GameTransformer(
             model=model, evaluator_specs=tuple(evaluators),
             mesh=mesh, fe_feature_sharded=fe_feature_sharded,
-        ).transform(data.dataset)
+        )
+        # the remote-compile/dispatch boundary: retry classified-transient
+        # tunnel failures, single-process only (a multi-process transform
+        # joins cross-process collectives — one rank retrying desyncs them)
+        if jax.process_count() == 1:
+            scored = default_dispatch_policy().call(
+                transformer.transform, data.dataset, description="score"
+            )
+        else:
+            scored = transformer.transform(data.dataset)
 
     summary = {"num_scored": int(len(scored.scores)), "evaluations": scored.evaluations}
     # multi-process rule: every rank participated in the scoring collectives
@@ -285,6 +382,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "input and writes its own part-NNNNN.avro score "
                         "shard into the SHARED --output-dir (no "
                         "process_allgather funnel; no --evaluators yet)")
+    p.add_argument("--on-corrupt", default="raise",
+                   choices=["raise", "quarantine"],
+                   help="corrupt Avro blocks: 'raise' (strict, default) "
+                        "or 'quarantine' (skip-and-count; spans journaled)")
+    p.add_argument("--telemetry-dir",
+                   help="write a rank-0 JSONL run journal (phase timings, "
+                        "io + resilience counters) here — on the failure "
+                        "path too")
     return p
 
 
@@ -309,6 +414,8 @@ def main(argv: Sequence[str] | None = None) -> dict:
         distributed=args.distributed,
         mesh_shape=_parse_mesh_shape(args.mesh),
         partitioned_io=args.partitioned_io,
+        on_corrupt=args.on_corrupt,
+        telemetry_dir=args.telemetry_dir,
     )
 
 

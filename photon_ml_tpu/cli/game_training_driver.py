@@ -140,10 +140,27 @@ class GameTrainingParams:
     #: partitions solve per-rank (entity-cluster the input for exact
     #: full-read parity). Single-process runs are unaffected.
     partitioned_io: bool = False
+    #: corrupt-input handling for Avro ingestion: "raise" (strict,
+    #: default) or "quarantine" (skip-and-count corrupt container blocks;
+    #: spans journaled — io/avro.py, resilience layer)
+    on_corrupt: str = "raise"
+    #: crash-safe recovery budget: a mid-sweep DivergenceError (with a
+    #: checkpoint to restore) or classified-transient failure restarts the
+    #: configuration — resuming from the latest intact checkpoint — up to
+    #: this many times before the error propagates
+    #: (resilience/recovery.py). 0 disables recovery.
+    max_restarts: int = 2
 
     def validate(self) -> None:
         """Cross-parameter checks (reference validateParams:196-298)."""
         problems = []
+        if self.on_corrupt not in ("raise", "quarantine"):
+            problems.append(
+                f"--on-corrupt must be 'raise' or 'quarantine', got "
+                f"{self.on_corrupt!r}"
+            )
+        if self.max_restarts < 0:
+            problems.append("--max-restarts must be >= 0")
         sequence = self.update_sequence or tuple(self.coordinates.keys())
         for cid in sequence:
             if cid not in self.coordinates:
@@ -278,8 +295,15 @@ def run(params: GameTrainingParams) -> dict:
         raise
     finally:
         # journal phase timings / gauges on failure too — a failed run's
-        # journal is the one that most needs them
+        # journal is the one that most needs them. The registry snapshot
+        # carries the resilience/* counters (retries, giveups,
+        # quarantined_blocks, checkpoint_restores); quarantined block
+        # SPANS get one forensic row each.
         if journal is not None:
+            from photon_ml_tpu.telemetry import resilience_counters
+
+            for event in resilience_counters.drain_quarantine_events():
+                journal.record("quarantined_block", **event)
             journal.record_timings(timing_summary())
             journal.record_gauge("jax/backend_compile_count", compiles.count)
             journal.record_gauge("device/live_buffer_bytes", live_buffer_bytes())
@@ -373,17 +397,32 @@ def _run_inner(
                 "score + evaluate with the partitioned scoring driver"
             )
 
+    # transient-I/O retry for the ingestion boundary — ONLY when the read
+    # is not collective: retrying one rank of a partitioned (exchange-
+    # coordinated) read would desynchronize the SPMD exchange sequence,
+    # so the collective path keeps its deadlines (ExchangeTimeout) instead
+    from photon_ml_tpu.resilience import default_io_policy
+
+    def _read(description, fn):
+        if exchange is not None:
+            return fn()
+        return default_io_policy().call(fn, description=description)
+
     with Timed("read training data"):
-        train_part = read_partitioned(
-            resolve(params.input_data_path, params.input_date_range),
-            params.feature_shards,
-            exchange=exchange,
-            index_maps=prebuilt_maps,
-            random_effect_id_columns=re_columns,
-            evaluation_id_columns=eval_columns,
-            fmt=params.input_format,
-            pad_multiple=pad_multiple,
-            tag="train",
+        train_part = _read(
+            "read training data",
+            lambda: read_partitioned(
+                resolve(params.input_data_path, params.input_date_range),
+                params.feature_shards,
+                exchange=exchange,
+                index_maps=prebuilt_maps,
+                random_effect_id_columns=re_columns,
+                evaluation_id_columns=eval_columns,
+                fmt=params.input_format,
+                pad_multiple=pad_multiple,
+                tag="train",
+                on_corrupt=params.on_corrupt,
+            ),
         )
         train = train_part.result
     partition = train_part.partition
@@ -402,17 +441,22 @@ def _run_inner(
     validation = None
     if params.validation_data_path:
         with Timed("read validation data"):
-            validation = read_partitioned(
-                resolve(
-                    params.validation_data_path, params.validation_data_date_range
+            validation = _read(
+                "read validation data",
+                lambda: read_partitioned(
+                    resolve(
+                        params.validation_data_path,
+                        params.validation_data_date_range,
+                    ),
+                    params.feature_shards,
+                    index_maps=train.index_maps,
+                    random_effect_id_columns=re_columns,
+                    evaluation_id_columns=eval_columns,
+                    entity_vocabs=train.dataset.entity_vocabs,
+                    fmt=params.input_format,
+                    tag="validation",
+                    on_corrupt=params.on_corrupt,
                 ),
-                params.feature_shards,
-                index_maps=train.index_maps,
-                random_effect_id_columns=re_columns,
-                evaluation_id_columns=eval_columns,
-                entity_vocabs=train.dataset.entity_vocabs,
-                fmt=params.input_format,
-                tag="validation",
             ).result
 
     with Timed("validate data"):
@@ -480,7 +524,9 @@ def _run_inner(
             entity_rank_presence=train_part.entity_rank_presence,
         )
 
-    def make_estimator(reg_weights, checkpointer=None) -> GameEstimator:
+    def make_estimator(
+        reg_weights, checkpointer=None, resume=None
+    ) -> GameEstimator:
         return GameEstimator(
             task=params.task_type,
             coordinate_configs=estimator_coordinate_configs(
@@ -494,7 +540,7 @@ def _run_inner(
             intercept_indices=train.intercept_indices,
             checkpointer=checkpointer,
             checkpoint_every=params.checkpoint_every,
-            resume=params.resume,
+            resume=params.resume if resume is None else resume,
             mesh=mesh,
             fe_feature_sharded=model_axis > 1,
             telemetry=telemetry,
@@ -537,16 +583,42 @@ def _run_inner(
         )
     first_evaluator = parse_evaluator(params.evaluators[0]) if params.evaluators else None
 
+    from photon_ml_tpu.resilience import run_with_recovery
+
     results = []
     warm_model = initial_model
     best_index, best_metric = -1, float("nan")
     for i, reg_weights in enumerate(grid):
         with Timed(f"train config {i}"):
-            est = make_estimator(reg_weights, make_checkpointer(i, reg_weights))
-            result = est.fit(
-                train.dataset,
-                validation_dataset=None if validation is None else validation.dataset,
-                initial_model=warm_model,
+            # crash-safe sweep: a DivergenceError (with a checkpoint to
+            # restore) or classified-transient failure restarts this
+            # configuration — the re-created estimator resumes from the
+            # latest intact checkpoint — instead of aborting the run
+            initial = warm_model
+            ckpt = make_checkpointer(i, reg_weights)
+
+            def attempt(restart: int, _rw=reg_weights, _ck=ckpt, _init=initial):
+                est = make_estimator(
+                    _rw,
+                    _ck,
+                    # restarts must resume even under --no-resume (the
+                    # whole point of the restart is the checkpoint)
+                    resume=params.resume or restart > 0,
+                )
+                return est.fit(
+                    train.dataset,
+                    validation_dataset=(
+                        None if validation is None else validation.dataset
+                    ),
+                    initial_model=_init,
+                )
+
+            result = run_with_recovery(
+                attempt,
+                max_restarts=params.max_restarts,
+                checkpointer=ckpt,
+                journal=telemetry.journal if telemetry is not None else None,
+                description=f"train config {i}",
             )
         # warm start the next grid point (reference GameEstimator.fit:352-366)
         warm_model = result.model
@@ -754,6 +826,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "of the input bytes (per-rank partitioned Avro "
                         "ingestion; dense IDENTITY configs, no validation "
                         "riders — see io/partitioned_reader.py)")
+    p.add_argument("--on-corrupt", default="raise",
+                   choices=["raise", "quarantine"],
+                   help="corrupt Avro blocks: 'raise' (strict, default) "
+                        "or 'quarantine' (skip-and-count; spans journaled "
+                        "via resilience/quarantined_blocks)")
+    p.add_argument("--max-restarts", type=int, default=2,
+                   help="mid-sweep recovery budget: restore the latest "
+                        "intact checkpoint and resume after a divergence/"
+                        "transient failure up to N times (0 disables)")
     return p
 
 
@@ -806,6 +887,8 @@ def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
         distributed=args.distributed or bool(args.mesh),
         mesh_shape=_parse_mesh_shape(args.mesh),
         partitioned_io=args.partitioned_io,
+        on_corrupt=args.on_corrupt,
+        max_restarts=args.max_restarts,
     )
 
 

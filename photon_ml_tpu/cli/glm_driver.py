@@ -102,6 +102,10 @@ class GLMDriverParams:
     #: per-λ convergence rows, compile-count gauge) finalized on completion;
     #: None = disabled
     telemetry_dir: str | None = None
+    #: corrupt-input handling for Avro ingestion: "raise" (strict,
+    #: default) or "quarantine" (skip-and-count corrupt container blocks;
+    #: io/avro.py + resilience layer)
+    on_corrupt: str = "raise"
 
 
 @dataclasses.dataclass
@@ -113,15 +117,21 @@ class GLMDriverResult:
     summary_path: str
 
 
-def _read_batch(path: str, fmt: str, shard_cfg, index_maps=None):
+def _read_batch(path: str, fmt: str, shard_cfg, index_maps=None,
+                on_corrupt: str = "raise"):
     # the single-GLM driver is a one-process tool: read through the
     # ingestion dispatcher with the trivial exchange (identical bytes to
-    # the old direct read; the lint bans direct read_merged in cli/)
+    # the old direct read; the lint bans direct read_merged in cli/),
+    # wrapped in the transient-I/O retry policy (non-collective read)
     from photon_ml_tpu.parallel.multihost import SingleProcessExchange
+    from photon_ml_tpu.resilience import default_io_policy
 
-    result = read_partitioned(
-        path, shard_cfg, exchange=SingleProcessExchange(),
-        index_maps=index_maps, fmt=fmt,
+    result = default_io_policy().call(
+        lambda: read_partitioned(
+            path, shard_cfg, exchange=SingleProcessExchange(),
+            index_maps=index_maps, fmt=fmt, on_corrupt=on_corrupt,
+        ),
+        description=f"read {path}",
     ).result
     ds = result.dataset
     batch = LabeledPointBatch(
@@ -185,8 +195,13 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
         raise
     finally:
         # journal phase timings / gauges on failure too — a failed run's
-        # journal is the one that most needs them
+        # journal is the one that most needs them (the registry snapshot
+        # carries the resilience/* counters)
         if journal is not None:
+            from photon_ml_tpu.telemetry import resilience_counters
+
+            for event in resilience_counters.drain_quarantine_events():
+                journal.record("quarantined_block", **event)
             journal.record_timings(timing_summary())
             journal.record_gauge("jax/backend_compile_count", compiles.count)
             journal.record_metrics(default_registry().snapshot())
@@ -201,7 +216,8 @@ def _run_stages(params: GLMDriverParams, telemetry: SolverTelemetry) -> GLMDrive
         # PREPROCESS
         with Timed("glm preprocess"):
             batch, index_maps, intercept_index = _read_batch(
-                params.input_data_path, params.input_format, shard_cfg
+                params.input_data_path, params.input_format, shard_cfg,
+                on_corrupt=params.on_corrupt,
             )
             validate_arrays(
                 labels=np.asarray(batch.labels),
@@ -276,7 +292,7 @@ def _run_stages(params: GLMDriverParams, telemetry: SolverTelemetry) -> GLMDrive
             with Timed("glm validate"):
                 val_batch, _, _ = _read_batch(
                     params.validation_data_path, params.input_format, shard_cfg,
-                    index_maps,
+                    index_maps, on_corrupt=params.on_corrupt,
                 )
                 metric = _SELECTION_METRIC[params.task_type]
                 larger = METRIC_DIRECTIONS[metric]
@@ -381,6 +397,10 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
     p.add_argument("--telemetry-dir",
                    help="write a JSONL run journal (phase timings, per-λ "
                         "convergence rows, compile counts) here")
+    p.add_argument("--on-corrupt", default="raise",
+                   choices=["raise", "quarantine"],
+                   help="corrupt Avro blocks: 'raise' (strict, default) "
+                        "or 'quarantine' (skip-and-count)")
     args = p.parse_args(argv)
     return run(
         GLMDriverParams(
@@ -404,6 +424,7 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
             coefficient_box_constraints=args.coefficient_box_constraints,
             input_format=args.input_format,
             telemetry_dir=args.telemetry_dir,
+            on_corrupt=args.on_corrupt,
         )
     )
 

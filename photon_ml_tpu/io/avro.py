@@ -14,6 +14,15 @@ implements the parts of the Avro 1.x specification the framework needs:
 Records are plain Python dicts; schemas are the JSON-derived dict form.
 This is a from-scratch implementation of the public Avro spec — no code
 from the reference (which uses the Java Avro library via Spark).
+
+Corrupt-input quarantine (``on_corrupt="quarantine"``): the container
+readers can validate every block's framing (length bounds + trailing sync
+marker) and full decode, SKIP corrupt blocks — resynchronizing on the next
+16-byte sync marker, the recovery the Avro spec designed the marker for —
+and count/journal the quarantined spans via telemetry
+(``resilience/quarantined_blocks``). Strict raise stays the default and
+its code path is byte-for-byte the pre-quarantine one
+(tests/test_avro_native.py pins it).
 """
 
 from __future__ import annotations
@@ -24,6 +33,8 @@ import os
 import struct
 import zlib
 from typing import Any, BinaryIO, Iterable, Iterator
+
+from photon_ml_tpu.telemetry import resilience_counters
 
 MAGIC = b"Obj\x01"
 DEFAULT_SYNC = bytes(range(16))
@@ -390,8 +401,26 @@ def write_container_blocks(
     return count
 
 
-def read_container(path: str | os.PathLike) -> Iterator[dict]:
-    """Iterate records of an Avro object container file."""
+def _check_on_corrupt(on_corrupt: str) -> None:
+    if on_corrupt not in ("raise", "quarantine"):
+        raise ValueError(
+            f"on_corrupt must be 'raise' or 'quarantine', got {on_corrupt!r}"
+        )
+
+
+def read_container(
+    path: str | os.PathLike, *, on_corrupt: str = "raise"
+) -> Iterator[dict]:
+    """Iterate records of an Avro object container file.
+
+    on_corrupt: "raise" (default — strict, byte-identical to the original
+    reader) or "quarantine" (skip corrupt blocks, resync on the sync
+    marker, count+journal each skipped span; a block either decodes fully
+    or contributes nothing)."""
+    _check_on_corrupt(on_corrupt)
+    if on_corrupt == "quarantine":
+        yield from _read_container_quarantine(path)
+        return
     with open(path, "rb") as inp:
         if inp.read(4) != MAGIC:
             raise AvroError(f"{path}: not an Avro container file")
@@ -417,12 +446,181 @@ def read_container(path: str | os.PathLike) -> Iterator[dict]:
                 raise AvroError(f"{path}: sync marker mismatch")
 
 
-def scan_block_index(path: str | os.PathLike) -> list[tuple[int, int, int]]:
+#: framing sanity bound: one block's record count / payload size can never
+#: exceed the file size (a corrupt varint otherwise "allocates" petabytes)
+def _plausible(n: int, limit: int) -> bool:
+    return 0 <= n <= limit
+
+
+def _resync(inp: BinaryIO, sync: bytes, start: int) -> int | None:
+    """Scan forward from ``start`` for the next occurrence of the 16-byte
+    sync marker; return the offset just AFTER it (the next block's start),
+    or None when no further marker exists. Chunked with a 15-byte overlap
+    so markers spanning chunk boundaries are found."""
+    chunk = 1 << 16
+    inp.seek(start)
+    tail = b""
+    while True:
+        pos = inp.tell()
+        data = inp.read(chunk)
+        if not data:
+            return None
+        buf = tail + data
+        hit = buf.find(sync)
+        if hit >= 0:
+            return pos - len(tail) + hit + 16
+        tail = buf[-15:]
+
+
+def _read_header(inp: BinaryIO, path) -> tuple[Any, SchemaRegistry, str, bytes]:
+    """(schema, registry, codec, sync) of an open container, or AvroError.
+    Header corruption is not quarantinable — without the schema and sync
+    marker nothing downstream can be decoded or resynced."""
+    if inp.read(4) != MAGIC:
+        raise AvroError(f"{path}: not an Avro container file")
+    meta = BinaryDecoder(inp, SchemaRegistry()).read(_META_SCHEMA)
+    schema, registry = parse_schema(meta["avro.schema"].decode("utf-8"))
+    codec = meta.get("avro.codec", b"null").decode("utf-8")
+    sync = inp.read(16)
+    if len(sync) != 16:
+        raise AvroError(f"{path}: truncated container header")
+    return schema, registry, codec, sync
+
+
+def _read_container_quarantine(path: str | os.PathLike) -> Iterator[dict]:
+    """The skip-and-count reader behind ``on_corrupt='quarantine'``.
+
+    Per block: validate count/size bounds, read the full payload, verify
+    the trailing sync marker, decompress, decode ALL records — and only
+    then yield them. Any failure quarantines the whole block (partial
+    blocks never leak half-decoded records), records the byte span via
+    telemetry, and resyncs on the next sync marker."""
+    file_size = os.path.getsize(path)
+    with open(path, "rb") as inp:
+        schema, registry, codec, sync = _read_header(inp, path)
+        if codec not in ("null", "deflate"):
+            raise AvroError(f"{path}: unsupported codec {codec!r}")
+        block_index = 0
+        while True:
+            block_start = inp.tell()
+            if block_start >= file_size:
+                return
+            records: list[dict] = []
+            try:
+                n_records = read_long(inp)
+                size = read_long(inp)
+                if not _plausible(n_records, file_size) or not _plausible(
+                    size, file_size - inp.tell()
+                ):
+                    raise AvroError(
+                        f"implausible block framing (count={n_records}, "
+                        f"size={size})"
+                    )
+                payload = inp.read(size)
+                if len(payload) != size:
+                    raise AvroError("truncated block payload")
+                trailer = inp.read(16)
+                if trailer != sync:
+                    raise AvroError("sync marker mismatch")
+                if codec == "deflate":
+                    payload = zlib.decompress(payload, -15)
+                buf = _io.BytesIO(payload)
+                dec = BinaryDecoder(buf, registry)
+                for _ in range(n_records):
+                    records.append(dec.read(schema))
+                if buf.read(1):
+                    raise AvroError("trailing bytes after last record")
+            # clean EOF returns before the try (block_start >= file_size),
+            # so an EOFError here is corruption — a truncated tail or a
+            # payload whose decode ran off its end — never end-of-data
+            except (AvroError, EOFError, zlib.error, struct.error,
+                    ValueError, IndexError, KeyError,
+                    UnicodeDecodeError) as e:
+                nxt = _resync(inp, sync, block_start + 1)
+                end = file_size if nxt is None else nxt
+                resilience_counters.record_quarantined_block(
+                    str(path), block_index, block_start, end,
+                    f"{type(e).__name__}: {e}",
+                )
+                block_index += 1
+                if nxt is None:
+                    return
+                inp.seek(nxt)
+                continue
+            block_index += 1
+            yield from records
+
+
+def validate_container(
+    path: str | os.PathLike,
+) -> list[tuple[int, int, int, str]]:
+    """Framing-only corruption scan: [(block_index, byte_start, byte_end,
+    reason), ...] — empty means every block's length bounds and trailing
+    sync marker check out. Cost is the header decode + one seek and a
+    16-byte read per block (never a payload read), so the native decode
+    path can gate on it cheaply before trusting a file
+    (io/data_reader._read_merged_avro_native under quarantine)."""
+    problems: list[tuple[int, int, int, str]] = []
+    file_size = os.path.getsize(path)
+    with open(path, "rb") as inp:
+        _, _, codec, sync = _read_header(inp, path)
+        if codec not in ("null", "deflate"):
+            raise AvroError(f"{path}: unsupported codec {codec!r}")
+        block_index = 0
+        while True:
+            block_start = inp.tell()
+            if block_start >= file_size:
+                return problems
+            try:
+                n_records = read_long(inp)
+                size = read_long(inp)
+                if not _plausible(n_records, file_size) or not _plausible(
+                    size, file_size - inp.tell()
+                ):
+                    raise AvroError(
+                        f"implausible block framing (count={n_records}, "
+                        f"size={size})"
+                    )
+                inp.seek(size, os.SEEK_CUR)
+                trailer = inp.read(16)
+                if trailer != sync:
+                    raise AvroError("sync marker mismatch")
+            except EOFError:
+                if block_start >= file_size:
+                    return problems
+                problems.append(
+                    (block_index, block_start, file_size,
+                     "truncated final block")
+                )
+                return problems
+            except AvroError as e:
+                nxt = _resync(inp, sync, block_start + 1)
+                end = file_size if nxt is None else nxt
+                problems.append((block_index, block_start, end, str(e)))
+                block_index += 1
+                if nxt is None:
+                    return problems
+                inp.seek(nxt)
+                continue
+            block_index += 1
+
+
+def scan_block_index(
+    path: str | os.PathLike, *, on_corrupt: str = "raise"
+) -> list[tuple[int, int, int]]:
     """The container's block index: [(record_count, payload_bytes,
     payload_offset), ...] — scanned by SEEKING past every payload, so the
     cost is header decode + one seek per block, not a data read. This is
     what makes block-level partitioned ingestion cheap to plan
-    (io/partitioned_reader.py splits few-large-files inputs by blocks)."""
+    (io/partitioned_reader.py splits few-large-files inputs by blocks).
+
+    on_corrupt="quarantine" additionally VALIDATES each block's framing
+    (length bounds + trailing sync marker — a 16-byte read per block) and
+    drops corrupt spans from the index, counting each via telemetry; the
+    default scan stays the seek-only fast path."""
+    _check_on_corrupt(on_corrupt)
+    if on_corrupt == "quarantine":
+        return _scan_block_index_quarantine(path)
     blocks: list[tuple[int, int, int]] = []
     with open(path, "rb") as inp:
         if inp.read(4) != MAGIC:
@@ -439,19 +637,81 @@ def scan_block_index(path: str | os.PathLike) -> list[tuple[int, int, int]]:
             inp.seek(size + 16, os.SEEK_CUR)  # payload + sync
 
 
+def _scan_block_index_quarantine(
+    path: str | os.PathLike,
+) -> list[tuple[int, int, int]]:
+    """Framing-validated block index: corrupt spans are skipped-and-counted
+    here (the planning pass is the authoritative skip decision for the
+    blocks-mode partitioned read; the block-range reader then only ever
+    decodes framing-intact blocks)."""
+    file_size = os.path.getsize(path)
+    blocks: list[tuple[int, int, int]] = []
+    with open(path, "rb") as inp:
+        _, _, codec, sync = _read_header(inp, path)
+        if codec not in ("null", "deflate"):
+            raise AvroError(f"{path}: unsupported codec {codec!r}")
+        block_index = 0
+        while True:
+            block_start = inp.tell()
+            if block_start >= file_size:
+                return blocks
+            try:
+                n_records = read_long(inp)
+                size = read_long(inp)
+                if not _plausible(n_records, file_size) or not _plausible(
+                    size, file_size - inp.tell()
+                ):
+                    raise AvroError(
+                        f"implausible block framing (count={n_records}, "
+                        f"size={size})"
+                    )
+                payload_offset = inp.tell()
+                inp.seek(size, os.SEEK_CUR)
+                if inp.read(16) != sync:
+                    raise AvroError("sync marker mismatch")
+            except EOFError:
+                if block_start >= file_size:
+                    return blocks
+                resilience_counters.record_quarantined_block(
+                    str(path), block_index, block_start, file_size,
+                    "truncated final block",
+                )
+                return blocks
+            except AvroError as e:
+                nxt = _resync(inp, sync, block_start + 1)
+                end = file_size if nxt is None else nxt
+                resilience_counters.record_quarantined_block(
+                    str(path), block_index, block_start, end, str(e)
+                )
+                block_index += 1
+                if nxt is None:
+                    return blocks
+                inp.seek(nxt)
+                continue
+            blocks.append((n_records, size, payload_offset))
+            block_index += 1
+
+
 def read_container_block_range(
     path: str | os.PathLike, start_block: int, num_blocks: int,
     index: "list[tuple[int, int, int]] | None" = None,
+    *, on_corrupt: str = "raise",
 ) -> Iterator[dict]:
     """Iterate the records of blocks [start_block, start_block+num_blocks)
     only — the partitioned reader's entry for a rank's block assignment.
     Seeks directly to the first selected payload via the block index
     (pass ``index`` from a prior :func:`scan_block_index` to skip the
-    re-scan — the partitioned planner already holds it)."""
+    re-scan — the partitioned planner already holds it).
+
+    on_corrupt="quarantine": a block whose payload fails to decompress or
+    decode is skipped-and-counted instead of raising (framing corruption
+    is the quarantining index scan's job — pass an index scanned with the
+    same mode)."""
+    _check_on_corrupt(on_corrupt)
     if num_blocks <= 0:
         return
     if index is None:
-        index = scan_block_index(path)
+        index = scan_block_index(path, on_corrupt=on_corrupt)
     selected = index[start_block:start_block + num_blocks]
     if len(selected) != num_blocks:
         raise AvroError(
@@ -463,9 +723,34 @@ def read_container_block_range(
         meta = BinaryDecoder(inp, SchemaRegistry()).read(_META_SCHEMA)
         schema, registry = parse_schema(meta["avro.schema"].decode("utf-8"))
         codec = meta.get("avro.codec", b"null").decode("utf-8")
-        for n_records, size, offset in selected:
+        for bi, (n_records, size, offset) in enumerate(selected):
             inp.seek(offset)
             payload = inp.read(size)
+            if on_corrupt == "quarantine":
+                records: list[dict] = []
+                try:
+                    if len(payload) != size:
+                        raise AvroError("truncated block payload")
+                    if codec == "deflate":
+                        payload = zlib.decompress(payload, -15)
+                    elif codec != "null":
+                        raise AvroError(f"unsupported codec {codec!r}")
+                    buf = _io.BytesIO(payload)
+                    dec = BinaryDecoder(buf, registry)
+                    for _ in range(n_records):
+                        records.append(dec.read(schema))
+                    if buf.read(1):
+                        raise AvroError("trailing bytes after last record")
+                except (AvroError, EOFError, zlib.error, struct.error,
+                        ValueError, IndexError, KeyError,
+                        UnicodeDecodeError) as e:
+                    resilience_counters.record_quarantined_block(
+                        str(path), start_block + bi, offset, offset + size,
+                        f"{type(e).__name__}: {e}",
+                    )
+                    continue
+                yield from records
+                continue
             if codec == "deflate":
                 payload = zlib.decompress(payload, -15)
             elif codec != "null":
@@ -499,8 +784,10 @@ def list_avro_files(path: str | os.PathLike) -> list[str]:
     return [os.path.join(p, name) for name in names]
 
 
-def read_directory(path: str | os.PathLike) -> Iterator[dict]:
+def read_directory(
+    path: str | os.PathLike, *, on_corrupt: str = "raise"
+) -> Iterator[dict]:
     """Read every ``*.avro`` file under a directory (the reference reads
     HDFS directories of part files, AvroUtils.scala readAvroFiles)."""
     for name in list_avro_files(path):
-        yield from read_container(name)
+        yield from read_container(name, on_corrupt=on_corrupt)

@@ -27,13 +27,17 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import shutil
 import tempfile
+import zipfile
 from typing import Any, Mapping
 
 import jax
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.game import (
@@ -121,20 +125,18 @@ class TrainingCheckpointer:
         steps = self.steps()
         return steps[-1] if steps else None
 
-    def restore(self, step: int | None = None) -> Checkpoint | None:
-        """Restore ``step`` (default: latest intact step).
+    #: everything a truncated/garbled step file can raise during load:
+    #: zip directory damage (BadZipFile), npz entry damage (zlib via
+    #: ValueError/OSError), meta damage (JSONDecodeError is a ValueError)
+    _CORRUPT_ERRORS = (
+        OSError,
+        EOFError,
+        ValueError,
+        KeyError,
+        zipfile.BadZipFile,
+    )
 
-        Returns None when no intact checkpoint exists; raises ValueError for
-        an explicitly-requested step that is missing or not intact.
-        """
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                return None
-        elif step not in self.steps():
-            raise ValueError(
-                f"checkpoint step {step} not found (intact steps: {self.steps()})"
-            )
+    def _load(self, step: int) -> Checkpoint:
         step_dir = os.path.join(self.directory, f"{_STEP_PREFIX}{step:08d}")
         with open(os.path.join(step_dir, _META_FILE)) as f:
             meta = json.load(f)
@@ -142,9 +144,72 @@ class TrainingCheckpointer:
             arrays = {k: z[k] for k in z.files}
         return Checkpoint(step=step, arrays=arrays, meta=meta)
 
+    def restore(self, step: int | None = None) -> Checkpoint | None:
+        """Restore ``step`` (default: NEWEST step that actually loads).
+
+        A ``step_<k>/`` dir whose ``arrays.npz`` or ``meta.json`` is
+        truncated/garbled (external damage — the atomic save never
+        produces one) is skipped with a warning and the next older step is
+        tried, so resume degrades to the newest INTACT step instead of
+        aborting. Returns None when no step loads; raises ValueError for
+        an explicitly-requested step that is missing, and the underlying
+        error for one that is present but corrupt (an explicit request
+        must not silently resolve to a different step).
+        """
+        if step is not None:
+            if step not in self.steps():
+                raise ValueError(
+                    f"checkpoint step {step} not found (intact steps: "
+                    f"{self.steps()})"
+                )
+            return self._load(step)
+        for candidate in reversed(self.steps()):
+            try:
+                return self._load(candidate)
+            except self._CORRUPT_ERRORS as e:
+                logger.warning(
+                    "checkpoint step %d at %s is corrupt (%s: %s); falling "
+                    "back to the previous step",
+                    candidate, self.directory, type(e).__name__, e,
+                )
+        return None
+
+    def _loadable(self, step: int) -> bool:
+        """Cheap integrity probe for pruning decisions: meta parses and the
+        npz's zip central directory (stored at end of file — the first
+        casualty of truncation) reads. Full CRC verification is restore's
+        job; pruning must not re-read multi-GB arrays."""
+        step_dir = os.path.join(self.directory, f"{_STEP_PREFIX}{step:08d}")
+        try:
+            with open(os.path.join(step_dir, _META_FILE)) as f:
+                json.load(f)
+            with zipfile.ZipFile(os.path.join(step_dir, _ARRAYS_FILE)) as z:
+                z.namelist()
+            return True
+        except self._CORRUPT_ERRORS:
+            return False
+
     def _prune(self) -> None:
         steps = self.steps()
-        for s in steps[: -self.max_to_keep]:
+        doomed = steps[: -self.max_to_keep]
+        if not doomed:
+            return
+        kept = steps[-self.max_to_keep:]
+        if not any(self._loadable(s) for s in kept):
+            # every kept step is damaged: protect the newest loadable step
+            # among the prune candidates — pruning must never delete the
+            # last checkpoint a resume could actually restore
+            for s in reversed(doomed):
+                if self._loadable(s):
+                    logger.warning(
+                        "keeping checkpoint step %d beyond max_to_keep=%d: "
+                        "it is the newest loadable step (%s newer steps "
+                        "are corrupt)",
+                        s, self.max_to_keep, len(kept),
+                    )
+                    doomed = [d for d in doomed if d != s]
+                    break
+        for s in doomed:
             shutil.rmtree(
                 os.path.join(self.directory, f"{_STEP_PREFIX}{s:08d}"),
                 ignore_errors=True,

@@ -253,8 +253,15 @@ def read_partitioned(
     dtype=np.float32,
     pad_multiple: int = 1,
     tag: str = "read",
+    on_corrupt: str = "raise",
 ) -> PartitionedReadResult:
     """Partition-aware ``read_merged``: decode only this rank's slice.
+
+    on_corrupt="quarantine" (Avro only) skips-and-counts corrupt container
+    blocks instead of failing the read (io/avro.py). In blocks mode the
+    quarantining INDEX scan is the authoritative skip decision — every
+    rank scans every file identically, so the plan (and its fingerprint)
+    stays rank-consistent with corrupt spans excluded.
 
     exchange: parallel/multihost.MetadataExchange. ``None`` means DO NOT
     partition — the full read on this process, exactly as before (the
@@ -284,6 +291,7 @@ def read_partitioned(
             random_effect_id_columns=random_effect_id_columns,
             evaluation_id_columns=evaluation_id_columns,
             entity_vocabs=entity_vocabs, fmt=fmt, dtype=dtype,
+            on_corrupt=on_corrupt,
         )
         n = result.dataset.num_samples
         return PartitionedReadResult(
@@ -312,13 +320,19 @@ def read_partitioned(
             random_effect_id_columns=random_effect_id_columns,
             evaluation_id_columns=evaluation_id_columns,
             entity_vocabs=entity_vocabs, fmt=fmt, dtype=dtype,
+            on_corrupt=on_corrupt,
         )
     else:
         mode = "blocks"
         # few-large-files: split by container blocks. The index scan is
         # header + seeks only; every rank scans every file's index (cheap)
-        # but decodes only its contiguous block run.
-        indexes = [avro_io.scan_block_index(f) for f in files]
+        # but decodes only its contiguous block run. Under quarantine the
+        # scan validates framing and drops corrupt spans identically on
+        # every rank (the plan fingerprint stays consistent).
+        indexes = [
+            avro_io.scan_block_index(f, on_corrupt=on_corrupt)
+            for f in files
+        ]
         blocks = []  # (file_idx, block_idx, payload_bytes)
         for fi, file_index in enumerate(indexes):
             for bi, (_, payload, _) in enumerate(file_index):
@@ -335,7 +349,8 @@ def read_partitioned(
             for fi, group in itertools.groupby(my_blocks, key=lambda b: b[0]):
                 run = list(group)
                 yield from avro_io.read_container_block_range(
-                    files[fi], run[0][1], len(run), index=indexes[fi]
+                    files[fi], run[0][1], len(run), index=indexes[fi],
+                    on_corrupt=on_corrupt,
                 )
 
         local = _read_local_records(
@@ -458,7 +473,7 @@ def read_partitioned(
 
 def _read_local_files(
     local_files, shard_configs, *, index_maps, random_effect_id_columns,
-    evaluation_id_columns, entity_vocabs, fmt, dtype,
+    evaluation_id_columns, entity_vocabs, fmt, dtype, on_corrupt="raise",
 ) -> ReadResult:
     if local_files:
         return read_merged(
@@ -466,6 +481,7 @@ def _read_local_files(
             random_effect_id_columns=random_effect_id_columns,
             evaluation_id_columns=evaluation_id_columns,
             entity_vocabs=entity_vocabs, fmt=fmt, dtype=dtype,
+            on_corrupt=on_corrupt,
         )
     return _read_local_records(
         [], shard_configs, index_maps=index_maps,

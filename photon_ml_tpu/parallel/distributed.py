@@ -1757,6 +1757,10 @@ def train_distributed(
                     extra_fe=by_prefix("best/extra_fe/"),
                 )
             best_metric = float(ckpt.meta.get("best_metric", float("nan")))
+            # journaled restore evidence (resilience/checkpoint_restores)
+            from photon_ml_tpu.telemetry import resilience_counters
+
+            resilience_counters.record_checkpoint_restore()
             start_sweep = min(int(ckpt.step), num_iterations)
             prior_losses = [float(x) for x in ckpt.meta.get("losses", [])][:start_sweep]
             history = [

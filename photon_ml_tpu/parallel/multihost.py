@@ -31,6 +31,7 @@ from __future__ import annotations
 import itertools
 import json
 import logging
+import re
 import threading
 
 import jax
@@ -193,12 +194,26 @@ def global_put(arr, sharding):
 # well under a few MB; array-sized exchanges belong on the devices.
 
 
+#: default deadline (seconds) for exchange reads and barriers — generous
+#: enough for a slow rank's multi-GB local decode, bounded enough that a
+#: wedged run fails attributed instead of hanging a CI/driver forever
+DEFAULT_EXCHANGE_TIMEOUT = 120.0
+
+
 class MetadataExchange:
     """Rank-aware small-payload allgather + barrier for host-side I/O.
 
     Every rank must make the SAME sequence of calls (SPMD discipline, like
     collectives); tags are namespaced per call site and serialized with an
     internal counter so repeated exchanges never collide.
+
+    Every read/barrier carries a DEADLINE: a rank that never publishes its
+    key (crashed, wedged, skipped a collective) surfaces as a
+    rank-attributed ``resilience.errors.ExchangeTimeout`` naming the tag,
+    the missing key, and the rank expected to publish it — never an
+    unbounded hang (ISSUE 3). Retry does NOT belong here: re-waiting one
+    rank's exchange while the others do not desynchronizes the SPMD call
+    sequence (resilience/policy.py module doc).
     """
 
     rank: int = 0
@@ -228,24 +243,31 @@ class InProcessExchange(MetadataExchange):
     transport: lets the partitioned reader/writer run num_ranks>1 flows on
     a single host, e.g. against the virtual CPU mesh."""
 
-    def __init__(self, store: dict, rank: int, num_ranks: int):
+    def __init__(self, store: dict, rank: int, num_ranks: int,
+                 timeout: float = DEFAULT_EXCHANGE_TIMEOUT):
         self._store = store
         self.rank = rank
         self.num_ranks = num_ranks
+        self.timeout = float(timeout)
         # per-instance call counter: repeated exchanges under the SAME tag
         # stay distinct (every rank makes the same sequence of calls — the
         # SPMD discipline — so counters agree), mirroring the KV transport
         self._seq = 0
 
     @classmethod
-    def create_group(cls, num_ranks: int) -> "list[InProcessExchange]":
+    def create_group(
+        cls, num_ranks: int, timeout: float = DEFAULT_EXCHANGE_TIMEOUT
+    ) -> "list[InProcessExchange]":
         store = {
             "cond": threading.Condition(),
             "gather": {},
         }
-        return [cls(store, r, num_ranks) for r in range(num_ranks)]
+        return [cls(store, r, num_ranks, timeout=timeout)
+                for r in range(num_ranks)]
 
     def allgather(self, tag: str, payload) -> list:
+        from photon_ml_tpu.resilience.errors import ExchangeTimeout
+
         key = (self._seq, tag)
         self._seq += 1
         cond, slot = self._store["cond"], self._store["gather"]
@@ -254,10 +276,18 @@ class InProcessExchange(MetadataExchange):
             entry[self.rank] = payload
             cond.notify_all()
             cond.wait_for(lambda: len(slot[key]) == self.num_ranks,
-                          timeout=120)
+                          timeout=self.timeout)
             if len(slot[key]) != self.num_ranks:
-                raise TimeoutError(f"allgather {tag!r}: "
-                                   f"{len(slot[key])}/{self.num_ranks} ranks")
+                missing = [r for r in range(self.num_ranks)
+                           if r not in slot[key]]
+                raise ExchangeTimeout(
+                    tag,
+                    missing_ranks=missing,
+                    rank=self.rank,
+                    timeout=self.timeout,
+                    detail=f"{len(slot[key])}/{self.num_ranks} ranks "
+                           "published",
+                )
             out = [slot[key][r] for r in range(self.num_ranks)]
             # reclaim the slot once every rank has read it (payloads can
             # be sizable — feature-key lists — and exchanges are many)
@@ -280,53 +310,131 @@ class InProcessExchange(MetadataExchange):
 _kv_seq = itertools.count().__next__
 
 
+#: how jaxlib's coordination-service client spells a missed deadline in
+#: the RuntimeError it raises (the TYPE carries no signal)
+_KV_DEADLINE_RE = re.compile(r"deadline|timed? ?out", re.IGNORECASE)
+
+
 class DistributedKVExchange(MetadataExchange):
     """Multi-process transport over jax.distributed's coordination-service
     key-value store (the same rendezvous channel ``initialize`` uses) —
     host-side only, so partitioned ingestion metadata flows even before
-    the first device computation."""
+    the first device computation.
 
-    def __init__(self, timeout_ms: int = 120_000):
-        from jax._src import distributed
+    Resilience wiring: point-to-point KV set/get operations retry
+    classified-transient coordinator errors (resilience/policy.py's KV
+    policy — a retried set that finds its key already stored treats the
+    first attempt as delivered); a blocking get or barrier that misses
+    its deadline raises a rank-attributed
+    ``resilience.errors.ExchangeTimeout`` naming the missing key and the
+    rank expected to publish it. Barriers are never retried (barrier ids
+    are single-use; only the deadline mapping applies).
 
-        client = distributed.global_state.client
+    ``client``/``rank``/``num_ranks`` are injectable for chaos tests —
+    production callers leave them None and get the live coordination
+    client.
+    """
+
+    def __init__(self, timeout_ms: int = 120_000, *, client=None,
+                 rank: int | None = None, num_ranks: int | None = None,
+                 retry=None):
         if client is None:
-            raise RuntimeError(
-                "DistributedKVExchange needs jax.distributed.initialize "
-                "(multihost.initialize) to have run first"
-            )
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+            if client is None:
+                raise RuntimeError(
+                    "DistributedKVExchange needs jax.distributed.initialize "
+                    "(multihost.initialize) to have run first"
+                )
         self._client = client
         self._timeout_ms = timeout_ms
-        self.rank = jax.process_index()
-        self.num_ranks = jax.process_count()
+        self.rank = jax.process_index() if rank is None else int(rank)
+        self.num_ranks = (
+            jax.process_count() if num_ranks is None else int(num_ranks)
+        )
+        if retry is None:
+            from photon_ml_tpu.resilience.policy import default_kv_policy
+
+            retry = default_kv_policy()
+        self._retry = retry
 
     def _key(self, tag: str, seq: int, rank: int) -> str:
         return f"photon/xchg/{seq}/{tag}/{rank}"
 
+    def _kv_set(self, key: str, value: str) -> None:
+        def attempt():
+            try:
+                self._client.key_value_set(key, value)
+            except RuntimeError as e:
+                if "already_exists" in str(e).lower().replace(" ", "_"):
+                    # a previous attempt's write landed but its ack was
+                    # lost; keys are sequence-unique so the value matches
+                    return
+                raise
+
+        self._retry.call(attempt, description=f"kv_set {key}")
+
+    def _kv_get(self, key: str, tag: str, expected_rank: int) -> str:
+        from photon_ml_tpu.resilience.errors import ExchangeTimeout
+
+        def attempt():
+            try:
+                return self._client.blocking_key_value_get(
+                    key, self._timeout_ms
+                )
+            except RuntimeError as e:
+                if _KV_DEADLINE_RE.search(str(e)):
+                    raise ExchangeTimeout(
+                        tag,
+                        key=key,
+                        missing_ranks=(expected_rank,),
+                        rank=self.rank,
+                        timeout=self._timeout_ms / 1000.0,
+                        detail=str(e),
+                    ) from e
+                raise
+
+        return self._retry.call(attempt, description=f"kv_get {key}")
+
+    def _wait_barrier(self, barrier_id: str, tag: str) -> None:
+        from photon_ml_tpu.resilience.errors import ExchangeTimeout
+
+        try:
+            self._client.wait_at_barrier(barrier_id, self._timeout_ms)
+        except RuntimeError as e:
+            if _KV_DEADLINE_RE.search(str(e)):
+                raise ExchangeTimeout(
+                    tag,
+                    key=barrier_id,
+                    rank=self.rank,
+                    timeout=self._timeout_ms / 1000.0,
+                    detail=f"some rank never reached the barrier: {e}",
+                ) from e
+            raise
+
     def allgather(self, tag: str, payload) -> list:
         seq = _kv_seq()
-        self._client.key_value_set(
-            self._key(tag, seq, self.rank), json.dumps(payload)
-        )
+        self._kv_set(self._key(tag, seq, self.rank), json.dumps(payload))
         out = []
         for r in range(self.num_ranks):
-            raw = self._client.blocking_key_value_get(
-                self._key(tag, seq, r), self._timeout_ms
-            )
+            raw = self._kv_get(self._key(tag, seq, r), tag, r)
             out.append(json.loads(raw))
         # every rank has read every key — reclaim our own entry so the
         # coordinator's KV store does not retain one payload per exchange
         # for the process lifetime (feature-key lists can be MBs)
-        self._client.wait_at_barrier(
-            f"photon/bar/xchg-read/{seq}", self._timeout_ms
-        )
-        self._client.key_value_delete(self._key(tag, seq, self.rank))
+        self._wait_barrier(f"photon/bar/xchg-read/{seq}", tag)
+        try:
+            self._client.key_value_delete(self._key(tag, seq, self.rank))
+        except RuntimeError as e:
+            # reclamation is best-effort; a leaked payload must not fail
+            # an otherwise-complete exchange
+            logger.warning("kv reclaim of %s failed: %s",
+                           self._key(tag, seq, self.rank), e)
         return out
 
     def barrier(self, tag: str) -> None:
-        self._client.wait_at_barrier(
-            f"photon/bar/{_kv_seq()}/{tag}", self._timeout_ms
-        )
+        self._wait_barrier(f"photon/bar/{_kv_seq()}/{tag}", tag)
 
 
 def default_exchange() -> MetadataExchange:

@@ -1,0 +1,191 @@
+"""Typed transient-vs-fatal error classification + attributed failure types.
+
+No reference analogue as code: the reference's failure model is Spark's —
+lineage recompute re-executes lost partitions and the driver retries failed
+tasks (spark-submit/YARN substrate, not a photon-ml source file; SURVEY.md
+§5). The TPU-native stack has none of that substrate, so every host-side
+boundary (remote-compile/dispatch tunnels, Avro container reads,
+coordination-service KV exchanges) needs an explicit answer to "is this
+error worth retrying?". This module is that answer — ONE classifier every
+retry/recovery site consults, so transient-vs-fatal policy lives in one
+reviewed place instead of scattered ``except`` clauses (dev/lint_parity.py
+bans broad excepts outside this layer's allowlist for exactly that reason).
+
+Classification rules (in precedence order):
+
+1. Explicit wrappers win: :class:`TransientError` is always transient;
+   :class:`ExchangeTimeout` is always fatal (it is already ATTRIBUTED — the
+   missing key/rank is named, and waiting the deadline again would just
+   double the hang).
+2. Known-poison signatures are fatal even when they smell transient: an
+   HTTP 413 / "payload too large" from the remote-compile tunnel means a
+   jit closed over a large constant (the r2 "compile service flakiness"
+   that masqueraded as a dropped connection for a whole round — CLAUDE.md);
+   retrying re-sends the same oversized request forever.
+3. Connection/timeout exception types and transient OS errnos (EAGAIN,
+   EIO, ETIMEDOUT, ECONNRESET, ...) are transient.
+4. Message patterns of the distributed runtimes (UNAVAILABLE,
+   DEADLINE_EXCEEDED, "socket closed", "connection reset", ...) are
+   transient — jaxlib surfaces tunnel/coordination failures as RuntimeError
+   subclasses whose TYPE carries no signal.
+5. Everything else is fatal (ValueError, programming errors, divergence):
+   retrying deterministic failures burns the budget and hides the bug.
+"""
+
+from __future__ import annotations
+
+import enum
+import errno
+import re
+
+#: OS errnos worth retrying: interrupted/expired I/O and dropped network
+#: paths (a remote filesystem or the compile tunnel), never logic errors
+TRANSIENT_ERRNOS = frozenset(
+    {
+        errno.EAGAIN,
+        errno.EINTR,
+        errno.EIO,
+        errno.EBUSY,
+        errno.ETIMEDOUT,
+        errno.ECONNRESET,
+        errno.ECONNABORTED,
+        errno.ECONNREFUSED,
+        errno.ENETRESET,
+        errno.ENETUNREACH,
+        errno.EHOSTUNREACH,
+        errno.EPIPE,
+    }
+)
+
+#: fatal-despite-the-smell signatures, checked BEFORE the transient
+#: patterns. \b413\b is the measured one (word-bounded so ports/byte
+#: counts like ":41352" never match): a jit that closed over a large
+#: batch serializes it as a CONSTANT into the remote-compile request and
+#: the tunnel rejects it — every retry re-sends the same bytes
+#: (CLAUDE.md). "out of memory" covers XLA's deterministic device OOM
+#: ("RESOURCE_EXHAUSTED: Out of memory while trying to allocate ...") —
+#: re-dispatching the identical program OOMs identically.
+_FATAL_PATTERNS = re.compile(
+    r"\b413\b|payload too large|request entity too large"
+    r"|INVALID_ARGUMENT|out of memory",
+    re.IGNORECASE,
+)
+
+#: gRPC/absl status words and socket-level phrases the distributed
+#: runtimes put in RuntimeError messages for genuinely transient failures.
+#: RESOURCE_EXHAUSTED stays here for its quota/rate-limit shape — the OOM
+#: shape is intercepted by the fatal "out of memory" pattern above.
+_TRANSIENT_PATTERNS = re.compile(
+    r"UNAVAILABLE|DEADLINE_EXCEEDED|RESOURCE_EXHAUSTED|ABORTED"
+    r"|socket closed|connection reset|connection refused|broken pipe"
+    r"|connection closed|temporarily unavailable|too many requests"
+    r"|timed? ?out",
+    re.IGNORECASE,
+)
+
+#: remediation hints keyed by fatal signature — logged once at giveup so
+#: the next reader does not re-spend a round rediscovering the cause
+FATAL_HINTS: tuple[tuple[re.Pattern, str], ...] = (
+    (
+        re.compile(r"\b413\b|payload too large|request entity too large",
+                   re.IGNORECASE),
+        "the remote-compile request exceeded the tunnel limit — a jit "
+        "likely closed over a large batch; pass batches as jit ARGUMENTS "
+        "(CLAUDE.md 'Never close a jax.jit over a large batch')",
+    ),
+    (
+        re.compile(r"out of memory", re.IGNORECASE),
+        "device OOM is deterministic — retrying re-allocates identically; "
+        "shrink the batch, use bf16 feature blocks, or shard further",
+    ),
+)
+
+
+class Transience(enum.Enum):
+    """The classifier's verdict: retry-worthy or not."""
+
+    TRANSIENT = "transient"
+    FATAL = "fatal"
+
+
+class TransientError(RuntimeError):
+    """Explicitly-transient failure: always retried within budget.
+
+    Raise (or wrap a caught error in) this at call sites that KNOW the
+    failure is worth retrying regardless of the generic rules."""
+
+
+class ExchangeTimeout(TimeoutError):
+    """A MetadataExchange read/barrier missed its deadline — attributed.
+
+    Carries the exchange tag, the key that never appeared, and the rank(s)
+    expected to publish it, so a wedged multi-host run fails with "rank 2
+    never published partitioned_read/train" instead of an anonymous hang
+    (the failure mode ISSUE 3 exists to kill). Classified FATAL: the
+    deadline already waited; what is needed is the named rank's logs, not
+    another identical wait.
+    """
+
+    def __init__(
+        self,
+        tag: str,
+        *,
+        missing_ranks: "tuple[int, ...] | list[int]" = (),
+        key: str | None = None,
+        rank: int | None = None,
+        timeout: float | None = None,
+        detail: str = "",
+    ):
+        self.tag = tag
+        self.missing_ranks = tuple(int(r) for r in missing_ranks)
+        self.key = key
+        self.rank = rank
+        self.timeout = timeout
+        parts = [f"exchange {tag!r}"]
+        if key is not None:
+            parts.append(f"key {key!r} was never published")
+        if self.missing_ranks:
+            parts.append(
+                "rank(s) %s did not participate"
+                % ",".join(map(str, self.missing_ranks))
+            )
+        if rank is not None:
+            parts.append(f"(observed on rank {rank})")
+        if timeout is not None:
+            parts.append(f"after {timeout:g}s")
+        if detail:
+            parts.append(f"[{detail}]")
+        super().__init__(" ".join(parts))
+
+
+def classify_exception(exc: BaseException) -> Transience:
+    """The ONE transient-vs-fatal rule (precedence in the module docstring)."""
+    if isinstance(exc, TransientError):
+        return Transience.TRANSIENT
+    if isinstance(exc, ExchangeTimeout):
+        return Transience.FATAL
+    message = f"{type(exc).__name__}: {exc}"
+    if _FATAL_PATTERNS.search(message):
+        return Transience.FATAL
+    if isinstance(
+        exc, (ConnectionError, TimeoutError, InterruptedError)
+    ):
+        return Transience.TRANSIENT
+    if isinstance(exc, OSError) and exc.errno in TRANSIENT_ERRNOS:
+        return Transience.TRANSIENT
+    if _TRANSIENT_PATTERNS.search(message):
+        return Transience.TRANSIENT
+    return Transience.FATAL
+
+
+def is_transient(exc: BaseException) -> bool:
+    return classify_exception(exc) is Transience.TRANSIENT
+
+
+def fatal_hint(exc: BaseException) -> str | None:
+    """A remediation hint for known-fatal signatures, or None."""
+    message = f"{type(exc).__name__}: {exc}"
+    for pattern, hint in FATAL_HINTS:
+        if pattern.search(message):
+            return hint
+    return None

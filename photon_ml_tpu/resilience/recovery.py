@@ -1,0 +1,122 @@
+"""Driver-level crash-safe recovery: restore-and-resume instead of abort.
+
+No reference analogue as code: the reference driver aborts on any
+exception and relies on Spark lineage + coarse per-configuration model
+re-use for recovery (GameTrainingDriver.scala:748-815 saves models per
+optimization config; there is no mid-sweep resume). Here the training
+sweep owns real mid-training checkpoints (io/checkpoint.py), so a
+mid-sweep failure that is either
+
+- a :class:`~photon_ml_tpu.io.checkpoint.DivergenceError` (non-finite
+  coordinate update) with an intact checkpoint to fall back to, or
+- a classified-transient error (dropped tunnel, flaky filesystem —
+  resilience/errors.classify_exception)
+
+restarts the attempt instead of aborting: the re-created estimator
+resumes from the latest intact checkpoint (run_coordinate_descent's
+fast-forward) and the run continues. Restarts are capped by
+``max_restarts``; exhaustion re-raises after counting a
+``resilience/giveups``. Every restart counts on ``resilience/retries``
+and journals a ``resilience_restart`` row; the checkpoint restore itself
+counts on ``resilience/checkpoint_restores`` (incremented at the restore
+site in algorithm/coordinate_descent.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from photon_ml_tpu.resilience.errors import (
+    Transience,
+    classify_exception,
+    fatal_hint,
+)
+from photon_ml_tpu.telemetry import resilience_counters
+
+logger = logging.getLogger(__name__)
+
+
+def run_with_recovery(
+    fn: Callable[[int], object],
+    *,
+    max_restarts: int = 2,
+    checkpointer=None,
+    classify: Callable = classify_exception,
+    journal=None,
+    description: str = "training",
+):
+    """Run ``fn(restart_index)`` with capped restore-and-resume restarts.
+
+    fn: one full attempt; receives the 0-based restart index (the driver
+        uses it to force ``resume=True`` on restarts even when the user
+        passed ``--no-resume`` for the first attempt).
+    checkpointer: optional ``io.checkpoint.TrainingCheckpointer``. A
+        DivergenceError is only recoverable when a checkpoint step exists
+        to restore (re-running a deterministic divergence from scratch
+        would fail identically); transient errors restart either way.
+    journal: optional ``telemetry.RunJournal`` for ``resilience_restart``
+        rows.
+    """
+    from photon_ml_tpu.io.checkpoint import DivergenceError
+
+    restart = 0
+    while True:
+        try:
+            return fn(restart)
+        except Exception as e:  # classified below; broad by design
+            transient = classify(e) is Transience.TRANSIENT
+            has_checkpoint = (
+                checkpointer is not None
+                and checkpointer.latest_step() is not None
+            )
+            divergent = isinstance(e, DivergenceError)
+            recoverable = transient or (divergent and has_checkpoint)
+            if not recoverable or restart >= max_restarts:
+                if recoverable:
+                    resilience_counters.record_giveup()
+                    logger.error(
+                        "%s: restart budget (%d) exhausted; giving up on %r",
+                        description, max_restarts, e,
+                    )
+                elif divergent and not has_checkpoint:
+                    logger.error(
+                        "%s: diverged with no checkpoint to restore "
+                        "(enable --checkpoint-dir for mid-sweep recovery): %r",
+                        description, e,
+                    )
+                else:
+                    hint = fatal_hint(e)
+                    if hint is not None:
+                        logger.error("%s: fatal failure %r. Hint: %s",
+                                     description, e, hint)
+                raise
+            restart += 1
+            resilience_counters.record_retry()
+            logger.warning(
+                "%s: %s failure (%r) — restart %d/%d%s",
+                description,
+                "transient" if transient else "divergence",
+                e,
+                restart,
+                max_restarts,
+                (
+                    f", resuming from checkpoint step "
+                    f"{checkpointer.latest_step()}"
+                    if has_checkpoint
+                    else ", retrying from scratch"
+                ),
+            )
+            if journal is not None:
+                journal.record(
+                    "resilience_restart",
+                    description=description,
+                    restart=restart,
+                    max_restarts=max_restarts,
+                    transient=transient,
+                    divergent=divergent,
+                    resumed_from_step=(
+                        checkpointer.latest_step() if has_checkpoint else None
+                    ),
+                    error=repr(e),
+                )

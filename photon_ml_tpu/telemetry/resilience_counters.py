@@ -1,0 +1,100 @@
+"""Resilience telemetry: retry/giveup/quarantine/restore counters + events.
+
+No reference analogue as code: the reference gets fault tolerance from
+Spark lineage recompute and surfaces it only as task-retry counts in the
+Spark UI — owned by the Spark substrate, not any photon-ml source file
+(SURVEY.md §5). Here every explicit recovery
+action the resilience layer takes (photon_ml_tpu/resilience/) lands on a
+named counter in the process-wide metrics registry, so the run journal —
+which both GAME drivers persist on success AND failure — records how many
+transient errors were retried, how many exhausted their budget, how many
+corrupt Avro blocks were quarantined, and how many checkpoint restores a
+run needed.
+
+Quarantined block SPANS additionally ride a small bounded event ring
+(``drain_quarantine_events``) that the drivers journal as one
+``quarantined_block`` row per span — the registry keeps the count, the
+journal keeps the forensics (path, block index, byte range, reason).
+
+Names are constants so producers (io/avro.py, resilience/policy.py,
+algorithm/coordinate_descent.py) and consumers (tests, journals) cannot
+drift — the same contract as telemetry/io_counters.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from photon_ml_tpu.telemetry.registry import default_registry
+
+#: transient failures retried by a RetryPolicy or a driver-level restart
+RETRIES = "resilience/retries"
+#: retry/restart budgets exhausted (the error then propagated)
+GIVEUPS = "resilience/giveups"
+#: corrupt Avro container blocks skipped under on_corrupt="quarantine"
+QUARANTINED_BLOCKS = "resilience/quarantined_blocks"
+#: coordinate-descent / sweep restores from a checkpoint
+CHECKPOINT_RESTORES = "resilience/checkpoint_restores"
+
+#: bounded forensic ring: quarantine spans awaiting journaling (a corrupt
+#: input could hold thousands of bad blocks; the counter stays exact while
+#: the ring keeps only the most recent spans)
+QUARANTINE_EVENT_WINDOW = 256
+
+_events_lock = threading.Lock()
+_quarantine_events: deque[dict] = deque(maxlen=QUARANTINE_EVENT_WINDOW)
+
+
+def record_retry(n: int = 1) -> None:
+    default_registry().counter(RETRIES).inc(int(n))
+
+
+def record_giveup(n: int = 1) -> None:
+    default_registry().counter(GIVEUPS).inc(int(n))
+
+
+def record_checkpoint_restore(n: int = 1) -> None:
+    default_registry().counter(CHECKPOINT_RESTORES).inc(int(n))
+
+
+def record_quarantined_block(
+    path: str, block_index: int, start: int, end: int, reason: str
+) -> None:
+    """One corrupt block skipped: count it and ring-buffer its span."""
+    default_registry().counter(QUARANTINED_BLOCKS).inc(1)
+    with _events_lock:
+        _quarantine_events.append(
+            {
+                "path": str(path),
+                "block_index": int(block_index),
+                "byte_start": int(start),
+                "byte_end": int(end),
+                "reason": str(reason),
+            }
+        )
+
+
+def drain_quarantine_events() -> list[dict]:
+    """Pop every pending quarantine span (drivers journal these as
+    ``quarantined_block`` rows; tests assert on them)."""
+    with _events_lock:
+        out = list(_quarantine_events)
+        _quarantine_events.clear()
+    return out
+
+
+def retries() -> int:
+    return int(default_registry().counter(RETRIES).value)
+
+
+def giveups() -> int:
+    return int(default_registry().counter(GIVEUPS).value)
+
+
+def quarantined_blocks() -> int:
+    return int(default_registry().counter(QUARANTINED_BLOCKS).value)
+
+
+def checkpoint_restores() -> int:
+    return int(default_registry().counter(CHECKPOINT_RESTORES).value)

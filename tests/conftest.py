@@ -43,6 +43,20 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # registered markers: the tier-1 command filters with -m 'not slow' and
+    # the chaos suite (tests/test_resilience.py) tags its fault-injection
+    # tests — registration keeps the suite warning-free under -q
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 suite (-m 'not slow')"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection resilience test (dev/faultinject.py); "
+        "must stay CPU-fast with bounded internal deadlines",
+    )
+
+
 def make_virtual_cpu_env(n_devices: int | None = None) -> dict:
     """Subprocess env for a virtual CPU mesh: force the CPU backend, disarm
     the container's axon sitecustomize (registers a TPU backend whenever

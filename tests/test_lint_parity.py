@@ -115,3 +115,64 @@ def test_lint_catches_cli_full_reads_and_score_allgathers(tmp_path):
                for p in problems)
     assert any("funnel.py:7" in p for p in problems)  # wrong file
     assert not any("distributed.py" in p for p in problems)  # allowlisted
+
+
+def test_lint_catches_broad_excepts(tmp_path):
+    """The broad-except check fires on swallowing handlers, and exempts
+    re-raising handlers and the resilience classifier's allowlist."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    pkg = tmp_path / "photon_ml_tpu" / "io"
+    pkg.mkdir(parents=True)
+    (pkg / "swallower.py").write_text(
+        '"""No reference analogue."""\n'
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        return None\n"
+        "def h():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except:\n"
+        "        pass\n"
+    )
+    (pkg / "reraiser.py").write_text(
+        '"""No reference analogue."""\n'
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except BaseException:\n"
+        "        cleanup()\n"
+        "        raise\n"
+        "def typed(e=None):\n"
+        "    try:\n"
+        "        g()\n"
+        "    except ValueError:\n"
+        "        return None  # typed: not the lint's business\n"
+    )
+    res = tmp_path / "photon_ml_tpu" / "resilience"
+    res.mkdir(parents=True)
+    (res / "policy.py").write_text(
+        '"""No reference analogue."""\n'
+        "def call():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        return None  # allowlisted (file, function)\n"
+        "def other():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        return None  # allowlisted file, WRONG function\n"
+    )
+    problems = lint_parity.run_lints(tmp_path)
+    assert any("swallower.py:5" in p and "broad except" in p for p in problems)
+    assert any("swallower.py:10" in p for p in problems)
+    assert not any("reraiser.py" in p for p in problems)
+    assert not any("policy.py:5" in p for p in problems)  # allowlisted
+    assert any("policy.py:10" in p for p in problems)  # wrong function

@@ -1,0 +1,676 @@
+"""Chaos suite: every injected fault class either recovers with the right
+answer or fails fast with an attributed error — never a hang.
+
+Drives the resilience layer (photon_ml_tpu/resilience/) end to end on the
+virtual CPU mesh with dev/faultinject.py injectors: flaky-then-succeeding
+callables, truncated/corrupted Avro blocks, mid-save crashes, withheld
+exchange keys, NaN-poisoned coordinate updates. The reference has no
+analogue — its fault tolerance is Spark lineage recompute (SURVEY.md §5);
+these tests pin the explicit contract that replaces it.
+
+No pytest-timeout in this environment: boundedness is enforced by the
+operations' OWN deadlines (exchange timeouts of well under a second, retry
+budgets with no-op sleeps) plus bounded thread joins — a regression that
+reintroduces an unbounded wait fails the join assertion, not the CI clock.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from dev import faultinject
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.resilience import (
+    ExchangeTimeout,
+    RetryPolicy,
+    Transience,
+    TransientError,
+    classify_exception,
+    run_with_recovery,
+)
+from photon_ml_tpu.telemetry import resilience_counters as rc
+
+pytestmark = pytest.mark.chaos
+
+NO_SLEEP = lambda _: None  # noqa: E731
+
+
+def _policy(**kw):
+    kw.setdefault("sleep", NO_SLEEP)
+    return RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# classifier + RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+class TestClassifier:
+    def test_connection_and_timeout_types_are_transient(self):
+        for exc in (
+            ConnectionError("x"),
+            ConnectionResetError("x"),
+            TimeoutError("x"),
+            BrokenPipeError("x"),
+            OSError(110, "Connection timed out"),
+            TransientError("forced"),
+            RuntimeError("UNAVAILABLE: socket closed"),
+            RuntimeError("DEADLINE_EXCEEDED while fetching"),
+        ):
+            assert classify_exception(exc) is Transience.TRANSIENT, exc
+
+    def test_programming_errors_are_fatal(self):
+        for exc in (
+            ValueError("bad shape"),
+            KeyError("missing"),
+            RuntimeError("something exploded"),
+        ):
+            assert classify_exception(exc) is Transience.FATAL, exc
+
+    def test_http_413_is_fatal_despite_connection_smell(self):
+        # the r2 pathology: a closed-over batch makes the tunnel return
+        # 413 — surfaced as a dropped connection, but retrying re-sends
+        # the same oversized request (CLAUDE.md)
+        exc = ConnectionError("tunnel returned HTTP 413 payload too large")
+        assert classify_exception(exc) is Transience.FATAL
+        from photon_ml_tpu.resilience import fatal_hint
+
+        assert "jit" in fatal_hint(exc)
+
+    def test_413_is_word_bounded_not_substring(self):
+        # '413' inside a port/byte count must not defeat retry
+        exc = RuntimeError("UNAVAILABLE: ipv4:10.0.0.2:41352: connection reset")
+        assert classify_exception(exc) is Transience.TRANSIENT
+
+    def test_device_oom_is_fatal_despite_resource_exhausted(self):
+        from photon_ml_tpu.resilience import fatal_hint
+
+        exc = RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            "8589934592 bytes"
+        )
+        assert classify_exception(exc) is Transience.FATAL
+        assert "deterministic" in fatal_hint(exc)
+        # the quota/rate-limit shape stays transient
+        quota = RuntimeError("RESOURCE_EXHAUSTED: quota exceeded for resource")
+        assert classify_exception(quota) is Transience.TRANSIENT
+
+    def test_read_merged_rejects_bad_on_corrupt(self, tmp_path):
+        from photon_ml_tpu.io.data_reader import (
+            FeatureShardConfiguration,
+            read_merged,
+        )
+
+        path = tmp_path / "x.avro"
+        _write(str(path))
+        cfg = {"g": FeatureShardConfiguration(feature_bags=("features",))}
+        with pytest.raises(ValueError, match="on_corrupt"):
+            read_merged(path, cfg, on_corrupt="Quarantine")
+
+    def test_exchange_timeout_is_fatal(self):
+        exc = ExchangeTimeout("tag", missing_ranks=(2,), key="k", rank=0)
+        assert classify_exception(exc) is Transience.FATAL
+        assert "rank(s) 2" in str(exc) and "'k'" in str(exc)
+
+
+class TestRetryPolicy:
+    def test_flaky_callable_recovers_and_counts(self):
+        fn = faultinject.flaky(2, ConnectionError, result=42)
+        before = rc.retries()
+        assert _policy(max_attempts=3).call(fn) == 42
+        assert fn.calls == 3
+        assert rc.retries() - before == 2
+
+    def test_fatal_error_not_retried(self):
+        fn = faultinject.flaky(1, lambda: ValueError("deterministic"))
+        with pytest.raises(ValueError):
+            _policy(max_attempts=5).call(fn)
+        assert fn.calls == 1
+
+    def test_budget_exhaustion_counts_giveup(self):
+        fn = faultinject.flaky(99, ConnectionError)
+        before = rc.giveups()
+        with pytest.raises(ConnectionError):
+            _policy(max_attempts=3).call(fn)
+        assert fn.calls == 3
+        assert rc.giveups() - before == 1
+
+    def test_jitter_is_deterministic_and_backoff_bounded(self):
+        p = _policy(base_delay=0.2, multiplier=2.0, max_delay=1.0)
+        delays = [p.delay(a, "key") for a in range(6)]
+        assert delays == [p.delay(a, "key") for a in range(6)]  # stable
+        assert all(d <= 1.0 * (1 + p.jitter) for d in delays)
+        assert delays[1] > delays[0]  # actually backs off
+        # different call keys decorrelate
+        assert p.delay(0, "key") != p.delay(0, "other-key")
+
+
+# ---------------------------------------------------------------------------
+# corrupt-input quarantine
+# ---------------------------------------------------------------------------
+
+SCHEMA = {
+    "type": "record",
+    "name": "R",
+    "fields": [
+        {"name": "label", "type": "double"},
+        {"name": "features", "type": {
+            "type": "array",
+            "items": {
+                "type": "record", "name": "F",
+                "fields": [
+                    {"name": "name", "type": "string"},
+                    {"name": "term", "type": "string"},
+                    {"name": "value", "type": "double"},
+                ],
+            },
+        }},
+    ],
+}
+
+
+def _records(n):
+    return [
+        {
+            "label": float(i),
+            "features": [
+                {"name": f"f{j}", "term": "", "value": float(i * 10 + j)}
+                for j in range(3)
+            ],
+        }
+        for i in range(n)
+    ]
+
+
+def _write(path, n=30, codec="deflate", block_records=10):
+    avro_io.write_container(
+        path, SCHEMA, _records(n), codec=codec, block_records=block_records
+    )
+
+
+class TestQuarantine:
+    def test_clean_file_identical_in_both_modes(self, tmp_path):
+        path = tmp_path / "clean.avro"
+        _write(path)
+        strict = list(avro_io.read_container(path))
+        loose = list(avro_io.read_container(path, on_corrupt="quarantine"))
+        assert strict == loose == _records(30)
+
+    @pytest.mark.parametrize("codec", ["null", "deflate"])
+    def test_corrupt_payload_block_skipped_and_counted(self, tmp_path, codec):
+        path = str(tmp_path / "c.avro")
+        _write(path, codec=codec)
+        # 16 bytes of 0xFF: lands on a varint position (an endless
+        # continuation -> "varint too long") even under the null codec,
+        # where 8 bytes would only garble a double silently
+        faultinject.corrupt_avro_block(path, block=1, nbytes=16)
+        with pytest.raises((avro_io.AvroError, EOFError, Exception)):
+            list(avro_io.read_container(path))
+        before = rc.quarantined_blocks()
+        out = list(avro_io.read_container(path, on_corrupt="quarantine"))
+        assert out == _records(30)[:10] + _records(30)[20:]
+        assert rc.quarantined_blocks() - before == 1
+        events = rc.drain_quarantine_events()
+        assert events and events[-1]["path"] == path
+        assert events[-1]["byte_end"] > events[-1]["byte_start"]
+
+    def test_truncated_final_block_quarantined(self, tmp_path):
+        path = str(tmp_path / "t.avro")
+        _write(path)
+        faultinject.truncate_avro_block(path, block=-1)
+        out = list(avro_io.read_container(path, on_corrupt="quarantine"))
+        assert out == _records(30)[:20]
+        assert len(avro_io.validate_container(path)) == 1
+
+    def test_broken_sync_loses_exactly_the_unreachable_span(self, tmp_path):
+        path = str(tmp_path / "s.avro")
+        _write(path)
+        faultinject.break_avro_sync(path, block=0)
+        # block 0 decodes but its trailer is gone -> resync lands after
+        # block 1's trailer: blocks 0 and 1 quarantined, block 2 recovered
+        out = list(avro_io.read_container(path, on_corrupt="quarantine"))
+        assert out == _records(30)[20:]
+        rc.drain_quarantine_events()
+
+    def test_block_range_reader_quarantines_payload_rot(self, tmp_path):
+        path = str(tmp_path / "b.avro")
+        _write(path)
+        faultinject.corrupt_avro_block(path, block=1, nbytes=16)
+        index = avro_io.scan_block_index(path, on_corrupt="quarantine")
+        assert len(index) == 3  # framing intact; rot is payload-level
+        got = list(
+            avro_io.read_container_block_range(
+                path, 0, 3, index=index, on_corrupt="quarantine"
+            )
+        )
+        assert got == _records(30)[:10] + _records(30)[20:]
+        rc.drain_quarantine_events()
+
+    def test_read_merged_quarantine_recovers_and_default_raises(self, tmp_path):
+        from photon_ml_tpu.io.data_reader import (
+            FeatureShardConfiguration,
+            read_merged,
+        )
+
+        data_dir = tmp_path / "d"
+        os.makedirs(data_dir)
+        _write(str(data_dir / "part-00000.avro"))
+        faultinject.truncate_avro_block(
+            str(data_dir / "part-00000.avro"), block=-1
+        )
+        cfg = {"global": FeatureShardConfiguration(feature_bags=("features",))}
+        with pytest.raises(Exception):
+            read_merged(data_dir, cfg)
+        before = rc.quarantined_blocks()
+        result = read_merged(data_dir, cfg, on_corrupt="quarantine")
+        assert result.dataset.num_samples == 20  # 3rd block quarantined
+        np.testing.assert_array_equal(
+            np.asarray(result.dataset.labels), np.arange(20.0)
+        )
+        assert rc.quarantined_blocks() - before >= 1
+        rc.drain_quarantine_events()
+
+
+# ---------------------------------------------------------------------------
+# exchange deadlines (withheld keys / absent ranks)
+# ---------------------------------------------------------------------------
+
+
+def _run_captured(fn, timeout=10.0):
+    """Run fn in a thread with a bounded join; return its exception."""
+    box = {}
+
+    def target():
+        try:
+            fn()
+            box["error"] = None
+        except BaseException as e:  # captured for the test to assert on
+            box["error"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout)
+    assert not t.is_alive(), "operation exceeded its bounded deadline (hang)"
+    return box["error"]
+
+
+class TestExchangeDeadlines:
+    def test_withheld_allgather_times_out_attributed(self):
+        from photon_ml_tpu.parallel.multihost import InProcessExchange
+
+        group = InProcessExchange.create_group(2, timeout=0.4)
+        # rank 1 never publishes (simulated crash): rank 0's read must
+        # fail attributed, not hang
+        error = _run_captured(
+            lambda: group[0].allgather("partitioned_read/train", {"n": 1})
+        )
+        assert isinstance(error, ExchangeTimeout)
+        assert error.missing_ranks == (1,)
+        assert "partitioned_read/train" in str(error)
+        assert "rank(s) 1" in str(error)
+
+    def test_score_writer_barrier_deadline(self, tmp_path):
+        from photon_ml_tpu.io.score_writer import ShardedScoreWriter
+        from photon_ml_tpu.parallel.multihost import InProcessExchange
+
+        group = InProcessExchange.create_group(2, timeout=0.4)
+        writer = ShardedScoreWriter(tmp_path / "scores", exchange=group[0])
+        error = _run_captured(
+            lambda: writer.write(np.zeros(4), uids=np.arange(4))
+        )
+        assert isinstance(error, ExchangeTimeout)
+        assert "score_writer/dir" in str(error)
+
+    def test_kv_exchange_deadline_names_key_and_rank(self):
+        from photon_ml_tpu.parallel.multihost import DistributedKVExchange
+
+        class FakeClient:
+            def __init__(self):
+                self.store = {}
+
+            def key_value_set(self, k, v):
+                self.store[k] = v
+
+            def blocking_key_value_get(self, k, timeout_ms):
+                if k in self.store:
+                    return self.store[k]
+                raise RuntimeError("DEADLINE_EXCEEDED: timed out")
+
+            def wait_at_barrier(self, bid, timeout_ms):
+                return None
+
+            def key_value_delete(self, k):
+                self.store.pop(k, None)
+
+        ex = DistributedKVExchange(
+            timeout_ms=300, client=FakeClient(), rank=0, num_ranks=2,
+            retry=_policy(max_attempts=2),
+        )
+        error = _run_captured(lambda: ex.allgather("meta", {"x": 1}))
+        assert isinstance(error, ExchangeTimeout)
+        assert error.missing_ranks == (1,)  # rank 1 never published
+        assert "photon/xchg/" in error.key and error.key.endswith("/1")
+
+    def test_kv_set_retries_transient_then_succeeds(self):
+        from photon_ml_tpu.parallel.multihost import DistributedKVExchange
+
+        class FlakySetClient:
+            def __init__(self):
+                self.store = {}
+                self.failures = 1
+
+            def key_value_set(self, k, v):
+                if self.failures:
+                    self.failures -= 1
+                    raise RuntimeError("UNAVAILABLE: connection reset")
+                self.store[k] = v
+
+            def blocking_key_value_get(self, k, timeout_ms):
+                # single-rank group: only our own key is read back
+                return self.store[k]
+
+            def wait_at_barrier(self, bid, timeout_ms):
+                return None
+
+            def key_value_delete(self, k):
+                self.store.pop(k, None)
+
+        client = FlakySetClient()
+        ex = DistributedKVExchange(
+            timeout_ms=300, client=client, rank=0, num_ranks=1,
+            retry=_policy(max_attempts=3),
+        )
+        assert ex.allgather("meta", {"x": 1}) == [{"x": 1}]
+        assert client.failures == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint atomicity + intact-step fallback
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointResilience:
+    def test_crash_between_temp_write_and_replace_is_atomic(self, tmp_path):
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+
+        ck = TrainingCheckpointer(tmp_path / "ck")
+        ck.save(1, {"w": np.arange(3.0)}, {"note": "good"})
+        with faultinject.crash_before_replace():
+            with pytest.raises(faultinject.InjectedCrash):
+                ck.save(2, {"w": np.full(3, 2.0)}, {"note": "doomed"})
+        # no partial step dirs, no leaked temp dirs
+        entries = sorted(os.listdir(tmp_path / "ck"))
+        assert entries == ["step_00000001"]
+        restored = ck.restore()
+        assert restored.step == 1
+        np.testing.assert_array_equal(restored.arrays["w"], np.arange(3.0))
+
+    def test_restore_falls_back_to_newest_intact_step(self, tmp_path):
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+
+        ck = TrainingCheckpointer(tmp_path / "ck", max_to_keep=5)
+        for step in (1, 2, 3):
+            ck.save(step, {"w": np.full(2, float(step))}, {})
+        faultinject.corrupt_checkpoint_step(ck.directory, 3, "arrays.npz")
+        faultinject.corrupt_checkpoint_step(ck.directory, 2, "meta.json")
+        restored = ck.restore()
+        assert restored.step == 1
+        np.testing.assert_array_equal(restored.arrays["w"], np.ones(2))
+        # an explicitly-requested corrupt step still raises (no silent
+        # substitution)
+        with pytest.raises(Exception):
+            ck.restore(step=3)
+
+    def test_prune_never_deletes_last_loadable_step(self, tmp_path):
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+
+        ck = TrainingCheckpointer(tmp_path / "ck", max_to_keep=10)
+        for step in (1, 2, 3, 4):
+            ck.save(step, {"w": np.full(2, float(step))}, {})
+        faultinject.corrupt_checkpoint_step(ck.directory, 3, "arrays.npz")
+        faultinject.corrupt_checkpoint_step(ck.directory, 4, "arrays.npz")
+        tight = TrainingCheckpointer(tmp_path / "ck", max_to_keep=2)
+        tight._prune()
+        # naive pruning would keep only {3, 4} — both corrupt; the newest
+        # loadable step (2) must survive
+        assert 2 in tight.steps()
+        assert tight.restore().step == 2
+
+    def test_restore_counter_journaled(self, tmp_path):
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+
+        ck = TrainingCheckpointer(tmp_path / "ck")
+        ck.save(1, {"w": np.zeros(2)}, {})
+        before = rc.checkpoint_restores()
+        ck.restore()  # direct restore does not count...
+        assert rc.checkpoint_restores() == before
+        # ...the CD-loop resume site does (tested in TestNanPoisonRecovery)
+
+
+# ---------------------------------------------------------------------------
+# NaN-poisoned lane -> DivergenceError -> checkpoint-restore recovery
+# ---------------------------------------------------------------------------
+
+
+def _mixed_data(rng, n_users=6, per_user=5, d_global=3, d_user=2):
+    from photon_ml_tpu.data.game_data import build_game_dataset
+
+    n = n_users * per_user
+    user_ids = np.repeat(np.arange(n_users), per_user)
+    xg = rng.normal(size=(n, d_global))
+    xu = rng.normal(size=(n, d_user))
+    y = (
+        xg @ rng.normal(size=d_global)
+        + np.einsum("nd,nd->n", xu, rng.normal(size=(n_users, d_user))[user_ids])
+        + 0.05 * rng.normal(size=n)
+    )
+    return build_game_dataset(
+        labels=y,
+        feature_shards={"global": xg, "per_user": xu},
+        entity_keys={"userId": user_ids},
+        dtype=np.float64,
+    )
+
+
+def _estimator(ckpt=None, resume=True):
+    from photon_ml_tpu.algorithm.coordinates import CoordinateOptimizationConfig
+    from photon_ml_tpu.estimators import (
+        FixedEffectCoordinateConfig,
+        GameEstimator,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+    from photon_ml_tpu.types import TaskType
+
+    opt = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(
+            optimizer_type=OptimizerType.LBFGS, max_iterations=25
+        ),
+        l2_weight=0.1,
+    )
+    return GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig("global", opt),
+            "per-user": RandomEffectCoordinateConfig("userId", "per_user", opt),
+        },
+        num_iterations=1,
+        checkpointer=ckpt,
+        resume=resume,
+    )
+
+
+class TestNanPoisonRecovery:
+    def test_poisoned_lane_recovers_bitwise_via_checkpoint(self, rng, tmp_path):
+        from photon_ml_tpu.algorithm.coordinates import RandomEffectCoordinate
+        from photon_ml_tpu.io.checkpoint import (
+            DivergenceError,
+            TrainingCheckpointer,
+        )
+
+        dataset = _mixed_data(rng)
+        baseline = _estimator().fit(dataset)
+
+        restores0, retries0 = rc.checkpoint_restores(), rc.retries()
+        ckpt_dir = tmp_path / "ck"
+
+        def attempt(restart):
+            return _estimator(
+                TrainingCheckpointer(ckpt_dir), resume=True
+            ).fit(dataset)
+
+        with faultinject.poison_coordinate_updates(
+            RandomEffectCoordinate, times=1
+        ):
+            # sanity: without recovery the poison is a DivergenceError
+            with pytest.raises(DivergenceError):
+                _estimator(TrainingCheckpointer(tmp_path / "nock")).fit(dataset)
+
+        with faultinject.poison_coordinate_updates(
+            RandomEffectCoordinate, times=1
+        ):
+            result = run_with_recovery(
+                attempt,
+                max_restarts=2,
+                checkpointer=TrainingCheckpointer(ckpt_dir),
+                description="chaos config",
+            )
+
+        # recovery resumed from the post-'fixed' checkpoint and re-ran the
+        # per-user update clean: the final model must be BITWISE the
+        # uninjected run's (lossless npz round-trip + deterministic solve)
+        np.testing.assert_array_equal(
+            np.asarray(result.model.models["fixed"].glm.coefficients.means),
+            np.asarray(baseline.model.models["fixed"].glm.coefficients.means),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(result.model.models["per-user"].coefficients),
+            np.asarray(baseline.model.models["per-user"].coefficients),
+        )
+        assert rc.checkpoint_restores() - restores0 >= 1
+        assert rc.retries() - retries0 >= 1
+
+    def test_divergence_without_checkpoint_fails_fast(self, rng, tmp_path):
+        from photon_ml_tpu.algorithm.coordinates import FixedEffectCoordinate
+        from photon_ml_tpu.io.checkpoint import DivergenceError
+
+        dataset = _mixed_data(rng)
+
+        def attempt(restart):
+            return _estimator().fit(dataset)
+
+        # poison the FIRST coordinate: no checkpoint exists yet, so this
+        # deterministic divergence must propagate (re-running from scratch
+        # would diverge identically), not burn restarts
+        with faultinject.poison_coordinate_updates(
+            FixedEffectCoordinate, times=99
+        ):
+            with pytest.raises(DivergenceError):
+                run_with_recovery(attempt, max_restarts=3, checkpointer=None)
+
+    def test_transient_failure_restarts_from_scratch(self):
+        calls = {"n": 0}
+
+        def attempt(restart):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ConnectionError("tunnel dropped")
+            return "done"
+
+        assert run_with_recovery(attempt, max_restarts=2) == "done"
+        assert calls["n"] == 2
+
+
+# ---------------------------------------------------------------------------
+# driver-level: quarantine + journaled resilience counters
+# ---------------------------------------------------------------------------
+
+
+class TestDriverQuarantineJournal:
+    @pytest.fixture()
+    def corrupt_train_dir(self, tmp_path):
+        from photon_ml_tpu.io import photon_schemas as schemas
+
+        data_dir = tmp_path / "train"
+        os.makedirs(data_dir)
+        rng = np.random.default_rng(7)
+        w = rng.normal(size=3)
+        records = []
+        for i in range(120):
+            x = rng.normal(size=3)
+            records.append(
+                {
+                    "uid": str(i),
+                    "label": float(x @ w + 0.05 * rng.normal()),
+                    "features": [
+                        {"name": f"f{j}", "term": "", "value": float(x[j])}
+                        for j in range(3)
+                    ],
+                    "weight": 1.0,
+                    "offset": 0.0,
+                    "metadataMap": None,
+                }
+            )
+        path = str(data_dir / "part-00000.avro")
+        avro_io.write_container(
+            path, schemas.TRAINING_EXAMPLE_AVRO, records, block_records=40
+        )
+        faultinject.truncate_avro_block(path, block=-1)
+        return data_dir
+
+    def test_training_driver_quarantines_and_journals(
+        self, corrupt_train_dir, tmp_path
+    ):
+        from photon_ml_tpu.cli import game_training_driver
+        from photon_ml_tpu.telemetry import JOURNAL_FILENAME, RunJournal
+
+        args = [
+            "--input-data-path", str(corrupt_train_dir),
+            "--root-output-dir", str(tmp_path / "out"),
+            "--task-type", "LINEAR_REGRESSION",
+            "--feature-shard-configurations",
+            "name=global,feature.bags=features,intercept=true",
+            "--coordinate-configurations",
+            "name=fe,feature.shard=global,reg.weights=0.1,max.iter=15",
+            "--telemetry-dir", str(tmp_path / "tel"),
+        ]
+        # strict default fails on the torn block
+        with pytest.raises(Exception):
+            game_training_driver.main(args)
+        summary = game_training_driver.main(
+            args + ["--override-output", "--on-corrupt", "quarantine"]
+        )
+        assert summary["num_configurations"] == 1
+        rows = RunJournal.read(str(tmp_path / "tel" / JOURNAL_FILENAME))
+        kinds = [r["kind"] for r in rows]
+        assert "quarantined_block" in kinds
+        snapshot = [r for r in rows if r["kind"] == "metrics"][-1]["snapshot"]
+        assert snapshot["counters"]["resilience/quarantined_blocks"] >= 1
+
+    def test_scoring_driver_journals_failure_path(self, tmp_path):
+        from photon_ml_tpu.cli import game_scoring_driver
+        from photon_ml_tpu.telemetry import JOURNAL_FILENAME, RunJournal
+
+        with pytest.raises(Exception):
+            game_scoring_driver.run(
+                input_data_path=str(tmp_path / "missing"),
+                model_input_dir=str(tmp_path / "no-model"),
+                output_dir=str(tmp_path / "out"),
+                feature_shards={},
+                telemetry_dir=str(tmp_path / "tel"),
+            )
+        # the journal survived the failure with the metrics snapshot
+        rows = RunJournal.read(str(tmp_path / "tel" / JOURNAL_FILENAME))
+        assert any(r["kind"] == "metrics" for r in rows)
+
+    def test_quarantine_events_are_json_safe(self, tmp_path):
+        path = str(tmp_path / "x.avro")
+        _write(path)
+        faultinject.corrupt_avro_block(path, block=0)
+        list(avro_io.read_container(path, on_corrupt="quarantine"))
+        events = rc.drain_quarantine_events()
+        assert events
+        json.dumps(events)  # journal rows must be strict JSON
