@@ -78,56 +78,62 @@ def _row(metric: str, value: float, spread, unit: str) -> dict:
 
 def _unit_primary(lane_iters: int, grid_sec: float) -> str:
     return (
-        f"ex*iters/s, {GRID}-lane lambda grid n=2^18 d={D}, "
-        f"{lane_iters} lane-iters, {grid_sec:.2f}s/grid 3v1-diff, "
-        f"med{GATE_REPS} everywhere, vs scipy iter-norm"
+        f"ex*it/s, {GRID}-lambda grid n=2^18 d={D}, "
+        f"{lane_iters} lane-it, {grid_sec:.2f}s/grid 3v1, "
+        f"med{GATE_REPS}, vs scipy it-norm"
     )
 
 
 def _unit_stream(n: int, d: int) -> str:
     return (
-        f"same-run cal: [n,d]-matvec read/step, n=2^{n.bit_length() - 1} "
-        f"d={d}, roofline {HBM_ROOFLINE_GBPS:.0f}"
+        f"same-run cal matvec/step, n=2^{n.bit_length() - 1} "
+        f"d={d}, roof {HBM_ROOFLINE_GBPS:.0f}"
     )
 
 
 def _unit_hot_loop(note: str, ms_per_eval: float, frac: float) -> str:
     return (
-        f"{note}, {ms_per_eval:.3f} ms/eval, {frac:.2f}x stream"
+        f"{note}, {ms_per_eval:.3f}ms/e, {frac:.2f}x cal"
     )
 
 
 def _unit_sweep(newton: bool) -> str:
     if newton:
         return (
-            "ms/sweep, REs on batched Newton, FE unchanged"
+            "ms/sweep, REs batched Newton, FE same"
         )
     return (
-        "ms/sweep: FE d=256 + 2 REs (2000/1500 ent, d=16) + rescore, "
-        "n=2^17, 10 LBFGS it/coord"
+        "ms/sweep: FE d=256 + 2 REs (2000/1500, d=16) + rescore, "
+        "n=2^17, 10 LBFGS it"
     )
+
+
+def _unit_sweep_scheduled() -> str:
+    # compare against fused_game_sweep_ms from the SAME run only (the
+    # calibration discipline); includes the scheduler's host reads
+    return "ms/sweep, RE probe2+rescue sched, ftol 1e-6"
 
 
 def _unit_sparse_1e7(nnz: int, ms_per_iter: float) -> str:
     return (
-        f"nnz*iters/s, d=1e7 ELL, n=2^19 nnz={nnz}, "
-        f"{ms_per_iter:.1f} ms/iter"
+        f"nnz*it/s, d=1e7 ELL, n=2^19 nnz={nnz}, "
+        f"{ms_per_iter:.1f}ms/it"
     )
 
 
 def _unit_sparse_1e8(nnz: int, entry_iters_m: float) -> str:
     return (
-        f"ms/TRON-iter (2 CG), d=1e8 ELL, n=2^18 nnz={nnz}, "
-        f"{entry_iters_m:.1f}M entry-iters/s"
+        f"ms/TRON-it (2CG), d=1e8 ELL, n=2^18 nnz={nnz}, "
+        f"{entry_iters_m:.1f}M ent-it/s"
     )
 
 
 #: hot-loop row labels -> telegraphic GB/s notes (prose: BASELINE.md r4)
 HOT_LOOP_NOTES = {
-    "autodiff_xla": "2 X passes (pre-r4)",
-    "pallas_kernel": "1 fused f32 pass (r4 default)",
-    "pallas_bf16": "1 fused bf16 pass, f32 accum",
-    "pallas_shardmap_mesh1": "kernel in shard_map, mesh1",
+    "autodiff_xla": "2 X passes",
+    "pallas_kernel": "1 f32 pass (default)",
+    "pallas_bf16": "bf16 pass, f32 accum",
+    "pallas_shardmap_mesh1": "shard_map mesh1",
 }
 
 
@@ -145,6 +151,7 @@ def sample_report() -> dict:
     extra += [
         _row("fused_game_sweep_ms", big, sp, _unit_sweep(newton=False)),
         _row("fused_game_sweep_newton_ms", big, sp, _unit_sweep(newton=True)),
+        _row("fused_game_sweep_scheduled_ms", big, sp, _unit_sweep_scheduled()),
         _row("sparse_giant_fe_entry_iters_per_sec", big, sp,
              _unit_sparse_1e7(25165824, 9999.9)),
         _row("sparse_1e8_fe_tron_ms_per_iter", big, sp,
@@ -393,9 +400,21 @@ def bench_game_sweep() -> list[dict]:
                                        bucket_sizes=(128,))
         for t in ("user", "item")
     }
+    from photon_ml_tpu.optim.optimizer import LaneSchedulerConfig
+
     opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=10)
     newton = OptimizerConfig(optimizer_type=OptimizerType.NEWTON,
                              max_iterations=10)
+    # probe/rescue lane scheduling (algorithm/lane_scheduler.py) + the live
+    # function-decrease stop: the same 10-iteration LBFGS budget, but lanes
+    # that converge in the 2-iteration probe never pay the rest. Compare
+    # against fused_game_sweep_ms from the SAME run per the calibration
+    # discipline — the scheduled step's host reads ride the marginal.
+    scheduled = OptimizerConfig(
+        optimizer_type=OptimizerType.LBFGS, max_iterations=10,
+        rel_function_tolerance=1e-6,
+        scheduler=LaneSchedulerConfig(probe_iterations=2),
+    )
 
     def make_program(re_opt):
         return GameTrainProgram(
@@ -409,7 +428,8 @@ def bench_game_sweep() -> list[dict]:
             use_pallas_fe=True,  # single chip: the FE solve takes the kernel
         )
 
-    def measure(program):
+    def measure(program, step_fn=None):
+        step = step_fn if step_fn is not None else program.step
         data, buckets = program.prepare_inputs(dataset, re_datasets, None)
         base_state = program.init_state(dataset, re_datasets, None)
 
@@ -436,7 +456,7 @@ def bench_game_sweep() -> list[dict]:
             state = perturbed(seed)
             t0 = time.perf_counter()
             for _ in range(k):
-                state, loss = program.step(data, buckets, state)
+                state, loss = step(data, buckets, state)
             read_scalar(state.fe_coefficients)  # host read: hard sync
             return time.perf_counter() - t0
 
@@ -454,6 +474,21 @@ def bench_game_sweep() -> list[dict]:
 
     per_sweep, sp = measure(make_program(opt))
     newton_sweep, newton_sp = measure(make_program(newton))
+
+    sched_program = make_program(scheduled)
+    from photon_ml_tpu.algorithm.lane_scheduler import LaneScheduler
+
+    schedulers = {
+        s.re_type: LaneScheduler(s.optimizer.scheduler)
+        for s in sched_program.re_specs
+    }
+
+    def sched_step(data, buckets, state):
+        return sched_program.step_scheduled(
+            data, buckets, state, schedulers=schedulers
+        )
+
+    sched_sweep, sched_sp = measure(sched_program, step_fn=sched_step)
     return [
         _row(
             "fused_game_sweep_ms",
@@ -466,6 +501,12 @@ def bench_game_sweep() -> list[dict]:
             round(newton_sweep * 1e3, 1),
             [round(s * 1e3, 1) for s in newton_sp],
             _unit_sweep(newton=True),
+        ),
+        _row(
+            "fused_game_sweep_scheduled_ms",
+            round(sched_sweep * 1e3, 1),
+            [round(s * 1e3, 1) for s in sched_sp],
+            _unit_sweep_scheduled(),
         ),
     ]
 

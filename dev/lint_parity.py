@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static parity-convention lints for photon_ml_tpu (CLAUDE.md conventions).
 
-Five checks, all pure-AST (no jax import; runs in milliseconds):
+Six checks, all pure-AST (no jax import; runs in milliseconds):
 
 1. **Docstring citations** — every ``photon_ml_tpu/**/*.py`` module (except
    ``__init__.py`` re-export shims) must carry a module docstring that
@@ -45,6 +45,15 @@ Five checks, all pure-AST (no jax import; runs in milliseconds):
    its (file, function) is on the resilience classifier's reviewed
    allowlist below (capability probes, destructor guards, listener
    isolation).
+
+6. **Pallas in vmapped solve modules** — ``lax.while_loop`` bodies trace
+   with UNBATCHED tracers, so a ``pallas_call`` baked into a solver loop
+   cannot see the vmap and gets batched into a serial per-lane loop
+   (measured 40x slower on the λ-grid, BASELINE.md r4; the reason
+   ops/objective.py forces use_pallas=False on every vmapped lane). The
+   solver/coordinate modules (``optim/``, ``algorithm/``, estimators.py)
+   therefore must not contain a literal ``use_pallas=True`` call keyword,
+   any ``pallas_call`` reference, or an import of a pallas module.
 
 Exit status 0 = clean; 1 = violations (printed one per line as
 ``path:lineno: message``). Run from the repo root:
@@ -319,6 +328,56 @@ def check_broad_excepts(root: pathlib.Path) -> list[str]:
     return problems
 
 
+#: modules whose solves are vmapped (per-entity RE/MF buckets, λ-grid
+#: lanes): a Pallas kernel reachable from them vmap-batches into a serial
+#: per-lane loop (the measured 40x footgun — check 6 above)
+VMAPPED_SOLVE_PREFIXES = (
+    f"{PACKAGE}/optim/",
+    f"{PACKAGE}/algorithm/",
+    f"{PACKAGE}/estimators.py",
+)
+
+_PALLAS_MODULE_RE = re.compile(r"(^|\.)pallas(\b|_glm)")
+
+
+def check_vmapped_pallas(root: pathlib.Path) -> list[str]:
+    problems = []
+    for path in sorted((root / PACKAGE).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if not rel.startswith(VMAPPED_SOLVE_PREFIXES):
+            continue
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            hit = None
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "use_pallas"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                    ):
+                        hit = "use_pallas=True"
+            elif isinstance(node, ast.Name) and node.id == "pallas_call":
+                hit = "pallas_call"
+            elif isinstance(node, ast.Attribute) and node.attr == "pallas_call":
+                hit = "pallas_call"
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                mods = [a.name for a in node.names]
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    mods.append(node.module)
+                if any(_PALLAS_MODULE_RE.search(m) for m in mods):
+                    hit = "pallas import"
+            if hit:
+                problems.append(
+                    f"{rel}:{node.lineno}: {hit} in a vmapped-solve module — "
+                    "while_loop bodies trace unbatched, so a baked-in Pallas "
+                    "call gets vmap-batched into a serial per-lane loop "
+                    "(measured 40x slower); keep use_pallas=False on vmapped "
+                    "lanes (ops/objective.py)"
+                )
+    return problems
+
+
 def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
     root = pathlib.Path(root) if root else pathlib.Path(__file__).resolve().parents[1]
     return (
@@ -327,6 +386,7 @@ def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
         + check_cli_full_reads(root)
         + check_score_allgathers(root)
         + check_broad_excepts(root)
+        + check_vmapped_pallas(root)
     )
 
 

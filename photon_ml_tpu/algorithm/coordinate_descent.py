@@ -152,6 +152,13 @@ def run_coordinate_descent(
                 continue
             if slot < start_slot:
                 continue  # already completed before the restored checkpoint
+            if hasattr(coord, "set_sweep"):
+                # cross-sweep active sets (algorithm/lane_scheduler.py):
+                # a lane-scheduled random-effect coordinate may freeze
+                # converged entities and skip them in later sweeps' solves
+                # (they are still rescored below) — it needs to know the
+                # final sweep, which always runs everyone
+                coord.set_sweep(iteration, num_iterations)
             # partial score = everything except this coordinate
             partial = full_score() - scores[cid]
             model, _info = coord.update_model(models[cid], partial)

@@ -239,6 +239,21 @@ class RandomEffectCoordinate(Coordinate):
     config: CoordinateOptimizationConfig
     normalization: NormalizationContext | None = None
     intercept_index: int | None = None
+    #: probe/rescue lane-scheduler state (algorithm/lane_scheduler.py),
+    #: created on first scheduled update when the coordinate's
+    #: OptimizerConfig carries a LaneSchedulerConfig; persists across CD
+    #: iterations (host bucket caches + cross-sweep active sets)
+    _scheduler: object = dataclasses.field(default=None, init=False, repr=False)
+    #: (iteration, num_iterations) from the CD loop — the active set needs
+    #: to know the final sweep (it runs everyone). Standalone update_model
+    #: calls leave it None, which means "treat as final": never skip.
+    _sweep_context: tuple = dataclasses.field(default=None, init=False, repr=False)
+
+    def set_sweep(self, iteration: int, num_iterations: int) -> None:
+        """Cross-sweep context hook, called by run_coordinate_descent before
+        each update (CoordinateDescent.scala:198-255's per-iteration loop is
+        where the reference knows the sweep index too)."""
+        self._sweep_context = (iteration, num_iterations)
 
     def initial_model(self) -> RandomEffectModel:
         from photon_ml_tpu.data.batch import solve_dtype_of
@@ -342,7 +357,11 @@ class RandomEffectCoordinate(Coordinate):
             table = norm.from_model_space(model.coefficients, self.intercept_index)
 
         traces: list[LaneTrace] = []
-        if projector == ProjectorType.INDEX_MAP:
+        if opt.scheduler is not None:
+            table, traces = self._solve_scheduled(
+                objective, opt, projector, full_offsets, table
+            )
+        elif projector == ProjectorType.INDEX_MAP:
             # extra scratch column absorbs the padding scatter/gather slots
             table_ext = jnp.concatenate(
                 [table, jnp.zeros((table.shape[0], 1), table.dtype)], axis=1
@@ -482,6 +501,38 @@ class RandomEffectCoordinate(Coordinate):
 
     def score(self, model: RandomEffectModel) -> Array:
         return model.score_dataset(self.dataset)
+
+    def _solve_scheduled(self, objective, opt, projector, full_offsets, table):
+        """Probe/rescue (+ cross-sweep active-set) solve of every bucket via
+        algorithm/lane_scheduler.py; returns (table, host-numpy traces)."""
+        # lazy import: lane_scheduler builds on this module's bucket solvers
+        from photon_ml_tpu.algorithm.lane_scheduler import LaneScheduler
+
+        if self._scheduler is None or self._scheduler.config != opt.scheduler:
+            self._scheduler = LaneScheduler(opt.scheduler)
+        iteration, num_iterations = self._sweep_context or (0, 1)
+        matrix = (
+            jnp.asarray(self.re_dataset.projection.matrix, dtype=table.dtype)
+            if projector == ProjectorType.RANDOM else None
+        )
+        blocks = [
+            {
+                "features": b.features,
+                "labels": b.labels,
+                "weights": b.weights,
+                "sample_rows": b.sample_rows,
+                "entity_rows": b.entity_rows,
+                **({"col_index": b.col_index}
+                   if projector == ProjectorType.INDEX_MAP else {}),
+            }
+            for b in self.re_dataset.buckets
+        ]
+        table, traces, _stats = self._scheduler.solve(
+            objective, opt, blocks, full_offsets, table,
+            projector=projector, matrix=matrix,
+            final_sweep=iteration >= num_iterations - 1,
+        )
+        return table, traces
 
 
 def _bucket_offsets(sample_rows: Array, full_offsets: Array) -> Array:

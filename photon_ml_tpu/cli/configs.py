@@ -27,7 +27,11 @@ from photon_ml_tpu.estimators import (
 )
 from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
 from photon_ml_tpu.ops.variance import validate_variance_mode
-from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+from photon_ml_tpu.optim.optimizer import (
+    LaneSchedulerConfig,
+    OptimizerConfig,
+    OptimizerType,
+)
 from photon_ml_tpu.projector.projectors import ProjectorType
 
 
@@ -124,6 +128,10 @@ class CoordinateCliConfig:
     optimizer: OptimizerType = OptimizerType.LBFGS
     max_iterations: int = 100
     tolerance: float = 1e-7
+    #: live function-decrease stop (optim/common.check_convergence): the
+    #: knob that lets warm-started vmapped lanes exit before max_iter.
+    #: None keeps the reference behavior (the plain tolerance)
+    rel_function_tolerance: float | None = None
     #: TRON inner CG cap (giant-d solves budget device time with a short
     #: CG ladder; ignored by other optimizers)
     max_cg_iterations: int = 20
@@ -139,6 +147,17 @@ class CoordinateCliConfig:
     projector: ProjectorType = ProjectorType.IDENTITY
     projected_dim: int | None = None
     features_to_samples_ratio: float | None = None
+    #: probe/rescue lane scheduling for the vmapped per-entity solves
+    #: (algorithm/lane_scheduler.py); strictly opt-in — off is
+    #: bitwise-identical to the unscheduled path
+    scheduler: bool = False
+    scheduler_probe_iterations: int = 2
+    #: cross-sweep active sets: entities whose relative coefficient delta
+    #: AND gradient norm fall below these after a sweep are frozen (skipped
+    #: by later sweeps, still rescored; final sweep runs everyone). Both
+    #: must be > 0 to freeze anything.
+    scheduler_freeze_tolerance: float = 0.0
+    scheduler_freeze_gradient: float = 0.0
     # matrix-factorization only (feature_shard is unused: the "features" of
     # an MF coordinate are the other side's latent factors)
     mf_row_effect_type: str | None = None
@@ -162,7 +181,13 @@ class CoordinateCliConfig:
                 optimizer_type=self.optimizer,
                 max_iterations=self.max_iterations,
                 tolerance=self.tolerance,
+                rel_function_tolerance=self.rel_function_tolerance,
                 max_cg_iterations=self.max_cg_iterations,
+                scheduler=LaneSchedulerConfig(
+                    probe_iterations=self.scheduler_probe_iterations,
+                    freeze_coefficient_tolerance=self.scheduler_freeze_tolerance,
+                    freeze_gradient_tolerance=self.scheduler_freeze_gradient,
+                ) if self.scheduler else None,
             ),
             l2_weight=l2,
             l1_weight=l1,
@@ -218,6 +243,8 @@ def format_coordinate_config(cfg: CoordinateCliConfig) -> str:
         parts.append(f"max.iter={cfg.max_iterations}")
     if cfg.tolerance != d["tolerance"]:
         parts.append(f"tolerance={cfg.tolerance!r}")
+    if cfg.rel_function_tolerance is not None:
+        parts.append(f"rel.function.tolerance={cfg.rel_function_tolerance!r}")
     if cfg.max_cg_iterations != d["max_cg_iterations"]:
         parts.append(f"max.cg.iter={cfg.max_cg_iterations}")
     if cfg.reg_weights != d["reg_weights"]:
@@ -244,6 +271,18 @@ def format_coordinate_config(cfg: CoordinateCliConfig) -> str:
         parts.append(f"projected.dim={cfg.projected_dim}")
     if cfg.features_to_samples_ratio is not None:
         parts.append(f"features.to.samples.ratio={cfg.features_to_samples_ratio!r}")
+    if cfg.scheduler != d["scheduler"]:
+        parts.append("scheduler=true")
+    if cfg.scheduler_probe_iterations != d["scheduler_probe_iterations"]:
+        parts.append(f"scheduler.probe.iter={cfg.scheduler_probe_iterations}")
+    if cfg.scheduler_freeze_tolerance != d["scheduler_freeze_tolerance"]:
+        parts.append(
+            f"scheduler.freeze.tolerance={cfg.scheduler_freeze_tolerance!r}"
+        )
+    if cfg.scheduler_freeze_gradient != d["scheduler_freeze_gradient"]:
+        parts.append(
+            f"scheduler.freeze.gradient={cfg.scheduler_freeze_gradient!r}"
+        )
     if cfg.mf_row_effect_type:
         parts.append(f"mf.row.effect.type={cfg.mf_row_effect_type}")
         parts.append(f"mf.col.effect.type={cfg.mf_col_effect_type}")
@@ -280,6 +319,9 @@ def parse_coordinate_config(spec: str) -> CoordinateCliConfig:
         optimizer=OptimizerType(pop("optimizer", "LBFGS").upper()),
         max_iterations=int(pop("max.iter", "100")),
         tolerance=float(pop("tolerance", "1e-7")),
+        rel_function_tolerance=(
+            float(v) if (v := pop("rel.function.tolerance")) else None
+        ),
         max_cg_iterations=int(pop("max.cg.iter", "20")),
         reg_weights=tuple(
             float(w) for w in pop("reg.weights", "0").split(LIST_SEP) if w
@@ -300,6 +342,10 @@ def parse_coordinate_config(spec: str) -> CoordinateCliConfig:
         features_to_samples_ratio=(
             float(v) if (v := pop("features.to.samples.ratio")) else None
         ),
+        scheduler=_bool(pop("scheduler", "false")),
+        scheduler_probe_iterations=int(pop("scheduler.probe.iter", "2")),
+        scheduler_freeze_tolerance=float(pop("scheduler.freeze.tolerance", "0")),
+        scheduler_freeze_gradient=float(pop("scheduler.freeze.gradient", "0")),
         mf_row_effect_type=pop("mf.row.effect.type"),
         mf_col_effect_type=pop("mf.col.effect.type"),
         mf_latent_factors=int(pop("mf.latent.factors", "0")),
@@ -325,6 +371,13 @@ def parse_coordinate_config(spec: str) -> CoordinateCliConfig:
         raise ValueError(
             f"coordinate {name!r}: features.to.samples.ratio is per-entity "
             "Pearson selection and only applies to random-effect coordinates"
+        )
+    if cfg.scheduler and not cfg.is_random_effect:
+        raise ValueError(
+            f"coordinate {name!r}: scheduler=true is probe/rescue lane "
+            "scheduling for VMAPPED per-entity solves and only applies to "
+            "random-effect coordinates (fixed effects are a single "
+            "un-vmapped solve; use rel.function.tolerance there)"
         )
     if cfg.is_matrix_factorization and cfg.is_random_effect:
         raise ValueError(

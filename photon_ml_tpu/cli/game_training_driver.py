@@ -580,6 +580,12 @@ def _run_inner(
                 params.update_sequence or params.coordinates.keys()
             ),
             coordinate_descent_iterations=params.coordinate_descent_iterations,
+            # lane-scheduled coordinates (algorithm/lane_scheduler.py): the
+            # scheduler/* counters + solver/lane_iters histogram land in the
+            # registry snapshot journaled on success AND failure paths
+            scheduled_coordinates=[
+                name for name, cfg in params.coordinates.items() if cfg.scheduler
+            ],
         )
     first_evaluator = parse_evaluator(params.evaluators[0]) if params.evaluators else None
 

@@ -423,6 +423,64 @@ def pack_bucket_lanes(
     return entity_rows, rows_concat, lane, slot
 
 
+def compact_lane_blocks(
+    host_blocks: Sequence[Mapping[str, np.ndarray]],
+    picks: Sequence[tuple[int, np.ndarray]],
+    *,
+    pad_to: int,
+    sentinel_row: int,
+) -> tuple[dict[str, np.ndarray], np.ndarray, np.ndarray]:
+    """Gather selected lanes of same-(cap, d) host bucket blocks into ONE
+    padded block — the lane-compaction counterpart of
+    :func:`pack_bucket_lanes`'s slot packing, used by the probe/rescue lane
+    scheduler (algorithm/lane_scheduler.py) to re-run only unconverged
+    entity solves.
+
+    picks: [(block_index, lane_indices), ...] — every named block must share
+        capacity and feature width (the caller groups by (cap, d)).
+    pad_to: lane count of the output block (power-of-two padded, so rescue
+        jit signatures stay bounded across sweeps).
+    sentinel_row: ``entity_rows`` value for padding lanes — out of range for
+        any coefficient table, so gathers clamp (junk warm starts on
+        all-zero-weight lanes are harmless) and scatters drop.
+
+    Returns (fields, src_block, src_lane): the padded field dict (weights 0 /
+    sample_rows -1 / entity_rows sentinel on padding lanes) plus the source
+    (block, lane) of each REAL lane for trace scatter-back.
+    """
+    src_block = np.concatenate(
+        [np.full(len(lanes), b, dtype=np.int32) for b, lanes in picks]
+    )
+    src_lane = np.concatenate(
+        [np.asarray(lanes, dtype=np.int64) for _, lanes in picks]
+    )
+    m = len(src_lane)
+    if not 0 < m <= pad_to:
+        raise ValueError(f"{m} picked lanes do not fit pad_to={pad_to}")
+    pad = pad_to - m
+    out: dict[str, np.ndarray] = {}
+    first = host_blocks[picks[0][0]]
+    for key in ("features", "labels", "weights", "sample_rows", "col_index"):
+        if first.get(key) is None:
+            continue
+        arr = np.concatenate(
+            [host_blocks[b][key][lanes] for b, lanes in picks], axis=0
+        )
+        if pad:
+            pad_block = np.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)
+            if key == "sample_rows":
+                pad_block[...] = -1
+            arr = np.concatenate([arr, pad_block], axis=0)
+        out[key] = arr
+    rows = np.concatenate(
+        [np.asarray(host_blocks[b]["entity_rows"][lanes]) for b, lanes in picks]
+    ).astype(np.int32)
+    if pad:
+        rows = np.concatenate([rows, np.full(pad, sentinel_row, np.int32)])
+    out["entity_rows"] = rows
+    return out, src_block, src_lane
+
+
 def build_random_effect_dataset(
     dataset: GameDataset,
     re_type: str,

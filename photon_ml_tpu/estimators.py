@@ -364,6 +364,10 @@ class GameEstimator:
                 )
             if cfg.optimization.down_sampling_rate < 1.0:
                 problems.append(f"down-sampling on '{cid}'")
+            if cfg.optimization.optimizer.scheduler is not None:
+                # lane-scheduler host compaction reads bucket shards, which
+                # a multi-process partitioned run cannot address
+                problems.append(f"lane scheduling on '{cid}'")
             if cfg.optimization.compute_variance:
                 problems.append(f"compute_variance on '{cid}'")
             if isinstance(
@@ -985,7 +989,8 @@ def train_glm_grid(
     ) if has_bounds else None
     results = _jitted_grid_solve(
         objective, use_owlqn, optimizer.history,
-        optimizer.max_iterations, optimizer.tolerance, batch, l2s, l1s,
+        optimizer.max_iterations, optimizer.tolerance,
+        optimizer.rel_function_tolerance, batch, l2s, l1s,
         bounds,
     )
     if telemetry is not None:
@@ -1019,13 +1024,16 @@ def train_glm_grid(
     return models
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
 def _jitted_grid_solve(objective, use_owlqn, history, max_iter, tolerance,
-                       batch, l2v, l1v, bounds=None):
+                       rel_function_tolerance, batch, l2v, l1v, bounds=None):
     """Module-level jit: one compiled vmapped-grid program per
     (objective, optimizer statics) pair, reused across train_glm_grid calls
     of the same shapes. ``bounds``: optional (lower[d], upper[d]) box shared
-    by every lane."""
+    by every lane. ``rel_function_tolerance``: the live function-decrease
+    stop inside every lane's while_loop — the λ-grid shares the RE-bucket
+    pathology of every lane paying the worst lane's max_iter (CLAUDE.md);
+    the objective stays use_pallas=False because these lanes are vmapped."""
     from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
     from photon_ml_tpu.optim.owlqn import minimize_owlqn
 
@@ -1042,9 +1050,11 @@ def _jitted_grid_solve(objective, use_owlqn, history, max_iter, tolerance,
             return minimize_owlqn(
                 vg, w0, l1_weight=l1,
                 max_iter=max_iter, tolerance=tolerance, history=history,
+                rel_function_tolerance=rel_function_tolerance,
             )
         return minimize_lbfgs(
             vg, w0, max_iter=max_iter, tolerance=tolerance, history=history,
+            rel_function_tolerance=rel_function_tolerance,
             lower_bounds=None if bounds is None else bounds[0],
             upper_bounds=None if bounds is None else bounds[1],
         )

@@ -7,6 +7,7 @@ from photon_ml_tpu.optim.newton import minimize_newton  # noqa: F401
 from photon_ml_tpu.optim.owlqn import minimize_owlqn  # noqa: F401
 from photon_ml_tpu.optim.tron import minimize_tron  # noqa: F401
 from photon_ml_tpu.optim.optimizer import (  # noqa: F401
+    LaneSchedulerConfig,
     OptimizerConfig,
     OptimizerType,
     default_config_for,

@@ -93,6 +93,11 @@ class LaneTrace:
     value: Array  # [lanes] final objective values
     gradient_norm: Array  # [lanes]
     valid: Array  # [lanes] bool; False = padding lane
+    #: True when the lane scheduler (algorithm/lane_scheduler.py) produced
+    #: this trace — it has already observed these lanes into the
+    #: solver/lane_iters histogram, so telemetry consumers must not count
+    #: them again (static metadata, not a pytree leaf)
+    scheduled: bool = flax.struct.field(pytree_node=False, default=False)
 
 
 class LaneTraces:
@@ -132,17 +137,28 @@ def check_convergence(
     grad_norm: Array,
     initial_grad_norm: Array,
     tolerance: float,
+    rel_function_tolerance: float | None = None,
 ) -> Array:
     """Return a ConvergenceReason code (0 if not converged).
 
     Matches the reference's dual test (Optimizer.scala:135-149): relative
     change in objective value below tolerance, or gradient norm below
     tolerance relative to the initial gradient norm.
+
+    ``rel_function_tolerance`` (default None = use ``tolerance``, the
+    reference behavior) sets a SEPARATE threshold for the function-decrease
+    test. This is the live stop that actually fires in f32 for warm-started
+    vmapped lanes: an exact step leaves ‖g‖ at rounding scale, which a large
+    warm-start g0 never maps below the relative gradient tolerance, and at
+    the 1e-7 default the relative value delta sits at f32 rounding scale too
+    — without a looser live function stop every lane pays max_iter
+    (CLAUDE.md; the ~87% RE-solve share of the fused sweep, BASELINE.md r5).
     """
     rel_delta = jnp.abs(value - prev_value) / jnp.maximum(
         jnp.maximum(jnp.abs(value), jnp.abs(prev_value)), 1.0
     )
-    func_ok = rel_delta <= tolerance
+    ftol = tolerance if rel_function_tolerance is None else rel_function_tolerance
+    func_ok = rel_delta <= ftol
     grad_ok = grad_norm <= tolerance * jnp.maximum(initial_grad_norm, 1.0)
     return jnp.where(
         grad_ok,

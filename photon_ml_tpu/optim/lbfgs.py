@@ -101,6 +101,7 @@ def minimize_lbfgs(
     max_iter: int = DEFAULT_MAX_ITER,
     history: int = DEFAULT_HISTORY,
     tolerance: float = DEFAULT_TOLERANCE,
+    rel_function_tolerance: float | None = None,
     lower_bounds: Array | None = None,
     upper_bounds: Array | None = None,
     max_line_search_steps: int = 25,
@@ -111,6 +112,11 @@ def minimize_lbfgs(
     the box after every accepted step and convergence is tested on the
     projected gradient — the gradient-projection scheme the reference applies
     (LBFGS.scala:70-76); the dedicated LBFGSB entry point builds on this.
+
+    ``rel_function_tolerance`` (None = reference behavior, use
+    ``tolerance``): a separate live function-decrease stop inside the
+    while_loop condition, so warm-started vmapped lanes can actually exit
+    instead of paying max_iter (optim/common.check_convergence).
     """
     dtype = w0.dtype
     d = w0.shape[0]
@@ -267,6 +273,7 @@ def minimize_lbfgs(
                 grad_norm=gnorm,
                 initial_grad_norm=state.g0_norm,
                 tolerance=tolerance,
+                rel_function_tolerance=rel_function_tolerance,
             ),
             jnp.int32(ConvergenceReason.LINE_SEARCH_FAILED),
         )

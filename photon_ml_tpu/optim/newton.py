@@ -103,6 +103,7 @@ def minimize_newton(
     value_fn: Callable[[Array], Array] | None = None,
     max_iter: int = 15,
     tolerance: float = 1e-7,
+    rel_function_tolerance: float | None = None,
 ) -> SolverResult:
     """Minimize a twice-differentiable convex objective by damped Newton
     (Levenberg-Marquardt safeguarded).
@@ -117,6 +118,11 @@ def minimize_newton(
     x64 and retries rather than terminating, so the solver always makes
     progress instead of silently returning w0. jit- and vmap-safe (fixed
     shapes, no divergent inner loops).
+
+    ``rel_function_tolerance`` (None = use ``tolerance``, unchanged
+    behavior): a separate threshold for the function-decrease stop — the
+    live-stop knob the LBFGS/OWLQN family adopted from this solver's
+    pattern (optim/common.check_convergence).
     """
     dtype = w0.dtype
     w0 = jnp.asarray(w0, dtype)
@@ -126,6 +132,7 @@ def minimize_newton(
     f0, g0 = value_and_grad_fn(w0)
     g0_norm = jnp.linalg.norm(g0)
     alphas = jnp.asarray(_ALPHAS, dtype)
+    ftol = tolerance if rel_function_tolerance is None else rel_function_tolerance
 
     nan_hist = jnp.full((max_iter + 1,), jnp.nan, dtype)
     init = _NewtonState(
@@ -182,7 +189,7 @@ def minimize_newton(
         # tolerance, and without a live stop every vmapped lane pays
         # max_iter full iterations — the 81 ms sweep in
         # newton_sweep_probe_r5.log)
-        f_delta_small = jnp.abs(vals[0] - vals[best]) <= tolerance * (
+        f_delta_small = jnp.abs(vals[0] - vals[best]) <= ftol * (
             jnp.abs(vals[0]) + 1e-30
         )
         w_new = jnp.where(improved, state.w + alphas[best] * p, state.w)

@@ -39,11 +39,50 @@ class OptimizerType(enum.Enum):
 
 
 @dataclasses.dataclass(frozen=True)
+class LaneSchedulerConfig:
+    """Converged-lane scheduling for vmapped random-effect solves
+    (algorithm/lane_scheduler.py; no reference analogue — the reference's
+    per-entity RDD solves are independently scheduled by Spark's task
+    scheduler, while vmapped lanes advance in lock-step to the worst lane).
+
+    probe_iterations: short probe budget — every lane solves this many
+        iterations, then only lanes that are still at MAX_ITERATIONS are
+        host-compacted into power-of-two-padded rescue blocks and re-run
+        with the remaining ``max_iterations - probe_iterations`` budget.
+    freeze_coefficient_tolerance / freeze_gradient_tolerance: cross-sweep
+        active sets — when BOTH are > 0, entities whose relative coefficient
+        delta and final gradient norm fall below these thresholds after a
+        sweep are frozen (skipped by later sweeps' solves, still rescored);
+        the final sweep always runs everyone.
+    """
+
+    probe_iterations: int = 2
+    freeze_coefficient_tolerance: float = 0.0
+    freeze_gradient_tolerance: float = 0.0
+
+    @property
+    def freezes(self) -> bool:
+        return (
+            self.freeze_coefficient_tolerance > 0.0
+            and self.freeze_gradient_tolerance > 0.0
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class OptimizerConfig:
     """Static solver configuration (reference OptimizerConfig.scala).
 
     ``box_constraints``: optional (lower, upper) arrays for LBFGSB / the
     reference's constraint-map projection (LBFGS.scala:70-76).
+
+    ``rel_function_tolerance`` (None = reference behavior): separate live
+    function-decrease stop threshold — the knob that lets warm-started
+    vmapped lanes exit before max_iter (optim/common.check_convergence).
+
+    ``scheduler`` (None = off, bitwise-identical to the unscheduled path):
+    probe/rescue lane scheduling for vmapped random-effect solves. Consumed
+    ABOVE :func:`solve` by algorithm/lane_scheduler.py; the solver dispatch
+    below ignores it.
     """
 
     optimizer_type: OptimizerType = OptimizerType.LBFGS
@@ -52,6 +91,8 @@ class OptimizerConfig:
     history: int = 10  # L-BFGS memory m
     max_cg_iterations: int = 20  # TRON inner loop cap
     l1_weight: float = 0.0  # OWLQN only; set by the elastic-net path
+    rel_function_tolerance: float | None = None
+    scheduler: LaneSchedulerConfig | None = None
 
     def with_l1(self, l1_weight: float) -> "OptimizerConfig":
         return dataclasses.replace(self, l1_weight=l1_weight)
@@ -83,6 +124,7 @@ def solve(
             max_iter=config.max_iterations,
             history=config.history,
             tolerance=config.tolerance,
+            rel_function_tolerance=config.rel_function_tolerance,
             lower_bounds=lower_bounds,
             upper_bounds=upper_bounds,
         )
@@ -95,6 +137,7 @@ def solve(
             max_iter=config.max_iterations,
             history=config.history,
             tolerance=config.tolerance,
+            rel_function_tolerance=config.rel_function_tolerance,
             lower_bounds=lower_bounds,
             upper_bounds=upper_bounds,
         )
@@ -106,6 +149,7 @@ def solve(
             max_iter=config.max_iterations,
             history=config.history,
             tolerance=config.tolerance,
+            rel_function_tolerance=config.rel_function_tolerance,
         )
     if t == OptimizerType.TRON:
         loss = objective.objective.loss
@@ -120,6 +164,7 @@ def solve(
             w0,
             max_iter=config.max_iterations,
             tolerance=config.tolerance,
+            rel_function_tolerance=config.rel_function_tolerance,
             max_cg_iter=config.max_cg_iterations,
         )
     if t == OptimizerType.NEWTON:
@@ -145,6 +190,7 @@ def solve(
             value_fn=objective.value,
             max_iter=config.max_iterations,
             tolerance=config.tolerance,
+            rel_function_tolerance=config.rel_function_tolerance,
         )
     raise ValueError(f"Unknown optimizer type {t}")
 

@@ -68,11 +68,14 @@ def minimize_owlqn(
     max_iter: int = 100,
     history: int = 10,
     tolerance: float = 1e-7,
+    rel_function_tolerance: float | None = None,
     max_line_search_steps: int = 30,
 ) -> SolverResult:
     """Minimize smooth(w) + l1_weight * ‖w‖₁.
 
     ``value_and_grad_fn`` covers only the smooth part (loss + optional L2).
+    ``rel_function_tolerance``: live function-decrease stop for warm-started
+    vmapped lanes (None = use ``tolerance``; optim/common.check_convergence).
     """
     dtype = w0.dtype
     d = w0.shape[0]
@@ -192,6 +195,7 @@ def minimize_owlqn(
                 grad_norm=gnorm,
                 initial_grad_norm=state.g0_norm,
                 tolerance=tolerance,
+                rel_function_tolerance=rel_function_tolerance,
             ),
             jnp.int32(ConvergenceReason.LINE_SEARCH_FAILED),
         )

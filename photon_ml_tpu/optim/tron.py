@@ -101,6 +101,7 @@ def minimize_tron(
     *,
     max_iter: int = 15,
     tolerance: float = 1e-5,
+    rel_function_tolerance: float | None = None,
     max_cg_iter: int = 20,
     cg_forcing: float = 0.1,
 ) -> SolverResult:
@@ -108,6 +109,11 @@ def minimize_tron(
 
     ``hessian_vector_fn(w, v)`` returns H(w) @ v. Convergence when
     ‖g‖ <= tolerance * ‖g0‖ (LIBLINEAR's test, TRON.scala:208).
+
+    ``rel_function_tolerance`` (None = reference behavior, no function
+    test): live relative function-decrease stop on accepted rounds — the
+    same warm-start exit the LBFGS/OWLQN/NEWTON family gained
+    (optim/common.check_convergence semantics).
     """
     dtype = w0.dtype
     w0 = jnp.asarray(w0, dtype)
@@ -196,6 +202,19 @@ def minimize_tron(
             jnp.int32(ConvergenceReason.FUNCTION_VALUES_WITHIN_TOLERANCE),
             reason,
         )
+        if rel_function_tolerance is not None:
+            # live stop: an ACCEPTED round whose relative decrease is below
+            # threshold (same test as optim/common.check_convergence)
+            rel_delta = jnp.abs(f_acc - state.f) / jnp.maximum(
+                jnp.maximum(jnp.abs(f_acc), jnp.abs(state.f)), 1.0
+            )
+            reason = jnp.where(
+                accept
+                & (rel_delta <= rel_function_tolerance)
+                & (reason == ConvergenceReason.NOT_CONVERGED),
+                jnp.int32(ConvergenceReason.FUNCTION_VALUES_WITHIN_TOLERANCE),
+                reason,
+            )
 
         it = state.iteration + 1
         return _TRONState(

@@ -28,6 +28,7 @@ axis "model" carries the feature axis of giant fixed-effect coordinates
 from __future__ import annotations
 
 import dataclasses
+import logging
 from functools import partial
 from typing import Mapping, Sequence
 
@@ -57,6 +58,8 @@ from photon_ml_tpu.optim.optimizer import OptimizerConfig, solve
 from photon_ml_tpu.types import TaskType
 
 Array = jax.Array
+
+logger = logging.getLogger(__name__)
 
 
 @flax.struct.dataclass
@@ -765,6 +768,117 @@ class GameTrainProgram:
         """One full CD sweep. Returns (new_state, training_loss)."""
         return self._step(data, buckets, state)
 
+    def _weighted_loss(self, labels, weights, total_margin):
+        losses = self._loss.loss(total_margin, labels)
+        wsum = jnp.maximum(jnp.sum(weights), 1.0)
+        return jnp.sum(weights * losses) / wsum
+
+    def _sum_scores(self, base, scores, skip=None):
+        """base + every coordinate score except ``skip`` — the residual-
+        offset sum of the CD recursion, as its own jittable piece for the
+        scheduled sweep."""
+        total = base
+        for k, v in scores.items():
+            if k != skip:
+                total = total + v
+        return total
+
+    def _scheduled_jits(self):
+        """Per-coordinate jitted pieces of the sweep, for step_scheduled:
+        the scheduler needs host control between the probe and rescue
+        solves, so the one-jit sweep is traded for a handful of cached
+        per-coordinate programs (compiled once, reused every sweep)."""
+        jits = getattr(self, "_sched_jits", None)
+        if jits is None:
+            jits = {
+                "scores": jax.jit(self._coordinate_scores),
+                "fe_solve": jax.jit(self._solve_primary_fe),
+                "fe_margin": jax.jit(self._fe_margin_score),
+                "extra_fe_solve": jax.jit(
+                    self._solve_extra_fe, static_argnums=(1,)
+                ),
+                "extra_fe_margin": jax.jit(
+                    self._extra_fe_margin, static_argnums=(1,)
+                ),
+                "re_solve": jax.jit(self._solve_re, static_argnums=(2,)),
+                "re_score": jax.jit(
+                    self._re_coordinate_score, static_argnums=(1, 3)
+                ),
+                "mf_solve": jax.jit(self._solve_mf, static_argnums=(2,)),
+                "offsets": jax.jit(self._sum_scores, static_argnums=(2,)),
+                "loss": jax.jit(self._weighted_loss),
+            }
+            self._sched_jits = jits
+        return jits
+
+    def step_scheduled(self, data, buckets, state: GameTrainState, *,
+                       schedulers: Mapping[str, object],
+                       final_sweep: bool = True):
+        """One full CD sweep with probe/rescue lane scheduling on the
+        random-effect coordinates (algorithm/lane_scheduler.py).
+
+        Same Gauss-Seidel recursion as :meth:`step` in the same
+        ``update_order``, but host-driven: each coordinate runs as its own
+        cached jitted program so the scheduler can read per-lane converged
+        flags between the probe and rescue solves and compact only the
+        unconverged lanes. Strictly opt-in — ``train_distributed`` uses it
+        only when an RE spec's OptimizerConfig carries a scheduler config;
+        single-process only (host compaction reads bucket shards).
+
+        schedulers: re_type -> LaneScheduler, persisted across sweeps by
+        the caller (bucket host caches + cross-sweep active sets live
+        there). REs absent from the mapping solve unscheduled.
+        """
+        jits = self._scheduled_jits()
+        scores = dict(jits["scores"](data, state))
+        labels, weights = data["labels"], data["weights"]
+        base = data["offsets"]
+        fe_w = state.fe_coefficients
+        extra_fe = dict(state.extra_fe)
+        tables = dict(state.re_tables)
+        mf_rows = dict(state.mf_rows)
+        mf_cols = dict(state.mf_cols)
+        for name in self.update_order:
+            kind = self._kind[name]
+            off = jits["offsets"](base, scores, name)
+            if kind == "fe":
+                fe_w = jits["fe_solve"](data, off, weights, fe_w)
+                scores[name] = jits["fe_margin"](data, fe_w)
+            elif kind == "extra_fe":
+                extra_fe[name] = jits["extra_fe_solve"](
+                    data, name, off, labels, weights, extra_fe[name]
+                )
+                scores[name] = jits["extra_fe_margin"](data, name, extra_fe[name])
+            elif kind == "re":
+                spec = self._re_by_name[name]
+                scheduler = schedulers.get(name)
+                if scheduler is None:
+                    tables[name] = jits["re_solve"](
+                        data, buckets, name, off, tables[name]
+                    )
+                else:
+                    matrix = buckets.get("__projections__", {}).get(name)
+                    tables[name], _traces, _stats = scheduler.solve(
+                        self._re_solve_objectives[name], spec.optimizer,
+                        buckets[name], off, tables[name],
+                        projector=spec.projector, matrix=matrix,
+                        final_sweep=final_sweep,
+                    )
+                scores[name] = jits["re_score"](
+                    data, name, tables[name], spec.feature_shard_id
+                )
+            else:  # mf
+                mf_rows[name], mf_cols[name], scores[name] = jits["mf_solve"](
+                    data, buckets, name, off, mf_rows[name], mf_cols[name]
+                )
+        total = jits["offsets"](base, scores, None)
+        loss = jits["loss"](labels, weights, total)
+        new_state = GameTrainState(
+            fe_coefficients=fe_w, re_tables=tables,
+            mf_rows=mf_rows, mf_cols=mf_cols, extra_fe=extra_fe,
+        )
+        return new_state, loss
+
     # -- whole-model scoring (validation / best-model tracking) --------------
 
     def prepare_scoring_inputs(
@@ -898,11 +1012,7 @@ class GameTrainProgram:
         scores = self._coordinate_scores(data, state)
 
         def offsets_excluding(skip=None):
-            total = base_offsets
-            for k, v in scores.items():
-                if k != skip:
-                    total = total + v
-            return total
+            return self._sum_scores(base_offsets, scores, skip)
 
         fe_w = state.fe_coefficients
         extra_fe = dict(state.extra_fe)
@@ -938,9 +1048,7 @@ class GameTrainProgram:
                 )
 
         total_margin = offsets_excluding()
-        losses = self._loss.loss(total_margin, labels)
-        wsum = jnp.maximum(jnp.sum(weights), 1.0)
-        train_loss = jnp.sum(weights * losses) / wsum
+        train_loss = self._weighted_loss(labels, weights, total_margin)
         new_state = GameTrainState(
             fe_coefficients=fe_w, re_tables=tables,
             mf_rows=mf_rows, mf_cols=mf_cols, extra_fe=extra_fe,
@@ -1150,11 +1258,7 @@ def compute_state_variances(
     scores = program._coordinate_scores(data, state)
 
     def offsets_excluding(skip=None):
-        total = base_offsets
-        for k, v in scores.items():
-            if k != skip:
-                total = total + v
-        return total
+        return program._sum_scores(base_offsets, scores, skip)
 
     # fixed effects: Hessian at the final coefficients with every other
     # coordinate's score as residual offset
@@ -1786,6 +1890,30 @@ def train_distributed(
     if state is None:
         state = program.init_state(dataset, re_datasets, mf_datasets)
 
+    # probe/rescue lane scheduling (algorithm/lane_scheduler.py): opt-in per
+    # RE spec via OptimizerConfig.scheduler. Host compaction reads bucket
+    # shards, so a multi-process run (not addressable) falls back to the
+    # fused one-jit step with a warning rather than crashing mid-sweep.
+    schedulers = None
+    scheduled_specs = [
+        s for s in program.re_specs if s.optimizer.scheduler is not None
+    ]
+    if scheduled_specs:
+        if jax.process_count() > 1:
+            logger.warning(
+                "lane scheduler configured on %s but this is a multi-process "
+                "run — host compaction needs addressable bucket shards; "
+                "falling back to the unscheduled fused step",
+                [s.re_type for s in scheduled_specs],
+            )
+        else:
+            from photon_ml_tpu.algorithm.lane_scheduler import LaneScheduler
+
+            schedulers = {
+                s.re_type: LaneScheduler(s.optimizer.scheduler)
+                for s in scheduled_specs
+            }
+
     # per-sweep FE down-sampling multipliers (stable-id splitmix64, identical
     # to the CD path's FixedEffectCoordinate seed rotation); keyed per FE
     # coordinate ("" = primary)
@@ -1912,7 +2040,13 @@ def train_distributed(
                 data["fe_weight_multiplier"] = mult
             else:
                 data.setdefault("extra_fe_weight_multipliers", {})[key] = mult
-        state, loss = program.step(data, buckets, state)
+        if schedulers is not None:
+            state, loss = program.step_scheduled(
+                data, buckets, state, schedulers=schedulers,
+                final_sweep=(sweep + 1 == num_iterations),
+            )
+        else:
+            state, loss = program.step(data, buckets, state)
         losses.append(float(loss))
         if check_finite and not np.isfinite(losses[-1]):
             # raise BEFORE the checkpoint save below would overwrite the

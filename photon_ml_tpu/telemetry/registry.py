@@ -73,6 +73,20 @@ class Histogram:
             self._max = max(self._max, v)
             self._values.append(v)
 
+    def observe_many(self, values) -> None:
+        """Bulk observation under ONE lock acquisition — per-lane feeders
+        (thousands of iteration counts per sweep) must not pay a lock
+        round-trip per value."""
+        vs = [float(v) for v in values]
+        if not vs:
+            return
+        with self._lock:
+            self._count += len(vs)
+            self._total += sum(vs)
+            self._min = min(self._min, min(vs))
+            self._max = max(self._max, max(vs))
+            self._values.extend(vs)
+
     @property
     def count(self) -> int:
         return self._count

@@ -38,14 +38,24 @@ MAX_LANE_ROWS = 128
 #: registry namespace for solver convergence metrics
 SOLVER_METRIC_PREFIX = "solver/"
 
+#: cross-coordinate per-lane iteration-count histogram: the lane-iteration
+#: DISTRIBUTION the lane scheduler (algorithm/lane_scheduler.py) exists to
+#: exploit — fed here for vmapped traces and by the scheduler itself
+LANE_ITERS_METRIC = "solver/lane_iters"
+
 
 def reset_solver_metrics(registry=None) -> None:
-    """Drop per-run solver/* counters and histograms — drivers call this at
-    run start (next to ``reset_timings``) so a sweep invoking ``run()``
-    repeatedly journals per-run tallies, not cross-run accumulations."""
+    """Drop per-run solver/* AND scheduler/* counters and histograms —
+    drivers call this at run start (next to ``reset_timings``) so a sweep
+    invoking ``run()`` repeatedly journals per-run tallies, not cross-run
+    accumulations."""
     from photon_ml_tpu.telemetry.registry import default_registry
 
-    (registry or default_registry()).remove_prefix(SOLVER_METRIC_PREFIX)
+    reg = registry or default_registry()
+    reg.remove_prefix(SOLVER_METRIC_PREFIX)
+    # literal, not imported: lane_scheduler pulls jax in, and this helper
+    # must stay importable/callable before the backend is chosen
+    reg.remove_prefix("scheduler/")
 
 
 def _reason_name(code) -> str:
@@ -96,6 +106,7 @@ def _as_host_trace(trace: LaneTrace | LaneTraces | SolverResult) -> LaneTrace:
                 [np.asarray(t.gradient_norm) for t in parts]
             ),
             valid=np.concatenate([np.asarray(t.valid) for t in parts]),
+            scheduled=any(t.scheduled for t in parts),
         )
     if isinstance(trace.iterations, np.ndarray):
         return trace
@@ -105,6 +116,7 @@ def _as_host_trace(trace: LaneTrace | LaneTraces | SolverResult) -> LaneTrace:
         value=np.asarray(trace.value),
         gradient_norm=np.asarray(trace.gradient_norm),
         valid=np.asarray(trace.valid),
+        scheduled=trace.scheduled,
     )
 
 
@@ -265,6 +277,15 @@ class SolverTelemetry:
             self.registry.histogram(
                 f"{SOLVER_METRIC_PREFIX}{coordinate_id}/iterations"
             ).observe(summary["iterations_mean"])
+            # per-lane iteration DISTRIBUTION across coordinates — p50/p95
+            # vs max is the headroom the lane scheduler compacts away.
+            # Scheduler-produced traces are skipped: the scheduler already
+            # observed them (counting twice would double count/total)
+            if not trace.scheduled:
+                valid = np.asarray(trace.valid).astype(bool)
+                self.registry.histogram(LANE_ITERS_METRIC).observe_many(
+                    np.asarray(trace.iterations)[valid].tolist()
+                )
             self.registry.counter(f"{SOLVER_METRIC_PREFIX}{coordinate_id}/solves").inc(
                 summary["num_lanes"]
             )

@@ -24,6 +24,7 @@ EXPECTED_METRICS = [
     "fe_hot_loop_hbm_gbps_pallas_shardmap_mesh1",
     "fused_game_sweep_ms",
     "fused_game_sweep_newton_ms",
+    "fused_game_sweep_scheduled_ms",
     "sparse_giant_fe_entry_iters_per_sec",
     "sparse_1e8_fe_tron_ms_per_iter",
 ]

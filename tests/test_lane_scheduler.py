@@ -1,0 +1,467 @@
+"""Converged-lane scheduling tests (algorithm/lane_scheduler.py).
+
+The JAX analogue of the reference's per-entity task scheduling
+(RandomEffectCoordinate.scala:104-153 — independent Spark tasks pay only
+their own iteration counts): probe/rescue compaction must agree with the
+unscheduled vmapped path to solver tolerance, scheduler=off must stay
+bitwise-identical, warm-started lanes must exit under the live
+function-decrease stop, and the scheduled solve must be sharding-invariant
+(1-device == 8-device CPU mesh).
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.algorithm.coordinates import CoordinateOptimizationConfig
+from photon_ml_tpu.data.game_data import (
+    build_game_dataset,
+    build_random_effect_dataset,
+    compact_lane_blocks,
+)
+from photon_ml_tpu.estimators import (
+    FixedEffectCoordinateConfig,
+    GameEstimator,
+    RandomEffectCoordinateConfig,
+    train_glm_grid,
+)
+from photon_ml_tpu.optim.optimizer import (
+    LaneSchedulerConfig,
+    OptimizerConfig,
+    OptimizerType,
+)
+from photon_ml_tpu.parallel.distributed import (
+    FixedEffectStepSpec,
+    GameTrainProgram,
+    RandomEffectStepSpec,
+    train_distributed,
+)
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.telemetry.registry import default_registry
+from photon_ml_tpu.telemetry.solver_trace import reset_solver_metrics
+from photon_ml_tpu.types import TaskType
+
+
+def _toy_game_data(rng, n=256, d_fe=8, d_re=4, n_users=16, n_items=12):
+    users = np.array([f"u{i}" for i in rng.integers(0, n_users, size=n)])
+    items = np.array([f"i{i}" for i in rng.integers(0, n_items, size=n)])
+    x_fe = rng.normal(size=(n, d_fe))
+    x_re = rng.normal(size=(n, d_re))
+    y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    dataset = build_game_dataset(
+        labels=y,
+        feature_shards={"global": x_fe, "per_entity": x_re},
+        entity_keys={"user": users, "item": items},
+        dtype=np.float64,
+    )
+    re_datasets = {
+        t: build_random_effect_dataset(dataset, t, "per_entity",
+                                       bucket_sizes=(64,))
+        for t in ("user", "item")
+    }
+    return dataset, re_datasets
+
+
+def _re_opt(scheduled, *, max_iter=8, ftol=1e-6, probe=2,
+            freeze_tol=0.0, freeze_grad=0.0):
+    return OptimizerConfig(
+        optimizer_type=OptimizerType.LBFGS,
+        max_iterations=max_iter,
+        rel_function_tolerance=ftol if scheduled else None,
+        scheduler=LaneSchedulerConfig(
+            probe_iterations=probe,
+            freeze_coefficient_tolerance=freeze_tol,
+            freeze_gradient_tolerance=freeze_grad,
+        ) if scheduled else None,
+    )
+
+
+def _program(re_opt):
+    opt = OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=8)
+    return GameTrainProgram(
+        TaskType.LOGISTIC_REGRESSION,
+        FixedEffectStepSpec(feature_shard_id="global", optimizer=opt,
+                            l2_weight=0.1),
+        (
+            RandomEffectStepSpec("user", "per_entity", re_opt, l2_weight=1.0),
+            RandomEffectStepSpec("item", "per_entity", re_opt, l2_weight=1.0),
+        ),
+    )
+
+
+def _sched_counters():
+    snap = default_registry().snapshot()
+    return {k: v for k, v in snap["counters"].items()
+            if k.startswith("scheduler/")}
+
+
+# -- fused path --------------------------------------------------------------
+
+
+def test_scheduled_fused_matches_unscheduled_losses(rng):
+    """Acceptance: the CPU-mesh fused sweep with the scheduler on agrees
+    with the unscheduled losses to solver tolerance."""
+    dataset, re_datasets = _toy_game_data(rng)
+    r_off = train_distributed(
+        _program(_re_opt(False)), dataset, re_datasets, num_iterations=2
+    )
+    r_on = train_distributed(
+        _program(_re_opt(True)), dataset, re_datasets, num_iterations=2
+    )
+    np.testing.assert_allclose(r_off.losses, r_on.losses, rtol=1e-4)
+    for k in r_off.state.re_tables:
+        np.testing.assert_allclose(
+            np.asarray(r_off.state.re_tables[k]),
+            np.asarray(r_on.state.re_tables[k]),
+            atol=5e-3,
+        )
+
+
+def test_scheduled_solve_sharding_invariant(rng):
+    """1-device == 8-device for the scheduled RE solve: host compaction
+    reads the same converged flags either way, so sharding only changes
+    the schedule, not the math."""
+    dataset, re_datasets = _toy_game_data(rng)
+    r1 = train_distributed(
+        _program(_re_opt(True)), dataset, re_datasets, num_iterations=2
+    )
+    mesh = make_mesh(data=4, model=2)
+    r8 = train_distributed(
+        _program(_re_opt(True)), dataset, re_datasets, mesh=mesh,
+        num_iterations=2,
+    )
+    np.testing.assert_allclose(r1.losses, r8.losses, rtol=1e-7)
+    for k in r1.state.re_tables:
+        np.testing.assert_allclose(
+            np.asarray(r1.state.re_tables[k]),
+            np.asarray(r8.state.re_tables[k]),
+            rtol=1e-6, atol=1e-8,
+        )
+
+
+def test_warm_start_rescued_lanes_strictly_below_total(rng):
+    """Acceptance: on the warm-start fixture the rescued-lane count is
+    strictly below the total lane count (most lanes converge within the
+    probe budget under the live stop)."""
+    dataset, re_datasets = _toy_game_data(rng)
+    cold = train_distributed(
+        _program(_re_opt(True)), dataset, re_datasets, num_iterations=4
+    )
+    reset_solver_metrics()
+    train_distributed(
+        _program(_re_opt(True)), dataset, re_datasets, num_iterations=1,
+        state=cold.state,
+    )
+    counters = _sched_counters()
+    total_lanes = sum(
+        sum(b.num_entities for b in ds.buckets) for ds in re_datasets.values()
+    )
+    assert counters["scheduler/lanes_probed"] == total_lanes
+    assert counters["scheduler/lanes_rescued"] < total_lanes
+    # the lane-iteration histogram records the distribution the scheduler
+    # exploits: warm-started lanes exit in a couple of iterations
+    hist = default_registry().snapshot()["histograms"]["solver/lane_iters"]
+    assert hist["count"] == total_lanes
+    # warm lanes stop well short of the 8-iteration budget; the fastest
+    # exit within the probe
+    assert hist["p50"] < 8
+    assert hist["min"] <= 2
+
+
+# -- scheduler=off stays bitwise-identical -----------------------------------
+
+
+def test_scheduler_off_bitwise_identical(rng):
+    """The new OptimizerConfig fields at their defaults route through
+    exactly the unscheduled code path: two fits — one with an old-style
+    config, one with the new fields explicitly off — are BITWISE equal."""
+    dataset, re_datasets = _toy_game_data(rng)
+    old_style = OptimizerConfig(
+        optimizer_type=OptimizerType.LBFGS, max_iterations=8
+    )
+    explicit_off = OptimizerConfig(
+        optimizer_type=OptimizerType.LBFGS, max_iterations=8,
+        rel_function_tolerance=None, scheduler=None,
+    )
+    r_a = train_distributed(
+        _program(old_style), dataset, re_datasets, num_iterations=2
+    )
+    r_b = train_distributed(
+        _program(explicit_off), dataset, re_datasets, num_iterations=2
+    )
+    assert r_a.losses == r_b.losses
+    np.testing.assert_array_equal(
+        np.asarray(r_a.state.fe_coefficients),
+        np.asarray(r_b.state.fe_coefficients),
+    )
+    for k in r_a.state.re_tables:
+        np.testing.assert_array_equal(
+            np.asarray(r_a.state.re_tables[k]),
+            np.asarray(r_b.state.re_tables[k]),
+        )
+
+
+def test_solver_off_tolerance_bitwise_identical(rng):
+    """rel_function_tolerance=None is the exact reference behavior at the
+    solver level too (the while_loop convergence test is unchanged)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import LabeledPointBatch
+    from photon_ml_tpu.ops.losses import LogisticLoss
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+
+    x = rng.normal(size=(64, 6))
+    y = (rng.uniform(size=64) < 0.5).astype(np.float64)
+    batch = LabeledPointBatch.create(jnp.asarray(x), jnp.asarray(y))
+    bound = GLMObjective(LogisticLoss(), l2_weight=0.5,
+                         use_pallas=False).bind(batch)
+    w0 = jnp.zeros(6, dtype=jnp.float64)
+    r_a = minimize_lbfgs(bound.value_and_grad, w0, max_iter=20)
+    r_b = minimize_lbfgs(bound.value_and_grad, w0, max_iter=20,
+                         rel_function_tolerance=None)
+    assert int(r_a.iterations) == int(r_b.iterations)
+    np.testing.assert_array_equal(
+        np.asarray(r_a.coefficients), np.asarray(r_b.coefficients)
+    )
+
+
+# -- warm-start live stop ----------------------------------------------------
+
+
+def test_warm_started_lane_exits_within_two_iterations(rng):
+    """Regression pin: a converged warm start exits within 2 iterations
+    under the live function-decrease stop instead of paying max_iter."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import LabeledPointBatch
+    from photon_ml_tpu.ops.losses import LogisticLoss
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+
+    x = rng.normal(size=(128, 6))
+    y = (rng.uniform(size=128) < 0.5).astype(np.float64)
+    batch = LabeledPointBatch.create(jnp.asarray(x), jnp.asarray(y))
+    bound = GLMObjective(LogisticLoss(), l2_weight=0.5,
+                         use_pallas=False).bind(batch)
+    w0 = jnp.zeros(6, dtype=jnp.float64)
+    converged = minimize_lbfgs(bound.value_and_grad, w0, max_iter=100)
+    warm = minimize_lbfgs(
+        bound.value_and_grad, converged.coefficients, max_iter=100,
+        rel_function_tolerance=1e-6,
+    )
+    assert int(warm.iterations) <= 2, int(warm.iterations)
+
+
+def test_tron_warm_start_live_stop(rng):
+    """TRON carries the same knob: None is bitwise reference behavior, and
+    a converged warm start exits immediately under the live stop."""
+    from photon_ml_tpu.data.batch import LabeledPointBatch
+    from photon_ml_tpu.ops.losses import LogisticLoss
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.optim.tron import minimize_tron
+
+    x = rng.normal(size=(128, 6))
+    y = (rng.uniform(size=128) < 0.5).astype(np.float64)
+    bound = GLMObjective(LogisticLoss(), l2_weight=0.5,
+                         use_pallas=False).bind(LabeledPointBatch.create(x, y))
+    w0 = np.zeros(6)
+    r0 = minimize_tron(bound.value_and_grad, bound.hessian_vector, w0,
+                       max_iter=50)
+    r_none = minimize_tron(bound.value_and_grad, bound.hessian_vector, w0,
+                           max_iter=50, rel_function_tolerance=None)
+    assert int(r0.iterations) == int(r_none.iterations)
+    np.testing.assert_array_equal(
+        np.asarray(r0.coefficients), np.asarray(r_none.coefficients)
+    )
+    warm = minimize_tron(
+        bound.value_and_grad, bound.hessian_vector, r0.coefficients,
+        max_iter=50, rel_function_tolerance=1e-6,
+    )
+    assert int(warm.iterations) <= 2
+
+
+def test_grid_lanes_stop_early_under_function_tolerance(rng):
+    """The λ-grid satellite: the live stop reaches the vmapped grid lanes
+    (same every-lane-pays-max_iter pathology as the RE buckets)."""
+    from photon_ml_tpu.data.batch import LabeledPointBatch
+    from photon_ml_tpu.telemetry.registry import MetricsRegistry
+    from photon_ml_tpu.telemetry.solver_trace import SolverTelemetry
+
+    x = rng.normal(size=(128, 6))
+    y = (rng.uniform(size=128) < 0.5).astype(np.float64)
+    batch = LabeledPointBatch.create(x, y)
+    lams = (0.1, 1.0, 10.0)
+
+    def mean_iters(opt):
+        reg = MetricsRegistry()
+        models = train_glm_grid(
+            batch, TaskType.LOGISTIC_REGRESSION, optimizer=opt,
+            regularization_weights=lams,
+            telemetry=SolverTelemetry(registry=reg),
+        )
+        hist = reg.snapshot()["histograms"]["solver/lane_iters"]
+        return models, hist["mean"], hist["count"]
+
+    m_slow, it_slow, n_slow = mean_iters(
+        OptimizerConfig(max_iterations=40, tolerance=0.0)
+    )
+    m_fast, it_fast, n_fast = mean_iters(
+        OptimizerConfig(max_iterations=40, tolerance=0.0,
+                        rel_function_tolerance=1e-5)
+    )
+    assert n_slow == n_fast == len(lams)
+    assert it_fast < it_slow
+    for lam in lams:
+        np.testing.assert_allclose(
+            np.asarray(m_fast[lam].coefficients.means),
+            np.asarray(m_slow[lam].coefficients.means),
+            atol=5e-3,
+        )
+
+
+# -- CD path + cross-sweep active sets ---------------------------------------
+
+
+def _estimator(re_opt, iters=2):
+    return GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs={
+            "fe": FixedEffectCoordinateConfig(
+                "global",
+                CoordinateOptimizationConfig(
+                    OptimizerConfig(max_iterations=8), l2_weight=0.1
+                ),
+            ),
+            "re": RandomEffectCoordinateConfig(
+                "user", "per_entity",
+                CoordinateOptimizationConfig(re_opt, l2_weight=1.0),
+            ),
+        },
+        num_iterations=iters,
+    )
+
+
+def test_cd_path_scheduled_matches_unscheduled(rng):
+    dataset, _ = _toy_game_data(rng)
+    r_off = _estimator(_re_opt(False)).fit(dataset)
+    r_on = _estimator(_re_opt(True)).fit(dataset)
+    np.testing.assert_allclose(
+        np.asarray(r_on.model.models["re"].coefficients),
+        np.asarray(r_off.model.models["re"].coefficients),
+        atol=5e-3,
+    )
+
+
+@pytest.mark.parametrize("projector", ["INDEX_MAP", "RANDOM"])
+def test_cd_path_scheduled_projected_matches_unscheduled(rng, projector):
+    """The scheduler's compaction also covers the projected solve shapes:
+    INDEX_MAP (scratch-column table, per-lane col_index) and RANDOM
+    (sketched solve space, back-projected scatter)."""
+    from photon_ml_tpu.projector.projectors import ProjectorType
+
+    dataset, _ = _toy_game_data(rng)
+    ptype = ProjectorType[projector]
+
+    def fit(scheduled):
+        est = _estimator(_re_opt(scheduled))
+        cfg = est.coordinate_configs["re"]
+        est.coordinate_configs = {
+            "fe": est.coordinate_configs["fe"],
+            "re": RandomEffectCoordinateConfig(
+                cfg.random_effect_type, cfg.feature_shard_id,
+                cfg.optimization,
+                projector_type=ptype,
+                projected_dim=3 if ptype == ProjectorType.RANDOM else None,
+            ),
+        }
+        return est.fit(dataset)
+
+    r_off, r_on = fit(False), fit(True)
+    np.testing.assert_allclose(
+        np.asarray(r_on.model.models["re"].coefficients),
+        np.asarray(r_off.model.models["re"].coefficients),
+        atol=5e-3,
+    )
+
+
+def test_cd_active_sets_freeze_and_final_sweep_runs_everyone(rng):
+    """Cross-sweep active sets: with loose freeze thresholds some entities
+    are skipped mid-run (counter > 0), the final sweep runs everyone, and
+    the result stays at solver tolerance of the unscheduled fit."""
+    dataset, _ = _toy_game_data(rng)
+    r_off = _estimator(_re_opt(False), iters=4).fit(dataset)
+    reset_solver_metrics()
+    r_frozen = _estimator(
+        _re_opt(True, freeze_tol=1e-2, freeze_grad=1.0), iters=4
+    ).fit(dataset)
+    counters = _sched_counters()
+    assert counters["scheduler/lanes_frozen_skipped"] > 0
+    np.testing.assert_allclose(
+        np.asarray(r_frozen.model.models["re"].coefficients),
+        np.asarray(r_off.model.models["re"].coefficients),
+        atol=2e-2,
+    )
+
+
+# -- building blocks ---------------------------------------------------------
+
+
+def test_compact_lane_blocks_padding_semantics():
+    blocks = [
+        {
+            "features": np.arange(2 * 4 * 3, dtype=np.float64).reshape(2, 4, 3),
+            "labels": np.ones((2, 4)),
+            "weights": np.ones((2, 4)),
+            "sample_rows": np.arange(8, dtype=np.int32).reshape(2, 4),
+            "entity_rows": np.array([5, 9], np.int32),
+        },
+        {
+            "features": np.ones((3, 4, 3)),
+            "labels": np.zeros((3, 4)),
+            "weights": np.ones((3, 4)),
+            "sample_rows": np.full((3, 4), 7, np.int32),
+            "entity_rows": np.array([1, 2, 3], np.int32),
+        },
+    ]
+    fields, src_blk, src_lane = compact_lane_blocks(
+        blocks, [(0, np.array([1])), (1, np.array([0, 2]))],
+        pad_to=8, sentinel_row=999,
+    )
+    assert fields["features"].shape == (8, 4, 3)
+    np.testing.assert_array_equal(fields["entity_rows"][:3], [9, 1, 3])
+    np.testing.assert_array_equal(fields["entity_rows"][3:], [999] * 5)
+    assert (fields["weights"][3:] == 0).all()
+    assert (fields["sample_rows"][3:] == -1).all()
+    np.testing.assert_array_equal(src_blk, [0, 1, 1])
+    np.testing.assert_array_equal(src_lane, [1, 0, 2])
+
+
+def test_cli_scheduler_round_trip():
+    from photon_ml_tpu.cli.configs import (
+        format_coordinate_config,
+        parse_coordinate_config,
+    )
+
+    spec = (
+        "name=per-user,feature.shard=user,random.effect.type=userId,"
+        "rel.function.tolerance=1e-6,scheduler=true,scheduler.probe.iter=3,"
+        "scheduler.freeze.tolerance=0.0001,scheduler.freeze.gradient=0.5"
+    )
+    cfg = parse_coordinate_config(spec)
+    assert cfg.scheduler and cfg.scheduler_probe_iterations == 3
+    assert cfg.rel_function_tolerance == 1e-6
+    assert parse_coordinate_config(format_coordinate_config(cfg)) == cfg
+    opt = cfg.optimization_config(1.0).optimizer
+    assert opt.rel_function_tolerance == 1e-6
+    assert opt.scheduler == LaneSchedulerConfig(
+        probe_iterations=3,
+        freeze_coefficient_tolerance=1e-4,
+        freeze_gradient_tolerance=0.5,
+    )
+
+
+def test_cli_scheduler_rejected_on_fixed_effect():
+    from photon_ml_tpu.cli.configs import parse_coordinate_config
+
+    with pytest.raises(ValueError, match="random-effect"):
+        parse_coordinate_config("name=fe,feature.shard=global,scheduler=true")

@@ -117,6 +117,52 @@ def test_lint_catches_cli_full_reads_and_score_allgathers(tmp_path):
     assert not any("distributed.py" in p for p in problems)  # allowlisted
 
 
+def test_lint_catches_pallas_in_vmapped_solve_modules(tmp_path):
+    """Check 6 fires: use_pallas=True literals, pallas_call references, and
+    pallas imports inside optim/ or algorithm/ (the vmapped solve modules)
+    are reported; the same code outside those modules stays clean."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    opt = tmp_path / "photon_ml_tpu" / "optim"
+    opt.mkdir(parents=True)
+    (opt / "bad_solver.py").write_text(
+        '"""No reference analogue."""\n'
+        "from jax.experimental import pallas as pl\n"
+        "def f(obj, batch):\n"
+        "    return obj.bind(batch, use_pallas=True)\n"
+        "def k(fn, x):\n"
+        "    return pl.pallas_call(fn)(x)\n"
+    )
+    alg = tmp_path / "photon_ml_tpu" / "algorithm"
+    alg.mkdir(parents=True)
+    (alg / "clean_solver.py").write_text(
+        '"""No reference analogue."""\n'
+        "def f(obj, batch):\n"
+        "    # the forced-off convention (ops/objective.py) passes\n"
+        "    return obj.bind(batch, use_pallas=False)\n"
+    )
+    ops = tmp_path / "photon_ml_tpu" / "ops"
+    ops.mkdir(parents=True)
+    (ops / "kernel_home.py").write_text(
+        '"""No reference analogue."""\n'
+        "from jax.experimental import pallas as pl\n"
+        "def k(fn, x):\n"
+        "    return pl.pallas_call(fn)(x)  # un-vmapped module: allowed\n"
+        "def force(obj, batch):\n"
+        "    return obj.bind(batch, use_pallas=True)\n"
+    )
+    problems = lint_parity.run_lints(tmp_path)
+    assert any("bad_solver.py:2" in p and "pallas import" in p for p in problems)
+    assert any("bad_solver.py:4" in p and "use_pallas=True" in p for p in problems)
+    assert any("bad_solver.py:6" in p and "pallas_call" in p for p in problems)
+    assert not any("clean_solver.py" in p for p in problems)
+    assert not any("kernel_home.py" in p for p in problems)
+
+
 def test_lint_catches_broad_excepts(tmp_path):
     """The broad-except check fires on swallowing handlers, and exempts
     re-raising handlers and the resilience classifier's allowlist."""
