@@ -78,7 +78,7 @@ def _row(metric: str, value: float, spread, unit: str) -> dict:
 
 def _unit_primary(lane_iters: int, grid_sec: float) -> str:
     return (
-        f"ex*it/s, {GRID}-lambda grid n=2^18 d={D}, "
+        f"ex*it/s, {GRID}-lam grid n=2^18 d={D}, "
         f"{lane_iters} lane-it, {grid_sec:.2f}s/grid 3v1, "
         f"med{GATE_REPS}, vs scipy it-norm"
     )
@@ -100,10 +100,10 @@ def _unit_hot_loop(note: str, ms_per_eval: float, frac: float) -> str:
 def _unit_sweep(newton: bool) -> str:
     if newton:
         return (
-            "ms/sweep, REs batched Newton, FE same"
+            "ms/sweep, REs Newton, FE same"
         )
     return (
-        "ms/sweep: FE d=256 + 2 REs (2000/1500, d=16) + rescore, "
+        "ms/sweep: FE d256 + 2 REs 2000/1500 d16 + rescore, "
         "n=2^17, 10 LBFGS it"
     )
 
@@ -116,14 +116,23 @@ def _unit_sweep_scheduled() -> str:
 
 def _unit_sparse_1e7(nnz: int, ms_per_iter: float) -> str:
     return (
-        f"nnz*it/s, d=1e7 ELL, n=2^19 nnz={nnz}, "
+        f"nnz*it/s, d=1e7 ELL, nnz={nnz}, "
         f"{ms_per_iter:.1f}ms/it"
+    )
+
+
+def _unit_sparse_hybrid(nnz: int, ell_ms: float, cov: float, k_hot: int) -> str:
+    # compare against the embedded same-run ELL ms/it only (the calibration
+    # discipline): same Zipfian data, same process, fractional comparison
+    return (
+        f"ms/it d=1e7 zipf nnz={nnz} hot{k_hot} "
+        f"cov{cov:.2f}, ELL same-run {ell_ms:.1f}"
     )
 
 
 def _unit_sparse_1e8(nnz: int, entry_iters_m: float) -> str:
     return (
-        f"ms/TRON-it (2CG), d=1e8 ELL, n=2^18 nnz={nnz}, "
+        f"ms/TRON-it 2CG, d=1e8 hybrid zipf hot512 nnz={nnz}, "
         f"{entry_iters_m:.1f}M ent-it/s"
     )
 
@@ -131,8 +140,8 @@ def _unit_sparse_1e8(nnz: int, entry_iters_m: float) -> str:
 #: hot-loop row labels -> telegraphic GB/s notes (prose: BASELINE.md r4)
 HOT_LOOP_NOTES = {
     "autodiff_xla": "2 X passes",
-    "pallas_kernel": "1 f32 pass (default)",
-    "pallas_bf16": "bf16 pass, f32 accum",
+    "pallas_kernel": "1 f32 pass dflt",
+    "pallas_bf16": "bf16 pass f32 acc",
     "pallas_shardmap_mesh1": "shard_map mesh1",
 }
 
@@ -140,25 +149,39 @@ HOT_LOOP_NOTES = {
 def sample_report() -> dict:
     """The report with worst-case-width representative values, through the
     SAME row/unit builders main() uses — what tests/test_bench_line.py
-    measures against MAX_LINE_BYTES without touching a TPU."""
-    big, sp = 99999999999.9, [99999999999.9, 99999999999.9]
-    extra = [_row("fe_hot_loop_stream_gbps", big, sp, _unit_stream(1 << 17, D))]
+    measures against MAX_LINE_BYTES without touching a TPU.
+
+    Widths are per metric CLASS, each a decade-plus above anything a sane
+    run can produce (r1-r5 actuals: rates ~1e8, GB/s ~750, sweeps ~50 ms;
+    main() still hard-raises if a pathological line exceeds the budget):
+    rate rows 1e10, bandwidth rows 1e4 GB/s (12x the roofline), ms rows
+    1e7 ms (2.8 h per iteration/sweep)."""
+    rate, rate_sp = 9999999999.9, [9999999999.9, 9999999999.9]
+    gbps, gbps_sp = 9999.9, [9999.9, 9999.9]
+    ms, ms_sp = 9999999.9, [9999999.9, 9999999.9]
+    extra = [
+        _row("fe_hot_loop_stream_gbps", gbps, gbps_sp,
+             _unit_stream(1 << 17, D))
+    ]
     extra += [
-        _row(f"fe_hot_loop_hbm_gbps_{label}", big, sp,
+        _row(f"fe_hot_loop_hbm_gbps_{label}", gbps, gbps_sp,
              _unit_hot_loop(note, 999.999, 99.99))
         for label, note in HOT_LOOP_NOTES.items()
     ]
     extra += [
-        _row("fused_game_sweep_ms", big, sp, _unit_sweep(newton=False)),
-        _row("fused_game_sweep_newton_ms", big, sp, _unit_sweep(newton=True)),
-        _row("fused_game_sweep_scheduled_ms", big, sp, _unit_sweep_scheduled()),
-        _row("sparse_giant_fe_entry_iters_per_sec", big, sp,
+        _row("fused_game_sweep_ms", ms, ms_sp, _unit_sweep(newton=False)),
+        _row("fused_game_sweep_newton_ms", ms, ms_sp, _unit_sweep(newton=True)),
+        _row("fused_game_sweep_scheduled_ms", ms, ms_sp,
+             _unit_sweep_scheduled()),
+        _row("sparse_giant_fe_entry_iters_per_sec", rate, rate_sp,
              _unit_sparse_1e7(25165824, 9999.9)),
-        _row("sparse_1e8_fe_tron_ms_per_iter", big, sp,
+        _row("sparse_giant_fe_hybrid", ms, ms_sp,
+             _unit_sparse_hybrid(16777216, 99999.9, 9.99, 256)),
+        _row("sparse_1e8_fe_tron_ms_per_iter", ms, ms_sp,
              _unit_sparse_1e8(4194304, 99999.9)),
     ]
     report = _row(
-        "glm_lambda_grid_example_iters_per_sec", big, sp,
+        "glm_lambda_grid_example_iters_per_sec", rate, rate_sp,
         _unit_primary(99999, 999.999),
     )
     report["vs_baseline"] = 99999.99
@@ -511,18 +534,68 @@ def bench_game_sweep() -> list[dict]:
     ]
 
 
+def _lbfgs_iter_marginal(obj, batch, d: int, k_lo: int = 4, k_hi: int = 16):
+    """Median-of-GATE_REPS marginal seconds per extra L-BFGS iteration over
+    one sparse batch (fresh-PRNG warm starts, k_hi-vs-k_lo differencing —
+    the sparse-row discipline since r3). The batch rides as a jit ARGUMENT:
+    closing over it would embed the entry arrays as constants in the
+    remote-compile request (HTTP 413 over the tunnel — the real cause of
+    r2's "compile service drops")."""
+    import jax
+    import jax.numpy as jnp
+
+    from functools import partial
+
+    from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+
+    @partial(jax.jit, static_argnums=(2,))
+    def run(w0, b, iters):
+        r = minimize_lbfgs(obj.bind(b).value_and_grad, w0, max_iter=iters,
+                           tolerance=0.0)
+        return r.value + r.coefficients[0]
+
+    def timed(iters, seed):
+        key = jax.random.PRNGKey(seed)
+        w0 = 1e-3 * jax.random.normal(key, (d,), jnp.float32)
+        float(run(w0, batch, iters))  # compile + sync
+        best = None
+        for s in range(2):
+            w0 = 1e-3 * jax.random.normal(jax.random.PRNGKey(seed + s + 1), (d,))
+            t0 = time.perf_counter()
+            float(run(w0.astype(jnp.float32), batch, iters))
+            el = time.perf_counter() - t0
+            best = el if best is None or el < best else best
+        return best
+
+    seed = [0]
+
+    def once():
+        s0 = seed[0]
+        seed[0] += 1000
+        return max(
+            (timed(k_hi, s0) - timed(k_lo, s0 + 100)) / (k_hi - k_lo), 1e-6
+        )
+
+    return median_spread(once)
+
+
+def _zipf_cols(rng, size: int, d: int, gamma: float = 24.0) -> np.ndarray:
+    """Bounded power-law column ids (top-k nnz share (k/d)^(1/gamma)),
+    scattered over [0, d) by an odd multiplicative bijection so the hot set
+    is NOT contiguous — Photon's name-term bags are power-law distributed;
+    this is the regime the hybrid layout exists for."""
+    raw = (rng.random(size) ** gamma * d).astype(np.int64)
+    return (raw * 2654435761) % d  # odd, not divisible by 5: bijective mod 10^k
+
+
 def bench_sparse_fe() -> dict:
     """Giant-d sparse fixed effect on hardware: d=10⁷ logistic L-BFGS over
     flat-COO data (dense [n, d] would be n·d·4 ≈ 21 TB — the path the
     reference's 'hundreds of billions of coefficients' claim needs).
     Reported as entry-iterations/sec, marginal over extra iterations."""
-    import jax
-    import jax.numpy as jnp
-
     from photon_ml_tpu.data.sparse_batch import SparseLabeledPointBatch
     from photon_ml_tpu.ops.losses import LogisticLoss
     from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
-    from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
 
     rng = np.random.default_rng(3)
     n, d, per_row = 1 << 19, 10_000_000, 32
@@ -547,42 +620,7 @@ def bench_sparse_fe() -> dict:
     batch = SparseLabeledPointBatch.from_coo(rows, cols, vals, y, dim=d,
                                              dtype=np.float32)
     obj = SparseGLMObjective(LogisticLoss(), l2_weight=0.1)
-
-    from functools import partial
-
-    # batch rides as a jit ARGUMENT: closing over it would embed the COO
-    # arrays as constants in the remote-compile request (HTTP 413 over the
-    # tunnel — the real cause of r2's "compile service drops")
-    @partial(jax.jit, static_argnums=(2,))
-    def run(w0, b, iters):
-        r = minimize_lbfgs(obj.bind(b).value_and_grad, w0, max_iter=iters,
-                           tolerance=0.0)
-        return r.value + r.coefficients[0]
-
-    def timed(iters, seed):
-        key = jax.random.PRNGKey(seed)
-        w0 = 1e-3 * jax.random.normal(key, (d,), jnp.float32)
-        float(run(w0, batch, iters))  # compile + sync
-        best = None
-        for s in range(2):
-            w0 = 1e-3 * jax.random.normal(jax.random.PRNGKey(seed + s + 1), (d,))
-            t0 = time.perf_counter()
-            float(run(w0.astype(jnp.float32), batch, iters))
-            el = time.perf_counter() - t0
-            best = el if best is None or el < best else best
-        return best
-
-    k_lo, k_hi = 4, 16
-    seed = [0]
-
-    def once():
-        s0 = seed[0]
-        seed[0] += 1000
-        return max(
-            (timed(k_hi, s0) - timed(k_lo, s0 + 100)) / (k_hi - k_lo), 1e-6
-        )
-
-    marginal, sp = median_spread(once)
+    marginal, sp = _lbfgs_iter_marginal(obj, batch, d)
     return _row(
         "sparse_giant_fe_entry_iters_per_sec",
         round(nnz / marginal, 1),
@@ -591,16 +629,67 @@ def bench_sparse_fe() -> dict:
     )
 
 
+def bench_sparse_fe_hybrid() -> dict:
+    """Same-run hybrid-vs-ELL comparison on Zipfian-column synthetic data
+    (ISSUE 5): ONE dataset, two layouts of it, both L-BFGS-iteration
+    marginals measured in THIS process back to back — the fractional
+    comparison the calibration discipline requires (chip-lottery pool;
+    never compare absolute ms across runs).
+
+    The hybrid view trains the 256 nnz-hottest columns (~0.6 of nonzeros
+    at gamma=24) as one dense [n, 256] MXU block — ZERO per-entry index
+    ops for covered entries — while the ELL tail shrinks to the cold
+    residual; the expected win is index-op removal proportional to hot
+    coverage (BASELINE.md r6 methodology)."""
+    from photon_ml_tpu.data.sparse_batch import (
+        HybridPolicy,
+        SparseLabeledPointBatch,
+    )
+    from photon_ml_tpu.ops.losses import LogisticLoss
+    from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
+    from photon_ml_tpu.telemetry import default_registry
+
+    rng = np.random.default_rng(11)
+    n, d, per_row, k_hot = 1 << 19, 10_000_000, 32, 256
+    rows = np.repeat(np.arange(n), per_row)
+    cols = _zipf_cols(rng, n * per_row, d)
+    vals = rng.normal(size=n * per_row).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    nnz = len(vals)
+    common = dict(dim=d, dtype=np.float32)
+    ell_batch = SparseLabeledPointBatch.from_coo(rows, cols, vals, y, **common)
+    hyb_batch = SparseLabeledPointBatch.from_coo(
+        rows, cols, vals, y,
+        hybrid=HybridPolicy(hot_cols=k_hot, label="bench_1e7"), **common,
+    )
+    cov = default_registry().gauge("layout/bench_1e7/hot_coverage").value or 0.0
+    obj = SparseGLMObjective(LogisticLoss(), l2_weight=0.1)
+    ell_marginal, _ = _lbfgs_iter_marginal(obj, ell_batch, d)
+    hyb_marginal, hyb_sp = _lbfgs_iter_marginal(obj, hyb_batch, d)
+    return _row(
+        "sparse_giant_fe_hybrid",
+        round(hyb_marginal * 1e3, 1),
+        [round(s * 1e3, 1) for s in hyb_sp],
+        _unit_sparse_hybrid(nnz, ell_marginal * 1e3, cov, k_hot),
+    )
+
+
 def bench_sparse_fe_1e8() -> dict:
     """d=10⁸ sparse FE via TRON (VERDICT r2 #5: a step toward the
     reference's 'hundreds of billions of coefficients', README.md:77).
     TRON holds O(1) work vectors of size d where LBFGS history is 2·m·d —
-    the survey's hard-parts recipe (SURVEY.md §7); the Hessian-vector ladder
-    reuses the ELL forward + transpose-scatter."""
+    the survey's hard-parts recipe (SURVEY.md §7). Since r6 the columns are
+    Zipfian (the realistic name-term regime) and the batch rides the hybrid
+    layout, so TRON's CG inner loop takes the split hessian_vector: the hot
+    head's forward AND transpose are dense matmuls, only the cold tail pays
+    per-entry index ops (ISSUE 5 — what moves this row)."""
     import jax
     import jax.numpy as jnp
 
-    from photon_ml_tpu.data.sparse_batch import SparseLabeledPointBatch
+    from photon_ml_tpu.data.sparse_batch import (
+        HybridPolicy,
+        SparseLabeledPointBatch,
+    )
     from photon_ml_tpu.ops.losses import LogisticLoss
     from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
     from photon_ml_tpu.optim.tron import minimize_tron
@@ -610,12 +699,14 @@ def bench_sparse_fe_1e8() -> dict:
     rng = np.random.default_rng(5)
     n, d, per_row = 1 << 18, 100_000_000, 16
     rows = np.repeat(np.arange(n), per_row)
-    cols = rng.integers(0, d, size=n * per_row)
+    cols = _zipf_cols(rng, n * per_row, d)
     vals = rng.normal(size=n * per_row).astype(np.float32)
     y = (rng.uniform(size=n) < 0.5).astype(np.float32)
     nnz = len(vals)
-    batch = SparseLabeledPointBatch.from_coo(rows, cols, vals, y, dim=d,
-                                             dtype=np.float32)
+    batch = SparseLabeledPointBatch.from_coo(
+        rows, cols, vals, y, dim=d, dtype=np.float32,
+        hybrid=HybridPolicy(hot_cols=512, label="bench_1e8"),
+    )
     obj = SparseGLMObjective(LogisticLoss(), l2_weight=0.1)
 
     @partial(jax.jit, static_argnums=(2,))
@@ -692,6 +783,7 @@ def main():
     extra = bench_hot_loop_bandwidth(x[: 1 << 17], y[: 1 << 17])
     extra.extend(bench_game_sweep())
     extra.append(bench_sparse_fe())
+    extra.append(bench_sparse_fe_hybrid())
     extra.append(bench_sparse_fe_1e8())
     cpu_rate = bench_cpu_scipy(x[:CPU_SUBSAMPLE], y[:CPU_SUBSAMPLE])
 

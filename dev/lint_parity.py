@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static parity-convention lints for photon_ml_tpu (CLAUDE.md conventions).
 
-Six checks, all pure-AST (no jax import; runs in milliseconds):
+Seven checks, all pure-AST (no jax import; runs in milliseconds):
 
 1. **Docstring citations** — every ``photon_ml_tpu/**/*.py`` module (except
    ``__init__.py`` re-export shims) must carry a module docstring that
@@ -54,6 +54,14 @@ Six checks, all pure-AST (no jax import; runs in milliseconds):
    solver/coordinate modules (``optim/``, ``algorithm/``, estimators.py)
    therefore must not contain a literal ``use_pallas=True`` call keyword,
    any ``pallas_call`` reference, or an import of a pallas module.
+
+7. **segment_sum without num_segments** — a ``jax.ops.segment_sum`` call
+   that omits ``num_segments`` infers the segment count from the data,
+   silently re-specializing shapes per batch (a fresh compile — ~100 ms
+   remote dispatch each — whenever the inferred count changes) and, under
+   jit with traced ids, failing outright. Every call in the device hot-path
+   packages ``ops/`` and ``parallel/`` must pass the count explicitly
+   (keyword or third positional argument).
 
 Exit status 0 = clean; 1 = violations (printed one per line as
 ``path:lineno: message``). Run from the repo root:
@@ -378,6 +386,44 @@ def check_vmapped_pallas(root: pathlib.Path) -> list[str]:
     return problems
 
 
+#: packages whose segment_sum calls run in device hot paths (check 7); a
+#: missing num_segments there silently re-specializes shapes per batch
+SEGMENT_SUM_CHECKED_PREFIXES = (
+    f"{PACKAGE}/ops/",
+    f"{PACKAGE}/parallel/",
+)
+
+
+def check_segment_sum_num_segments(root: pathlib.Path) -> list[str]:
+    problems = []
+    for path in sorted((root / PACKAGE).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if not rel.startswith(SEGMENT_SUM_CHECKED_PREFIXES):
+            continue
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_seg = (
+                isinstance(fn, ast.Attribute) and fn.attr == "segment_sum"
+            ) or (isinstance(fn, ast.Name) and fn.id == "segment_sum")
+            if not is_seg:
+                continue
+            explicit = len(node.args) >= 3 or any(
+                kw.arg == "num_segments" for kw in node.keywords
+            )
+            if not explicit:
+                problems.append(
+                    f"{rel}:{node.lineno}: segment_sum without an explicit "
+                    "num_segments= — the inferred count re-specializes "
+                    "shapes per batch (a fresh remote compile whenever it "
+                    "changes) and fails under jit with traced ids; pass "
+                    "the static segment count"
+                )
+    return problems
+
+
 def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
     root = pathlib.Path(root) if root else pathlib.Path(__file__).resolve().parents[1]
     return (
@@ -387,6 +433,7 @@ def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
         + check_score_allgathers(root)
         + check_broad_excepts(root)
         + check_vmapped_pallas(root)
+        + check_segment_sum_num_segments(root)
     )
 
 

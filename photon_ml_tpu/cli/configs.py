@@ -88,6 +88,12 @@ def parse_feature_shard_config(spec: str) -> tuple[str, FeatureShardConfiguratio
     sparse = _bool(kv.pop("sparse", "false"))
     pre_indexed = _bool(kv.pop("pre.indexed", "false"))
     dimension = kv.pop("dimension", None)
+    # hybrid dense-head/sparse-tail layout (sparse shards only): the
+    # nnz-hottest columns train on a dense MXU block, the cold residual on
+    # the ELL tail (data/sparse_batch.HybridPolicy; BASELINE.md r6)
+    hybrid = _bool(kv.pop("hybrid", "false"))
+    hybrid_hot_cols = kv.pop("hybrid.hot.cols", None)
+    hybrid_coverage = kv.pop("hybrid.coverage", None)
     # dtype=bf16 halves the dense block's HBM footprint/traffic (hot loop
     # at ~1.2-1.4x, BASELINE.md r4 bf16 study); accepted aliases follow
     # common usage
@@ -112,6 +118,13 @@ def parse_feature_shard_config(spec: str) -> tuple[str, FeatureShardConfiguratio
         pre_indexed=pre_indexed,
         dimension=None if dimension is None else int(dimension),
         dtype=dtype_aliases[raw_dtype],
+        hybrid=hybrid,
+        hybrid_hot_cols=(
+            None if hybrid_hot_cols is None else int(hybrid_hot_cols)
+        ),
+        hybrid_coverage=(
+            None if hybrid_coverage is None else float(hybrid_coverage)
+        ),
     )
 
 

@@ -89,6 +89,19 @@ def run(
     partitioned = partitioned_io and jax.process_count() > 1
     if partitioned and not (distributed or mesh_shape):
         raise ValueError("--partitioned-io requires --distributed or --mesh")
+    if partitioned_io and any(
+        getattr(cfg, "hybrid", False)
+        for cfg in (feature_shards or {}).values()
+    ):
+        # same up-front rejection as the training driver: hot-column
+        # ranking is a GLOBAL nnz statistic — per-rank partitioned blocks
+        # would each elect a different head before scoring even starts
+        raise ValueError(
+            "hybrid feature shards cannot combine with --partitioned-io "
+            "(hot-column selection is a global statistic; per-rank blocks "
+            "would disagree on the head) — drop hybrid=true or read "
+            "unpartitioned"
+        )
     from photon_ml_tpu.telemetry import RunJournal
     from photon_ml_tpu.util.timed import reset_timings, timing_summary
 

@@ -58,6 +58,7 @@ from photon_ml_tpu.io.model_io import (
 )
 from photon_ml_tpu.ops.normalization import NormalizationType
 from photon_ml_tpu.telemetry import RunJournal, SolverTelemetry, default_registry
+from photon_ml_tpu.telemetry.layout import reset_layout_metrics
 from photon_ml_tpu.telemetry.probes import CompileMonitor, live_buffer_bytes
 from photon_ml_tpu.telemetry.solver_trace import reset_solver_metrics
 from photon_ml_tpu.types import TaskType
@@ -161,6 +162,20 @@ class GameTrainingParams:
             )
         if self.max_restarts < 0:
             problems.append("--max-restarts must be >= 0")
+        if self.partitioned_io and any(
+            getattr(cfg, "hybrid", False)
+            for cfg in self.feature_shards.values()
+        ):
+            # rejected up front, not silently wrong: the hot-column ranking
+            # is a GLOBAL nnz statistic, and per-rank partitioned blocks
+            # would each elect a different head (different k_hot/hot sets
+            # per rank feeding one collective program)
+            problems.append(
+                "hybrid feature shards cannot combine with --partitioned-io"
+                " (hot-column selection is a global statistic; per-rank "
+                "blocks would disagree on the head) — drop hybrid=true or "
+                "read unpartitioned"
+            )
         sequence = self.update_sequence or tuple(self.coordinates.keys())
         for cid in sequence:
             if cid not in self.coordinates:
@@ -262,9 +277,11 @@ def run(params: GameTrainingParams) -> dict:
         )
     os.makedirs(out, exist_ok=True)
 
-    # per-run phase timings + solver tallies (a sweep may call run() repeatedly)
+    # per-run phase timings + solver/layout tallies (a sweep may call run()
+    # repeatedly)
     reset_timings()
     reset_solver_metrics()
+    reset_layout_metrics()
     events.send(TrainingStartEvent(job_name="game-training"))
     job_log = PhotonLogger(os.path.join(out, "driver.log"))
     # rank-gated journal: inert on worker ranks, so telemetry calls below

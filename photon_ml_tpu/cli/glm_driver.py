@@ -32,6 +32,7 @@ from photon_ml_tpu.io.model_io import write_glm_text
 from photon_ml_tpu.ops.normalization import NormalizationType, build_normalization
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
 from photon_ml_tpu.telemetry import RunJournal, SolverTelemetry, default_registry
+from photon_ml_tpu.telemetry.layout import reset_layout_metrics
 from photon_ml_tpu.telemetry.probes import CompileMonitor
 from photon_ml_tpu.telemetry.solver_trace import reset_solver_metrics
 from photon_ml_tpu.types import TaskType
@@ -156,9 +157,11 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
             "normalization"
         )
     os.makedirs(params.output_dir, exist_ok=True)
-    # per-run phase timings + solver tallies (sweeps may call run() repeatedly)
+    # per-run phase timings + solver/layout tallies (sweeps may call run()
+    # repeatedly)
     reset_timings()
     reset_solver_metrics()
+    reset_layout_metrics()
     journal = (
         RunJournal(params.telemetry_dir) if params.telemetry_dir else None
     )

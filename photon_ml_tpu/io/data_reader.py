@@ -71,6 +71,19 @@ class FeatureShardConfiguration:
     #: study; <5e-6 coefficient delta on the accuracy table). Dense shards
     #: only. No reference analogue (TPU-first capability).
     dtype: str = "float32"
+    #: hybrid dense-head / sparse-tail layout for giant-d sparse shards
+    #: (data/sparse_batch.HybridPolicy): the nnz-hottest columns train on
+    #: a dense MXU block, the cold residual on the ELL tail — the index-op
+    #:  removal win on power-law name-term bags (BASELINE.md r6). Sparse
+    #: shards only; strictly opt-in (off is bitwise-identical).
+    hybrid: bool = False
+    #: explicit hot-head column budget (``hybrid.hot.cols``); None lets
+    #: ``hybrid_coverage`` drive the split
+    hybrid_hot_cols: int | None = None
+    #: target fraction of nonzeros the head should cover
+    #: (``hybrid.coverage``); None with no explicit budget uses the
+    #: builder default
+    hybrid_coverage: float | None = None
 
     def __post_init__(self):
         if self.dtype not in ("float32", "bfloat16"):
@@ -85,6 +98,35 @@ class FeatureShardConfiguration:
                 "index-bound, not bandwidth-bound (BASELINE.md sparse "
                 "floor study)"
             )
+        if self.hybrid and not self.sparse:
+            raise ValueError(
+                "hybrid=true is the dense-head/sparse-tail layout of "
+                "SPARSE shards (sparse=true); dense blocks are already "
+                "one MXU matmul"
+            )
+        if not self.hybrid and (
+            self.hybrid_hot_cols is not None
+            or self.hybrid_coverage is not None
+        ):
+            raise ValueError(
+                "hybrid.hot.cols / hybrid.coverage require hybrid=true"
+            )
+        # range checks delegate to HybridPolicy so the CLI and programmatic
+        # paths agree on the contract
+        self.hybrid_policy()
+
+    def hybrid_policy(self, label: str = "sparse"):
+        """The shard's HybridPolicy (None when hybrid is off); ``label``
+        namespaces the layout telemetry gauges (``layout/<label>/*``)."""
+        if not self.hybrid:
+            return None
+        from photon_ml_tpu.data.sparse_batch import HybridPolicy
+
+        return HybridPolicy(
+            hot_cols=self.hybrid_hot_cols,
+            coverage=self.hybrid_coverage,
+            label=label,
+        )
 
 
 def read_avro_records(
@@ -215,6 +257,7 @@ def _assemble_sparse_shard(
     return SparseShard(
         rows=row_idx, cols=col_idx, vals=vals,
         num_samples=n, feature_dim=imap.size,
+        hybrid_policy=cfg.hybrid_policy(label=shard),
     )
 
 
@@ -838,6 +881,7 @@ def _read_merged_libsvm(
                     cols=data.cols.astype(np.int64),
                     vals=data.vals.astype(dtype),
                     num_samples=n, feature_dim=dim,
+                    hybrid_policy=cfg.hybrid_policy(label=shard),
                 )
             else:
                 feature_shards[shard] = _scatter_dense(

@@ -30,6 +30,7 @@ axpys lower to per-shard ops + psums under jit.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from functools import partial
 
 import flax.struct
@@ -40,11 +41,18 @@ from photon_ml_tpu.parallel.mesh import shard_map
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from photon_ml_tpu.data.sparse_batch import SparseShard
+from photon_ml_tpu.data.sparse_batch import (
+    SparseShard,
+    _hybrid_arrays,
+    resolve_hybrid_policy,
+)
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.ops.sparse_objective import _sorted_run_sums
+from photon_ml_tpu.telemetry.layout import record_block_head
 
 Array = jax.Array
+
+logger = logging.getLogger(__name__)
 
 
 @flax.struct.dataclass
@@ -72,6 +80,21 @@ class ColumnShardedSparseBatch:
     weights: Array      # [n]
     dim: int = flax.struct.field(pytree_node=False)
     block: int = flax.struct.field(pytree_node=False)
+    #: optional hybrid dense-head view (data/sparse_batch.HybridPolicy
+    #: builder rule applied globally): each block's slice of the hot
+    #: column set rides a dense [n, h] sub-block with LOCAL column ids —
+    #: the head is "model"-sharded by the same contiguous-range rule as
+    #: the tail, so each device still owns exactly the entries that touch
+    #: its coefficient range. Pad slots carry local col 0 over an all-zero
+    #: column (inert in gather and scatter). The COO/column-sorted arrays
+    #: then hold ONLY the cold residual tail. None = hybrid off (the
+    #: existing layout, bitwise unchanged).
+    hot_vals: Array | None = None        # [K, n, h]
+    hot_local_cols: Array | None = None  # [K, h] int32
+
+    @property
+    def has_hot_head(self) -> bool:
+        return self.hot_vals is not None
 
     @property
     def num_blocks(self) -> int:
@@ -90,6 +113,31 @@ class ColumnShardedSparseBatch:
         return self.values.dtype
 
 
+def _block_hot_head(
+    hot_block: np.ndarray, hot_ids: np.ndarray, k: int, block: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Regroup a global [n, k_hot] hot head into per-block [K, n, h] dense
+    sub-blocks with LOCAL column ids — the same contiguous-range rule the
+    tail's column blocks follow. Pad slots (h padding, and the global
+    head's own lane padding) carry local col 0 over an all-zero column."""
+    n = hot_block.shape[0]
+    kh = hot_ids.shape[0]
+    blk = (hot_ids // block).astype(np.int64)
+    local = (hot_ids - blk * block).astype(np.int64)
+    counts = np.bincount(blk, minlength=k) if kh else np.zeros(k, np.int64)
+    h = max(int(counts.max(initial=0)), 1)
+    starts = np.zeros(k + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    # hot_ids are sorted, so each block's ids are contiguous in the input
+    slot = np.arange(kh) - starts[blk]
+    out_v = np.zeros((k, n, h), dtype=hot_block.dtype)
+    out_c = np.zeros((k, h), dtype=np.int32)
+    if kh:
+        out_v[blk, :, slot] = hot_block.T
+        out_c[blk, slot] = local
+    return out_v, out_c
+
+
 def build_column_sharded_batch(
     shard: SparseShard,
     labels,
@@ -97,6 +145,7 @@ def build_column_sharded_batch(
     *,
     offsets=None,
     weights=None,
+    hybrid=None,
 ) -> ColumnShardedSparseBatch:
     """Group a SparseShard's entries into ``num_blocks`` column blocks.
 
@@ -104,6 +153,11 @@ def build_column_sharded_batch(
     except blocks are CONTIGUOUS ranges so each device's run bounds stay a
     dense [block+1] slice and locality survives (hash partitioning would
     randomize columns across devices and kill the sorted-run reduction).
+
+    hybrid: None (default) inherits the shard's attached ``hybrid_policy``;
+    False forces it off; a HybridPolicy/True enables the dense hot head —
+    selected GLOBALLY by the same nnz ranking as the single-chip builder,
+    then "model"-sharded per block alongside the cold tail.
     """
     rows, cols, vals = shard.coalesced()
     rows = np.asarray(rows)
@@ -112,6 +166,40 @@ def build_column_sharded_batch(
     n, dim = shard.shape
     k = int(num_blocks)
     block = -(-dim // k)
+
+    policy = (
+        shard.hybrid_policy if hybrid is None else resolve_hybrid_policy(hybrid)
+    )
+    hot_extra = {}
+    if policy is not None:
+        # pad=False: lane padding would land every duplicate pad id in the
+        # last hot column's block and inflate the per-block width; blocks
+        # re-pad to their own widest count below
+        hot_block, hot_ids, rows, cols, vals = _hybrid_arrays(
+            rows, cols, vals, n, dim, policy, pad=False
+        )
+        hv3, hc2 = _block_hot_head(hot_block, hot_ids, k, block)
+        # every block pads to the widest block's hot count: hot ids
+        # clustered into few contiguous blocks (e.g. insertion-ordered
+        # index maps) blow the [K, n, h] head up toward K× the global
+        # head — surface it instead of silently multiplying HBM/compute
+        record_block_head(
+            policy.label, width=hv3.shape[2], num_blocks=k,
+            k_hot_padded=hot_ids.shape[0],
+        )
+        if hot_ids.shape[0] and hv3.shape[2] * k > 2 * hot_ids.shape[0]:
+            logger.warning(
+                "hybrid hot head is clustered across column blocks: "
+                "per-block width %d x %d blocks vs %d global hot columns "
+                "(%.1fx replicated zeros); a hashed/shuffled feature id "
+                "assignment spreads the head",
+                hv3.shape[2], k, hot_ids.shape[0],
+                hv3.shape[2] * k / hot_ids.shape[0],
+            )
+        hot_extra = dict(
+            hot_vals=jnp.asarray(hv3),
+            hot_local_cols=jnp.asarray(hc2, dtype=jnp.int32),
+        )
 
     blk = (cols // block).astype(np.int64)
     local = (cols - blk * block).astype(np.int64)
@@ -168,6 +256,7 @@ def build_column_sharded_batch(
         weights=jnp.asarray(weights),
         dim=int(dim),
         block=int(block),
+        **hot_extra,
     )
 
 
@@ -181,7 +270,18 @@ def shard_column_batch(batch: ColumnShardedSparseBatch, mesh: Mesh,
     put = put_fn if put_fn is not None else jax.device_put
     mdl = NamedSharding(mesh, P("model", None))
     rep = NamedSharding(mesh, P())
+    hot_extra = {}
+    if batch.has_hot_head:
+        # the hot head shards over "model" with the tail (each device owns
+        # its blocks' hot columns); the sample axis stays unsharded like
+        # every other per-sample dimension here
+        hot_extra = dict(
+            hot_vals=put(batch.hot_vals,
+                         NamedSharding(mesh, P("model", None, None))),
+            hot_local_cols=put(batch.hot_local_cols, mdl),
+        )
     return batch.replace(
+        **hot_extra,
         values=put(batch.values, mdl),
         local_cols=put(batch.local_cols, mdl),
         row_ids=put(batch.row_ids, mdl),
@@ -221,11 +321,13 @@ class ColumnShardedGLMObjective:
     def __hash__(self):
         return hash(self._key())
 
-    def _shard_spec(self):
+    def _shard_spec(self, hot: bool = False):
         e = P("model", None)
+        hot_specs = (P("model", None, None), e) if hot else ()
         return dict(
             mesh=self.mesh,
-            in_specs=(P("model"), e, e, e, e, e, e, P(), P(), P()),
+            in_specs=(P("model"),) + hot_specs
+            + (e, e, e, e, e, e, P(), P(), P()),
             check_vma=False,
         )
 
@@ -244,21 +346,38 @@ class ColumnShardedGLMObjective:
     # -- margins (the psum'd treeAggregate) ---------------------------------
 
     @staticmethod
-    def _local_margins(w_l, values, local_cols, row_ids, n: int) -> Array:
+    def _local_margins(w_l, values, local_cols, row_ids, n: int,
+                       hot_vals=None, hot_cols=None) -> Array:
         contrib = values * w_l[local_cols]
         partial = jax.ops.segment_sum(
             contrib, row_ids, num_segments=n, indices_are_sorted=True
         )
+        if hot_vals is not None:
+            # dense hot head: one [n, h] matvec against this block's own
+            # coefficient slice (pad columns are zero — inert)
+            partial = partial + hot_vals @ w_l[hot_cols]
         return jax.lax.psum(partial, "model")
+
+    @staticmethod
+    def _unpack(hot: bool, args):
+        """(hot_vals, hot_cols, tail-and-sample args) from a shard_map
+        argument list that carries the hot head only when present."""
+        if hot:
+            return args[0], args[1], args[2:]
+        return None, None, args
 
     def value(self, w: Array, batch: ColumnShardedSparseBatch) -> Array:
         self._check_blocks(batch)
         n = batch.num_samples
+        hot = batch.has_hot_head
 
-        def f(w_l, values, local_cols, row_ids, vbc, rbc, bounds,
-              labels, offsets, weights):
+        def f(w_l, *args):
+            hv, hc, (values, local_cols, row_ids, vbc, rbc, bounds,
+                     labels, offsets, weights) = self._unpack(hot, args)
             margins = self._local_margins(
-                w_l[0], values[0], local_cols[0], row_ids[0], n
+                w_l[0], values[0], local_cols[0], row_ids[0], n,
+                hot_vals=None if hv is None else hv[0],
+                hot_cols=None if hc is None else hc[0],
             ) + offsets
             total = jnp.sum(weights * self.loss.loss(margins, labels))
             if self.l2_weight > 0.0:
@@ -268,7 +387,7 @@ class ColumnShardedGLMObjective:
             return total
 
         return shard_map(
-            f, out_specs=P(), **self._shard_spec()
+            f, out_specs=P(), **self._shard_spec(hot)
         )(w.reshape(batch.num_blocks, batch.block), *self._batch_args(batch))
 
     def value_and_gradient(
@@ -276,17 +395,25 @@ class ColumnShardedGLMObjective:
     ) -> tuple[Array, Array]:
         self._check_blocks(batch)
         n = batch.num_samples
+        hot = batch.has_hot_head
 
-        def f(w_l, values, local_cols, row_ids, vbc, rbc, bounds,
-              labels, offsets, weights):
+        def f(w_l, *args):
+            hv, hc, (values, local_cols, row_ids, vbc, rbc, bounds,
+                     labels, offsets, weights) = self._unpack(hot, args)
             margins = self._local_margins(
-                w_l[0], values[0], local_cols[0], row_ids[0], n
+                w_l[0], values[0], local_cols[0], row_ids[0], n,
+                hot_vals=None if hv is None else hv[0],
+                hot_cols=None if hc is None else hc[0],
             ) + offsets
             losses, dz = self.loss.loss_and_dz(margins, labels)
             total = jnp.sum(weights * losses)
             dzw = weights * dz
             contrib = dzw[rbc[0]] * vbc[0]
             g_l = _sorted_run_sums(contrib, bounds[0])
+            if hv is not None:
+                # head transpose: ONE [n]·[n, h] matvec + an h-sized
+                # scatter into this block's gradient slice
+                g_l = g_l.at[hc[0]].add(dzw @ hv[0])
             if self.l2_weight > 0.0:
                 total = total + 0.5 * self.l2_weight * jax.lax.psum(
                     jnp.vdot(w_l, w_l), "model"
@@ -295,7 +422,7 @@ class ColumnShardedGLMObjective:
             return total, g_l[None, :]
 
         value, grad = shard_map(
-            f, out_specs=(P(), P("model", None)), **self._shard_spec()
+            f, out_specs=(P(), P("model", None)), **self._shard_spec(hot)
         )(w.reshape(batch.num_blocks, batch.block), *self._batch_args(batch))
         return value, grad.reshape(-1)
 
@@ -303,27 +430,37 @@ class ColumnShardedGLMObjective:
         self, w: Array, v: Array, batch: ColumnShardedSparseBatch
     ) -> Array:
         """H v = Xᵀ diag(w_i l''_i) X v (+ λv): forward psum'd Jv, then the
-        same local sorted-run transpose — TRON's CG ladder at giant d."""
+        same local sorted-run transpose — TRON's CG ladder at giant d.
+        With a hot head, both directions take the dense-head/sparse-tail
+        split (the hybrid CG step of the d=10⁸ bench row)."""
         self._check_blocks(batch)
         n = batch.num_samples
+        hot = batch.has_hot_head
 
-        def f(w_l, v_l, values, local_cols, row_ids, vbc, rbc, bounds,
-              labels, offsets, weights):
+        def f(w_l, v_l, *args):
+            hv, hc, (values, local_cols, row_ids, vbc, rbc, bounds,
+                     labels, offsets, weights) = self._unpack(hot, args)
+            hot_kw = dict(
+                hot_vals=None if hv is None else hv[0],
+                hot_cols=None if hc is None else hc[0],
+            )
             margins = self._local_margins(
-                w_l[0], values[0], local_cols[0], row_ids[0], n
+                w_l[0], values[0], local_cols[0], row_ids[0], n, **hot_kw
             ) + offsets
             jv = self._local_margins(
-                v_l[0], values[0], local_cols[0], row_ids[0], n
+                v_l[0], values[0], local_cols[0], row_ids[0], n, **hot_kw
             )
             d2w = self.loss.d2z(margins, labels) * weights
             t = d2w * jv
             contrib = t[rbc[0]] * vbc[0]
             hv_l = _sorted_run_sums(contrib, bounds[0])
+            if hv is not None:
+                hv_l = hv_l.at[hc[0]].add(t @ hv[0])
             if self.l2_weight > 0.0:
                 hv_l = hv_l + self.l2_weight * v_l[0]
             return hv_l[None, :]
 
-        spec = self._shard_spec()
+        spec = self._shard_spec(hot)
         spec["in_specs"] = (P("model"),) + spec["in_specs"]
         k, b = batch.num_blocks, batch.block
         hv = shard_map(f, out_specs=P("model", None), **spec)(
@@ -333,7 +470,11 @@ class ColumnShardedGLMObjective:
 
     @staticmethod
     def _batch_args(batch: ColumnShardedSparseBatch):
-        return (
+        hot = (
+            (batch.hot_vals, batch.hot_local_cols)
+            if batch.has_hot_head else ()
+        )
+        return hot + (
             batch.values, batch.local_cols, batch.row_ids,
             batch.vals_by_col, batch.rows_by_col, batch.local_bounds,
             batch.labels, batch.offsets, batch.weights,

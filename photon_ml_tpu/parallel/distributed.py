@@ -628,6 +628,13 @@ class GameTrainProgram:
                     ell_vals=put(sb.ell_vals, NamedSharding(mesh, P("data", None))),
                     ell_cols=put(sb.ell_cols, NamedSharding(mesh, P("data", None))),
                 )
+            if sb.has_hybrid_view:
+                # the dense hot head [n, k_hot] rides the sample axis too;
+                # the k_hot global column ids are model-sized and replicate
+                sb = sb.replace(
+                    hot_vals=put(sb.hot_vals, NamedSharding(mesh, P("data", None))),
+                    hot_col_ids=put(sb.hot_col_ids, NamedSharding(mesh, P())),
+                )
             if sb.has_column_sorted_view:
                 sb = sb.replace(
                     vals_by_col=put(sb.vals_by_col, vec),

@@ -9,6 +9,11 @@ through — see each submodule's docstring for its slice of the map.
 """
 
 from photon_ml_tpu.telemetry.journal import JOURNAL_FILENAME, RunJournal, json_safe
+from photon_ml_tpu.telemetry.layout import (
+    LAYOUT_METRIC_PREFIX,
+    record_hybrid_layout,
+    reset_layout_metrics,
+)
 from photon_ml_tpu.telemetry.probes import (
     GATE_REPS,
     CompileMonitor,
@@ -54,6 +59,9 @@ __all__ = [
     "JOURNAL_FILENAME",
     "RunJournal",
     "json_safe",
+    "LAYOUT_METRIC_PREFIX",
+    "record_hybrid_layout",
+    "reset_layout_metrics",
     "GATE_REPS",
     "CompileMonitor",
     "MarginalResult",

@@ -163,6 +163,53 @@ def test_lint_catches_pallas_in_vmapped_solve_modules(tmp_path):
     assert not any("kernel_home.py" in p for p in problems)
 
 
+def test_lint_catches_segment_sum_without_num_segments(tmp_path):
+    """Check 7 fires: segment_sum calls in ops/ or parallel/ missing an
+    explicit num_segments are reported; keyword or third-positional counts
+    pass, and modules outside the checked packages are not the lint's
+    business."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    ops = tmp_path / "photon_ml_tpu" / "ops"
+    ops.mkdir(parents=True)
+    (ops / "bad_ops.py").write_text(
+        '"""No reference analogue."""\n'
+        "import jax\n"
+        "def f(v, ids):\n"
+        "    return jax.ops.segment_sum(v, ids)\n"
+        "def g(v, ids, n):\n"
+        "    return jax.ops.segment_sum(v, ids, num_segments=n)\n"
+        "def h(v, ids, n):\n"
+        "    return jax.ops.segment_sum(v, ids, n)  # positional: explicit\n"
+    )
+    par = tmp_path / "photon_ml_tpu" / "parallel"
+    par.mkdir(parents=True)
+    (par / "bad_parallel.py").write_text(
+        '"""No reference analogue."""\n'
+        "from jax.ops import segment_sum\n"
+        "def f(v, ids):\n"
+        "    return segment_sum(v, ids, indices_are_sorted=True)\n"
+    )
+    ev = tmp_path / "photon_ml_tpu" / "evaluation"
+    ev.mkdir(parents=True)
+    (ev / "outside.py").write_text(
+        '"""No reference analogue."""\n'
+        "import jax\n"
+        "def f(v, ids):\n"
+        "    return jax.ops.segment_sum(v, ids)  # outside ops/ + parallel/\n"
+    )
+    problems = lint_parity.run_lints(tmp_path)
+    assert any("bad_ops.py:4" in p and "num_segments" in p for p in problems)
+    assert not any("bad_ops.py:6" in p for p in problems)
+    assert not any("bad_ops.py:8" in p for p in problems)
+    assert any("bad_parallel.py:4" in p for p in problems)
+    assert not any("outside.py" in p for p in problems)
+
+
 def test_lint_catches_broad_excepts(tmp_path):
     """The broad-except check fires on swallowing handlers, and exempts
     re-raising handlers and the resilience classifier's allowlist."""

@@ -1,0 +1,715 @@
+"""Hybrid dense-head / sparse-tail layout tests (ISSUE 5).
+
+The reference keeps name-term feature bags sparse end to end
+(AvroDataReader.scala:165-200); those bags are power-law distributed, so a
+small hot-column head carries most nonzeros. These tests pin the hybrid
+view's contract: every sparse view of the same shard (flat COO,
+column-sorted, ELL, hybrid) computes identical value/gradient/
+hessian_vector; hybrid OFF is bitwise-identical to the pre-existing
+layouts; the pad/offsets lifecycle keeps all views in lockstep; the
+column-sharded hot head is sharding-invariant (1-device == 8-device); and
+the CLI grammar + partitioned-io guard behave.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.batch import LabeledPointBatch
+from photon_ml_tpu.data.sparse_batch import (
+    HybridPolicy,
+    SparseLabeledPointBatch,
+    SparseShard,
+    resolve_hybrid_policy,
+    sparse_column_sum,
+    sparse_margins,
+)
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
+from photon_ml_tpu.types import TaskType
+
+
+def _skewed_coo(n, d, nnz, seed, gamma=6.0):
+    """Power-law columns (the regime the hybrid layout targets) with forced
+    duplicate (row, col) pairs to pin the accumulation rule."""
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, n, size=nnz)
+    cols = (rng.random(nnz) ** gamma * d).astype(np.int64)
+    vals = rng.normal(size=nnz)
+    rows[: nnz // 8] = rows[nnz // 2 : nnz // 2 + nnz // 8]
+    cols[: nnz // 8] = cols[nnz // 2 : nnz // 2 + nnz // 8]
+    return rows, cols, vals
+
+
+def _data(n=80, d=40, nnz=600, seed=0):
+    rng = np.random.default_rng(seed + 1)
+    rows, cols, vals = _skewed_coo(n, d, nnz, seed)
+    labels = (rng.random(n) < 0.5).astype(np.float64)
+    offsets = rng.normal(scale=0.1, size=n)
+    weights = rng.uniform(0.5, 2.0, size=n)
+    return rows, cols, vals, labels, offsets, weights
+
+
+def _views(seed=0, n=80, d=40, nnz=600):
+    """All four views of the same shard, keyed by name."""
+    rows, cols, vals, labels, offsets, weights = _data(n, d, nnz, seed)
+    common = dict(dim=d, offsets=offsets, weights=weights, dtype=np.float64)
+    build = lambda **kw: SparseLabeledPointBatch.from_coo(
+        rows, cols, vals, labels, **common, **kw
+    )
+    return {
+        "flat": build(ell=False),
+        "column_sorted": build(ell=False, column_sorted_gradient=True),
+        "ell": build(),
+        "ell_narrow": build(ell=2),  # forces a large overflow tail
+        "hybrid": build(hybrid=HybridPolicy(coverage=0.6, pad_multiple=4)),
+        "hybrid_budget": build(
+            hybrid=HybridPolicy(hot_cols=3, pad_multiple=8)
+        ),
+        "hybrid_flat_tail": build(
+            ell=False, hybrid=HybridPolicy(coverage=0.5, pad_multiple=4)
+        ),
+    }
+
+
+class TestViewContract:
+    """Flat-COO vs column-sorted vs ELL vs hybrid views of the same shard
+    agree on value/gradient/hessian_vector (ISSUE 5 property test)."""
+
+    @pytest.mark.parametrize("seed", [0, 7, 23])
+    @pytest.mark.parametrize("task", [
+        TaskType.LOGISTIC_REGRESSION, TaskType.POISSON_REGRESSION,
+    ])
+    def test_value_gradient_hessian_vector_agree(self, seed, task):
+        views = _views(seed=seed)
+        so = SparseGLMObjective(loss_for_task(task), l2_weight=0.3)
+        d = views["flat"].dim
+        rng = np.random.default_rng(seed + 100)
+        w = jnp.asarray(rng.normal(scale=0.1, size=d))
+        v = jnp.asarray(rng.normal(size=d))
+        want_val, want_grad = so.value_and_gradient(w, views["flat"])
+        want_hv = so.hessian_vector(w, v, views["flat"])
+        want_diag = so.hessian_diagonal(w, views["flat"])
+        for name, batch in views.items():
+            val, grad = so.value_and_gradient(w, batch)
+            np.testing.assert_allclose(
+                float(val), float(want_val), rtol=1e-11, err_msg=name
+            )
+            np.testing.assert_allclose(
+                np.asarray(grad), np.asarray(want_grad),
+                rtol=1e-9, atol=1e-12, err_msg=name,
+            )
+            np.testing.assert_allclose(
+                np.asarray(so.hessian_vector(w, v, batch)),
+                np.asarray(want_hv), rtol=1e-8, atol=1e-12, err_msg=name,
+            )
+            np.testing.assert_allclose(
+                np.asarray(so.hessian_diagonal(w, batch)),
+                np.asarray(want_diag), rtol=1e-8, atol=1e-12, err_msg=name,
+            )
+
+    def test_hybrid_view_shapes(self):
+        views = _views()
+        hyb = views["hybrid"]
+        assert hyb.has_hybrid_view and hyb.has_ell_view
+        k_pad = hyb.hot_vals.shape[1]
+        assert k_pad % 4 == 0  # lane-friendly padding
+        assert hyb.hot_col_ids.shape == (k_pad,)
+        # the head actually absorbed entries: the tail is strictly smaller
+        # than the full ELL view's footprint
+        assert hyb.ell_vals.shape[1] <= views["ell"].ell_vals.shape[1]
+        budget = views["hybrid_budget"]
+        assert budget.hot_vals.shape[1] == 8  # 3 hot cols padded to 8
+        # pad head ids repeat the LAST hot id over all-zero columns
+        ids = np.asarray(budget.hot_col_ids)
+        assert np.all(ids[3:] == ids[2])
+        assert np.all(np.asarray(budget.hot_vals)[:, 3:] == 0.0)
+
+    def test_margins_and_column_sums_agree(self):
+        views = _views(seed=3)
+        rng = np.random.default_rng(4)
+        d, n = views["flat"].dim, views["flat"].num_samples
+        w = jnp.asarray(rng.normal(size=d))
+        rw = jnp.asarray(rng.uniform(0.5, 2.0, size=n))
+        want_m = np.asarray(sparse_margins(views["flat"], w))
+        for name, batch in views.items():
+            np.testing.assert_allclose(
+                np.asarray(sparse_margins(batch, w)), want_m,
+                rtol=1e-11, err_msg=name,
+            )
+            for sq in (False, True):
+                np.testing.assert_allclose(
+                    np.asarray(sparse_column_sum(batch, rw, sq)),
+                    np.asarray(sparse_column_sum(views["flat"], rw, sq)),
+                    rtol=1e-9, atol=1e-12, err_msg=f"{name} sq={sq}",
+                )
+
+    def test_normalization_algebra_agrees(self):
+        """Factors + shifts through the fused hybrid path; with shifts the
+        Hv falls back to autodiff and must still agree."""
+        views = _views(seed=5)
+        rng = np.random.default_rng(6)
+        d = views["flat"].dim
+        norm = NormalizationContext(
+            factors=jnp.asarray(rng.uniform(0.5, 2.0, size=d)),
+            shifts=jnp.asarray(rng.normal(scale=0.2, size=d)),
+        )
+        so = SparseGLMObjective(
+            loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=0.2,
+            normalization=norm,
+        )
+        w = jnp.asarray(rng.normal(scale=0.1, size=d))
+        v = jnp.asarray(rng.normal(size=d))
+        want_v, want_g = so.value_and_gradient(w, views["flat"])
+        for name in ("hybrid", "hybrid_budget", "hybrid_flat_tail"):
+            val, grad = so.value_and_gradient(w, views[name])
+            np.testing.assert_allclose(float(val), float(want_v), rtol=1e-11)
+            np.testing.assert_allclose(
+                np.asarray(grad), np.asarray(want_g),
+                rtol=1e-9, atol=1e-12, err_msg=name,
+            )
+            np.testing.assert_allclose(
+                np.asarray(so.hessian_vector(w, v, views[name])),
+                np.asarray(so.hessian_vector(w, v, views["flat"])),
+                rtol=1e-8, atol=1e-12, err_msg=name,
+            )
+        # factors only: the split Hv path (no fallback) still agrees
+        so_f = SparseGLMObjective(
+            loss_for_task(TaskType.POISSON_REGRESSION), l2_weight=0.7,
+            normalization=NormalizationContext(
+                factors=norm.factors, shifts=None
+            ),
+        )
+        np.testing.assert_allclose(
+            np.asarray(so_f.hessian_vector(w, v, views["hybrid"])),
+            np.asarray(so_f.hessian_vector(w, v, views["flat"])),
+            rtol=1e-8, atol=1e-12,
+        )
+
+    def test_matches_dense(self):
+        rows, cols, vals, labels, offsets, weights = _data(seed=9)
+        n, d = len(labels), 40
+        x = np.zeros((n, d))
+        np.add.at(x, (rows, cols), vals)
+        db = LabeledPointBatch(
+            features=jnp.asarray(x), labels=jnp.asarray(labels),
+            offsets=jnp.asarray(offsets), weights=jnp.asarray(weights),
+        )
+        hyb = SparseLabeledPointBatch.from_coo(
+            rows, cols, vals, labels, dim=d, offsets=offsets,
+            weights=weights, dtype=np.float64,
+            hybrid=HybridPolicy(coverage=0.7, pad_multiple=4),
+        )
+        from photon_ml_tpu.ops.objective import GLMObjective
+
+        loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+        so = SparseGLMObjective(loss, l2_weight=0.3)
+        do = GLMObjective(loss, l2_weight=0.3)
+        w = jnp.asarray(np.random.default_rng(10).normal(scale=0.1, size=d))
+        sv, sg = so.value_and_gradient(w, hyb)
+        dv, dg = do.value_and_gradient(w, db)
+        np.testing.assert_allclose(float(sv), float(dv), rtol=1e-9)
+        np.testing.assert_allclose(np.asarray(sg), np.asarray(dg), rtol=1e-8)
+
+
+class TestHybridOffBitwise:
+    """``hybrid`` off must be bitwise-identical to the pre-existing
+    ELL/flat paths (ISSUE 5 acceptance)."""
+
+    @pytest.mark.parametrize("off", [None, False])
+    def test_builder_arrays_identical(self, off):
+        rows, cols, vals, labels, offsets, weights = _data(seed=11)
+        common = dict(
+            dim=40, offsets=offsets, weights=weights, dtype=np.float64
+        )
+        base = SparseLabeledPointBatch.from_coo(
+            rows, cols, vals, labels, **common
+        )
+        off_batch = SparseLabeledPointBatch.from_coo(
+            rows, cols, vals, labels, hybrid=off, **common
+        )
+        assert not off_batch.has_hybrid_view
+        assert off_batch.hot_vals is None and off_batch.hot_col_ids is None
+        base_leaves = jax.tree_util.tree_leaves(base)
+        off_leaves = jax.tree_util.tree_leaves(off_batch)
+        assert len(base_leaves) == len(off_leaves)
+        for a, b in zip(base_leaves, off_leaves):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_objective_outputs_bitwise_identical(self):
+        rows, cols, vals, labels, offsets, weights = _data(seed=12)
+        common = dict(
+            dim=40, offsets=offsets, weights=weights, dtype=np.float64
+        )
+        base = SparseLabeledPointBatch.from_coo(
+            rows, cols, vals, labels, **common
+        )
+        off_batch = SparseLabeledPointBatch.from_coo(
+            rows, cols, vals, labels, hybrid=False, **common
+        )
+        so = SparseGLMObjective(
+            loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=0.4
+        )
+        w = jnp.asarray(np.random.default_rng(13).normal(size=40))
+        v1, g1 = jax.jit(so.value_and_gradient)(w, base)
+        v2, g2 = jax.jit(so.value_and_gradient)(w, off_batch)
+        assert float(v1) == float(v2)
+        np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+    def test_shard_without_policy_stays_plain(self):
+        rows, cols, vals, labels, _, _ = _data(seed=14)
+        shard = SparseShard(
+            rows=rows, cols=cols, vals=vals, num_samples=80, feature_dim=40
+        )
+        b = SparseLabeledPointBatch.from_shard(
+            shard, labels, np.zeros(80), np.ones(80)
+        )
+        assert not b.has_hybrid_view
+
+
+class TestLifecycleLockstep:
+    """pad_nnz -> with_offsets -> add_scores_to_offsets keeps every view in
+    lockstep: pads are weight-0 / value-0 / clamped ids and all views still
+    agree after the full residual-update cycle."""
+
+    @pytest.mark.parametrize("name", [
+        "flat", "ell", "ell_narrow", "hybrid", "hybrid_flat_tail",
+    ])
+    def test_round_trip_keeps_views_in_lockstep(self, name):
+        views = _views(seed=17)
+        batch = views[name]
+        rng = np.random.default_rng(18)
+        n, d = batch.num_samples, batch.dim
+        scores = jnp.asarray(rng.normal(scale=0.1, size=n))
+        offsets2 = jnp.asarray(rng.normal(scale=0.1, size=n))
+
+        def cycle(b):
+            padded = b.pad_nnz(b.nnz + 13)
+            assert padded.nnz == b.nnz + 13
+            # hybrid head and ELL block are not on the entry axis: lockstep
+            # means they are UNTOUCHED while the flat tail pads inertly
+            if b.has_hybrid_view:
+                np.testing.assert_array_equal(
+                    np.asarray(padded.hot_vals), np.asarray(b.hot_vals)
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(padded.hot_col_ids), np.asarray(b.hot_col_ids)
+                )
+            if b.has_ell_view:
+                np.testing.assert_array_equal(
+                    np.asarray(padded.ell_vals), np.asarray(b.ell_vals)
+                )
+            assert np.all(np.asarray(padded.values)[b.nnz:] == 0.0)
+            assert np.all(np.diff(np.asarray(padded.row_ids)) >= 0)
+            return padded.with_offsets(offsets2).add_scores_to_offsets(scores)
+
+        got = cycle(batch)
+        want = cycle(views["flat"])
+        np.testing.assert_allclose(
+            np.asarray(got.offsets), np.asarray(want.offsets), rtol=1e-12
+        )
+        so = SparseGLMObjective(
+            loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=0.2
+        )
+        w = jnp.asarray(rng.normal(scale=0.1, size=d))
+        v1, g1 = so.value_and_gradient(w, got)
+        v2, g2 = so.value_and_gradient(w, want)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-11)
+        np.testing.assert_allclose(
+            np.asarray(g1), np.asarray(g2), rtol=1e-9, atol=1e-12
+        )
+
+
+class TestTraining:
+    def test_train_glm_hybrid_matches_dense(self):
+        from photon_ml_tpu.estimators import train_glm
+        from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+
+        rng = np.random.default_rng(20)
+        n, d = 200, 10
+        rows, cols, vals = _skewed_coo(n, d, 1500, seed=21, gamma=3.0)
+        x = np.zeros((n, d))
+        np.add.at(x, (rows, cols), vals)
+        labels = (x @ rng.normal(size=d) > 0).astype(np.float64)
+        hyb = SparseLabeledPointBatch.from_coo(
+            rows, cols, vals, labels, dim=d, dtype=np.float64,
+            hybrid=HybridPolicy(hot_cols=3, pad_multiple=2),
+        )
+        db = LabeledPointBatch(
+            features=jnp.asarray(x), labels=jnp.asarray(labels),
+            offsets=jnp.zeros(n), weights=jnp.ones(n),
+        )
+        for opt in ("LBFGS", "TRON"):
+            kw = dict(
+                optimizer=OptimizerConfig(
+                    optimizer_type=OptimizerType[opt], max_iterations=60
+                ),
+                regularization_weights=[1.0],
+            )
+            ms = train_glm(hyb, TaskType.LOGISTIC_REGRESSION, **kw)
+            md = train_glm(db, TaskType.LOGISTIC_REGRESSION, **kw)
+            np.testing.assert_allclose(
+                np.asarray(ms[1.0].coefficients.means),
+                np.asarray(md[1.0].coefficients.means),
+                atol=2e-5, err_msg=opt,
+            )
+
+
+class TestColumnShardedHybrid:
+    def _shard(self, seed=30, n=96, d=48, nnz=700):
+        rows, cols, vals = _skewed_coo(n, d, nnz, seed)
+        labels = (np.random.default_rng(seed).random(n) < 0.5).astype(
+            np.float64
+        )
+        shard = SparseShard(
+            rows=rows, cols=cols, vals=vals, num_samples=n, feature_dim=d,
+            hybrid_policy=HybridPolicy(coverage=0.5, pad_multiple=4),
+        )
+        return shard, labels
+
+    def test_sharding_invariance_1_vs_8_devices(self):
+        """Hybrid path 1-device == 8-device on the virtual CPU mesh — the
+        "model"-sharded tail AND the hot head (ISSUE 5 satellite)."""
+        from jax.sharding import Mesh
+
+        from photon_ml_tpu.parallel.column_sharded import (
+            ColumnShardedGLMObjective,
+            build_column_sharded_batch,
+            shard_column_batch,
+        )
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        shard, labels = self._shard()
+        n, d = shard.shape
+        loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+        flat = SparseLabeledPointBatch.from_shard(
+            shard, labels, np.zeros(n), np.ones(n), ell=False, hybrid=False
+        )
+        so = SparseGLMObjective(loss, l2_weight=0.4)
+        rng = np.random.default_rng(31)
+        w = jnp.asarray(rng.normal(scale=0.1, size=d))
+        v = jnp.asarray(rng.normal(size=d))
+        want_v, want_g = so.value_and_gradient(w, flat)
+        want_hv = so.hessian_vector(w, v, flat)
+        for num_devices in (1, 8):
+            mesh = Mesh(
+                np.asarray(jax.devices()[:num_devices]).reshape(num_devices),
+                ("model",),
+            )
+            batch = build_column_sharded_batch(shard, labels, num_devices)
+            assert batch.has_hot_head  # inherited from the shard's policy
+            batch = shard_column_batch(batch, mesh)
+            obj = ColumnShardedGLMObjective(loss, mesh, l2_weight=0.4)
+            pad = batch.padded_dim
+            wp = jnp.zeros(pad, dtype=w.dtype).at[:d].set(w)
+            vp = jnp.zeros(pad, dtype=w.dtype).at[:d].set(v)
+            val = obj.value(wp, batch)
+            v2, g2 = obj.value_and_gradient(wp, batch)
+            hv2 = obj.hessian_vector(wp, vp, batch)
+            msg = f"devices={num_devices}"
+            np.testing.assert_allclose(
+                float(val), float(want_v), rtol=1e-10, err_msg=msg
+            )
+            np.testing.assert_allclose(float(v2), float(want_v), rtol=1e-10)
+            np.testing.assert_allclose(
+                np.asarray(g2)[:d], np.asarray(want_g),
+                rtol=1e-9, atol=1e-12, err_msg=msg,
+            )
+            # padding coefficient lanes beyond dim stay untouched (zero grad
+            # contribution from zero data, before L2)
+            np.testing.assert_allclose(
+                np.asarray(hv2)[:d], np.asarray(want_hv),
+                rtol=1e-9, atol=1e-12, err_msg=msg,
+            )
+
+    def test_hybrid_off_column_sharded_identical(self):
+        """hybrid=False on a policy-carrying shard forces the pre-existing
+        layout — no hot head, same results."""
+        from jax.sharding import Mesh
+
+        from photon_ml_tpu.parallel.column_sharded import (
+            ColumnShardedGLMObjective,
+            build_column_sharded_batch,
+            shard_column_batch,
+        )
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        shard, labels = self._shard(seed=33)
+        n, d = shard.shape
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("model",))
+        off = build_column_sharded_batch(shard, labels, 8, hybrid=False)
+        assert not off.has_hot_head
+        on = build_column_sharded_batch(shard, labels, 8)
+        assert on.has_hot_head
+        loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+        obj = ColumnShardedGLMObjective(loss, mesh, l2_weight=0.1)
+        rng = np.random.default_rng(34)
+        w_full = rng.normal(scale=0.1, size=d)
+        results = []
+        for batch in (off, on):
+            batch = shard_column_batch(batch, mesh)
+            wp = jnp.zeros(batch.padded_dim).at[:d].set(jnp.asarray(w_full))
+            _, g = obj.value_and_gradient(wp, batch)
+            results.append(np.asarray(g)[:d])
+        np.testing.assert_allclose(
+            results[0], results[1], rtol=1e-9, atol=1e-12
+        )
+
+
+class TestLayoutTelemetry:
+    def test_hybrid_build_records_gauges_and_resets(self):
+        from photon_ml_tpu.telemetry import default_registry
+        from photon_ml_tpu.telemetry.layout import reset_layout_metrics
+
+        reset_layout_metrics()
+        rows, cols, vals, labels, _, _ = _data(seed=40)
+        SparseLabeledPointBatch.from_coo(
+            rows, cols, vals, labels, dim=40, dtype=np.float64,
+            hybrid=HybridPolicy(coverage=0.5, label="t_shard"),
+        )
+        snap = default_registry().snapshot()
+        gauges = snap["gauges"]
+        for key in ("k_hot", "k_hot_padded", "hot_coverage", "hot_nnz",
+                    "tail_nnz", "tail_width", "hybrid_bytes", "ell_bytes"):
+            assert f"layout/t_shard/{key}" in gauges, key
+        assert 0.0 < gauges["layout/t_shard/hot_coverage"] <= 1.0
+        assert snap["counters"]["layout/t_shard/builds"] == 1
+        # per-run reset (drivers call this next to reset_solver_metrics)
+        reset_layout_metrics()
+        snap = default_registry().snapshot()
+        assert not any(k.startswith("layout/") for k in snap["gauges"])
+        assert not any(k.startswith("layout/") for k in snap["counters"])
+
+    def test_column_sharded_build_records_block_head_gauges(self):
+        from photon_ml_tpu.parallel.column_sharded import (
+            build_column_sharded_batch,
+        )
+        from photon_ml_tpu.telemetry import default_registry
+        from photon_ml_tpu.telemetry.layout import reset_layout_metrics
+
+        reset_layout_metrics()
+        rows, cols, vals = _skewed_coo(64, 48, 500, seed=42)
+        labels = np.zeros(64)
+        shard = SparseShard(
+            rows=rows, cols=cols, vals=vals, num_samples=64, feature_dim=48,
+            hybrid_policy=HybridPolicy(
+                coverage=0.5, pad_multiple=4, label="cs"
+            ),
+        )
+        build_column_sharded_batch(shard, labels, 8)
+        gauges = default_registry().snapshot()["gauges"]
+        assert gauges["layout/cs/block_head_width"] >= 1
+        # replication 1.0 = perfectly spread head; ~num_blocks = clustered
+        assert gauges["layout/cs/block_head_replication"] >= 1.0
+        reset_layout_metrics()
+
+
+class TestCliGrammar:
+    def test_parse_hybrid_keys(self):
+        from photon_ml_tpu.cli.configs import parse_feature_shard_config
+
+        name, cfg = parse_feature_shard_config(
+            "name=g,feature.bags=features,sparse=true,hybrid=true,"
+            "hybrid.hot.cols=512"
+        )
+        assert name == "g" and cfg.hybrid
+        assert cfg.hybrid_hot_cols == 512
+        policy = cfg.hybrid_policy(label="g")
+        assert isinstance(policy, HybridPolicy)
+        assert policy.hot_cols == 512 and policy.label == "g"
+        _, cfg = parse_feature_shard_config(
+            "name=g,feature.bags=features,sparse=true,hybrid=true,"
+            "hybrid.coverage=0.9"
+        )
+        assert cfg.hybrid_policy().coverage == 0.9
+
+    def test_budget_and_coverage_mutually_exclusive(self):
+        from photon_ml_tpu.cli.configs import parse_feature_shard_config
+
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            parse_feature_shard_config(
+                "name=g,feature.bags=features,sparse=true,hybrid=true,"
+                "hybrid.hot.cols=512,hybrid.coverage=0.9"
+            )
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            HybridPolicy(hot_cols=64, coverage=0.9)
+
+    def test_hybrid_defaults_off(self):
+        from photon_ml_tpu.cli.configs import parse_feature_shard_config
+
+        _, cfg = parse_feature_shard_config(
+            "name=g,feature.bags=features,sparse=true"
+        )
+        assert not cfg.hybrid and cfg.hybrid_policy() is None
+
+    def test_hybrid_requires_sparse(self):
+        from photon_ml_tpu.cli.configs import parse_feature_shard_config
+
+        with pytest.raises(ValueError, match="sparse"):
+            parse_feature_shard_config(
+                "name=g,feature.bags=features,hybrid=true"
+            )
+
+    def test_hybrid_knobs_require_hybrid(self):
+        from photon_ml_tpu.cli.configs import parse_feature_shard_config
+
+        with pytest.raises(ValueError, match="hybrid=true"):
+            parse_feature_shard_config(
+                "name=g,feature.bags=features,sparse=true,"
+                "hybrid.hot.cols=128"
+            )
+
+    def test_bad_ranges_rejected(self):
+        from photon_ml_tpu.cli.configs import parse_feature_shard_config
+
+        with pytest.raises(ValueError, match="coverage"):
+            parse_feature_shard_config(
+                "name=g,feature.bags=features,sparse=true,hybrid=true,"
+                "hybrid.coverage=1.5"
+            )
+        with pytest.raises(ValueError, match="hot_cols"):
+            parse_feature_shard_config(
+                "name=g,feature.bags=features,sparse=true,hybrid=true,"
+                "hybrid.hot.cols=0"
+            )
+
+    def test_resolve_policy_forms(self):
+        assert resolve_hybrid_policy(None) is None
+        assert resolve_hybrid_policy(False) is None
+        assert resolve_hybrid_policy(True) == HybridPolicy()
+        p = HybridPolicy(hot_cols=7)
+        assert resolve_hybrid_policy(p) is p
+        with pytest.raises(TypeError):
+            resolve_hybrid_policy("yes")
+
+    def test_reader_attaches_policy(self):
+        from photon_ml_tpu.io.data_reader import (
+            FeatureShardConfiguration,
+            build_index_maps,
+            records_to_game_dataset,
+        )
+
+        records = [
+            {
+                "uid": str(i),
+                "label": float(i % 2),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": 1.0}
+                    for j in range(3)
+                ],
+            }
+            for i in range(6)
+        ]
+        cfgs = {
+            "g": FeatureShardConfiguration(
+                feature_bags=("features",), sparse=True, hybrid=True,
+                hybrid_coverage=0.8,
+            )
+        }
+        imaps = build_index_maps(records, cfgs)
+        result = records_to_game_dataset(records, cfgs, imaps)
+        shard = result.dataset.feature_shards["g"]
+        assert isinstance(shard, SparseShard)
+        assert shard.hybrid_policy is not None
+        assert shard.hybrid_policy.coverage == 0.8
+        assert shard.hybrid_policy.label == "g"
+        batch = result.dataset.fixed_effect_batch("g")
+        assert batch.has_hybrid_view  # inherited through from_shard
+
+    def test_hybrid_incompatible_with_column_sorted(self):
+        rows, cols, vals, labels, _, _ = _data(seed=41)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            SparseLabeledPointBatch.from_coo(
+                rows, cols, vals, labels, dim=40,
+                column_sorted_gradient=True, hybrid=True,
+            )
+
+
+class TestHybridSplitCache:
+    def test_from_shard_reuses_split_across_rebuilds(self):
+        """GAME CD rebuilds the FE batch every sweep; the (shard, policy)
+        split — an O(nnz log nnz) ranking + dense host fill — must compute
+        once, not per sweep (builds counter pins it)."""
+        from photon_ml_tpu.telemetry import default_registry
+        from photon_ml_tpu.telemetry.layout import reset_layout_metrics
+
+        reset_layout_metrics()
+        rows, cols, vals, labels, _, _ = _data(seed=50)
+        shard = SparseShard(
+            rows=rows, cols=cols, vals=vals, num_samples=80, feature_dim=40,
+            hybrid_policy=HybridPolicy(coverage=0.5, label="cache"),
+        )
+        b1 = SparseLabeledPointBatch.from_shard(
+            shard, labels, np.zeros(80), np.ones(80)
+        )
+        b2 = SparseLabeledPointBatch.from_shard(
+            shard, labels, np.ones(80), np.ones(80)  # offsets differ
+        )
+        assert b1.has_hybrid_view and b2.has_hybrid_view
+        counters = default_registry().snapshot()["counters"]
+        assert counters["layout/cache/builds"] == 1
+        np.testing.assert_array_equal(
+            np.asarray(b1.hot_vals), np.asarray(b2.hot_vals)
+        )
+        # a different policy recomputes
+        SparseLabeledPointBatch.from_shard(
+            shard, labels, np.zeros(80), np.ones(80),
+            hybrid=HybridPolicy(hot_cols=2, label="cache"),
+        )
+        counters = default_registry().snapshot()["counters"]
+        assert counters["layout/cache/builds"] == 2
+        reset_layout_metrics()
+
+
+class TestPartitionedIoGuard:
+    def test_hybrid_plus_partitioned_io_rejected_up_front(self):
+        """hybrid + --partitioned-io is rejected at validate() — before any
+        data is read — instead of silently electing per-rank hot sets."""
+        from photon_ml_tpu.cli.configs import CoordinateCliConfig
+        from photon_ml_tpu.cli.game_training_driver import GameTrainingParams
+        from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
+
+        def params(partitioned_io):
+            return GameTrainingParams(
+                input_data_path="/nonexistent",
+                root_output_dir="/nonexistent-out",
+                feature_shards={
+                    "g": FeatureShardConfiguration(
+                        feature_bags=("features",), sparse=True, hybrid=True
+                    )
+                },
+                coordinates={
+                    "fe": CoordinateCliConfig(name="fe", feature_shard="g")
+                },
+                task_type=TaskType.LINEAR_REGRESSION,
+                partitioned_io=partitioned_io,
+            )
+
+        with pytest.raises(ValueError, match="partitioned-io"):
+            params(True).validate()
+        params(False).validate()  # hybrid alone is fine
+
+    def test_scoring_driver_rejects_hybrid_partitioned_io(self):
+        """The scoring driver rejects the combination up front too — before
+        any input decode, not via a late unrelated partitioned-v1 error."""
+        from photon_ml_tpu.cli import game_scoring_driver
+        from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
+
+        with pytest.raises(ValueError, match="partitioned-io"):
+            game_scoring_driver.run(
+                input_data_path="/nonexistent",
+                model_input_dir="/nonexistent-model",
+                output_dir="/nonexistent-out",
+                feature_shards={
+                    "g": FeatureShardConfiguration(
+                        feature_bags=("features",), sparse=True, hybrid=True
+                    )
+                },
+                partitioned_io=True,
+            )
