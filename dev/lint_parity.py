@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static parity-convention lints for photon_ml_tpu (CLAUDE.md conventions).
 
-Seven checks, all pure-AST (no jax import; runs in milliseconds):
+Eight checks, all pure-AST (no jax import; runs in milliseconds):
 
 1. **Docstring citations** — every ``photon_ml_tpu/**/*.py`` module (except
    ``__init__.py`` re-export shims) must carry a module docstring that
@@ -62,6 +62,14 @@ Seven checks, all pure-AST (no jax import; runs in milliseconds):
    jit with traced ids, failing outright. Every call in the device hot-path
    packages ``ops/`` and ``parallel/`` must pass the count explicitly
    (keyword or third positional argument).
+
+8. **Dead-end flag rejections in cli/** — a driver-level guard that
+   rejects a flag COMBINATION ("cannot combine", "mutually exclusive",
+   ...) must tell the operator what to do instead (an actionable verb:
+   use/drop/pass/see/disable/read ...). ISSUE 6 turned the
+   hybrid x --partitioned-io rejection into a supported composition; the
+   rejections that remain must never strand an operator without naming
+   the composing alternative or the flag to change.
 
 Exit status 0 = clean; 1 = violations (printed one per line as
 ``path:lineno: message``). Run from the repo root:
@@ -189,6 +197,9 @@ def check_banned_linalg(root: pathlib.Path) -> list[str]:
 ALLGATHER_ALLOWED = {
     (f"{PACKAGE}/parallel/distributed.py", "_host_scores"),
     (f"{PACKAGE}/parallel/distributed.py", "to_host"),
+    # SPMD lane scheduling: per-LANE scalars (entity-table-sized flags and
+    # traces, never the [n] sample axis), a collective every rank makes
+    (f"{PACKAGE}/algorithm/lane_scheduler.py", "_gather_np"),
 }
 
 
@@ -424,6 +435,59 @@ def check_segment_sum_num_segments(root: pathlib.Path) -> list[str]:
     return problems
 
 
+#: a rejection message is a flag-COMBINATION rejection when it says two
+#: things cannot be used together (check 8)
+COMBINATION_REJECTION_RE = re.compile(
+    r"cannot (?:be )?combined?\b|does not combine|mutually exclusive",
+    re.IGNORECASE,
+)
+
+#: ...and it escapes the dead-end when it names an actionable alternative
+REJECTION_POINTER_RE = re.compile(
+    r"\b(use|instead|drop|pass|see|disable|switch|read|set)\b",
+    re.IGNORECASE,
+)
+
+
+def _literal_message(call: ast.Call) -> str:
+    """Concatenate the string-literal fragments of a call's arguments
+    (implicit adjacent-literal concatenation arrives as one Constant;
+    f-string constant parts ride JoinedStr values)."""
+    parts: list[str] = []
+    for arg in call.args:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                parts.append(node.value)
+    return "".join(parts)
+
+
+def check_cli_dead_end_rejections(root: pathlib.Path) -> list[str]:
+    problems = []
+    for path in sorted((root / PACKAGE / "cli").glob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_guard = (
+                isinstance(fn, ast.Name) and fn.id == "ValueError"
+            ) or (isinstance(fn, ast.Attribute) and fn.attr == "append")
+            if not is_guard:
+                continue
+            msg = _literal_message(node)
+            if not COMBINATION_REJECTION_RE.search(msg):
+                continue
+            if not REJECTION_POINTER_RE.search(msg):
+                problems.append(
+                    f"{rel}:{node.lineno}: flag-combination rejection "
+                    "without a pointer to the composing alternative — tell "
+                    "the operator what to use/drop/change instead (no "
+                    "dead-end rejections; see ISSUE 6 / lint check 8)"
+                )
+    return problems
+
+
 def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
     root = pathlib.Path(root) if root else pathlib.Path(__file__).resolve().parents[1]
     return (
@@ -434,6 +498,7 @@ def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
         + check_broad_excepts(root)
         + check_vmapped_pallas(root)
         + check_segment_sum_num_segments(root)
+        + check_cli_dead_end_rejections(root)
     )
 
 

@@ -190,12 +190,29 @@ class LaneScheduler:
     that frozen lanes report to telemetry. Create one per random-effect
     coordinate and reuse it for every sweep; a fresh instance per call works
     but re-reads the bucket arrays to the host each time.
+
+    ``mesh``: None (default) is the single-process host mode — compaction
+    reads whole bucket arrays. Passing the training mesh switches to the
+    COLLECTIVE-SAFE SPMD mode (the multi-process path): per-lane flags are
+    read through a tiled ``process_allgather`` (a collective every rank
+    makes), compaction is RANK-LOCAL over this rank's addressable bucket
+    shards only, and the compacted stragglers assemble into one fixed
+    ``[num_ranks * R]``-lane rescue block (R a power of two derived from
+    the globally-agreed straggler maximum; ranks with fewer stragglers pad
+    with sentinel lanes) — the same jit signature on every rank every
+    sweep, so SPMD ranks stay in lock-step and ``train_distributed`` no
+    longer falls back on multi-process runs.
     """
 
-    def __init__(self, config: LaneSchedulerConfig, registry=None):
+    def __init__(self, config: LaneSchedulerConfig, registry=None,
+                 mesh=None):
         self.config = config
+        self.mesh = mesh
         self._registry = registry
         self._host_blocks: list[dict[str, np.ndarray]] | None = None
+        #: SPMD mode: (rank-local field slices, base row, owner map) per
+        #: bucket — built lazily like the host cache
+        self._spmd_blocks: list[dict] | None = None
         #: bool [table rows]; grows monotonically until the final sweep
         self.frozen_rows: np.ndarray | None = None
         #: per-block (value, gradient_norm) carried for lanes a later sweep
@@ -205,6 +222,39 @@ class LaneScheduler:
         self.last_stats: SchedulerStats | None = None
         self._warned_no_live_stop = False
         self._num_rows: int | None = None
+
+    # -- SPMD (collective-safe) helpers --------------------------------------
+
+    def _gather_np(self, x):
+        """Host copy of a per-lane device array — or a PYTREE of them,
+        gathered in ONE collective (per-call dispatch is ~100 ms on this
+        platform; never loop scalars through separate gathers). SPMD mode
+        on a multi-process run allgathers (a COLLECTIVE — every rank
+        calls it for every solve, by construction of the shared solve()
+        flow); otherwise a plain device read."""
+        import jax
+
+        if self.mesh is not None and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            x = multihost_utils.process_allgather(x, tiled=True)
+        return jax.tree_util.tree_map(np.asarray, x)
+
+    def _spmd_cache(self, blocks: Sequence[Mapping[str, Array]]):
+        """Rank-local addressable slices + global owner maps, built once
+        (buckets are immutable across sweeps)."""
+        if self._spmd_blocks is None:
+            self._spmd_blocks = [
+                _rank_local_block(b) for b in blocks
+            ]
+        if len(self._spmd_blocks) != len(blocks):
+            raise ValueError(
+                "LaneScheduler is per-coordinate state: it was built over "
+                f"{len(self._spmd_blocks)} buckets but is now asked to "
+                f"schedule {len(blocks)} — create one scheduler per "
+                "random-effect coordinate"
+            )
+        return self._spmd_blocks
 
     def registry(self):
         if self._registry is None:
@@ -331,8 +381,12 @@ class LaneScheduler:
             frozen = np.zeros(num_rows, dtype=bool)
 
         # host lane bookkeeping (entity_rows only — cheap; the full host
-        # bucket cache is built lazily, first time compaction is needed)
-        rows_h = [np.asarray(b["entity_rows"]).astype(np.int64) for b in blocks]
+        # bucket cache is built lazily, first time compaction is needed).
+        # SPMD mode allgathers, so every rank sees the same global arrays.
+        rows_h = [
+            r.astype(np.int64)
+            for r in self._gather_np(tuple(b["entity_rows"] for b in blocks))
+        ]
         valid_h = [(r >= 0) & (r < num_rows) for r in rows_h]
         if freezing and not final_sweep and frozen.any():
             skip_h = [
@@ -365,27 +419,58 @@ class LaneScheduler:
         def scatter_back(trace, delta, wnorm, blk, lane):
             """Write one solved block's per-lane scalars back into the
             per-original-bucket output arrays; (blk, lane) name the source
-            of each REAL lane (compacted-block padding lanes are beyond
-            len(lane) and never land here). Iterations and deltas ADD
-            (probe + rescue accumulate); the rest overwrite."""
-            it = np.asarray(trace.iterations)
-            rs = np.asarray(trace.reason)
-            vl = np.asarray(trace.value)
-            gn = np.asarray(trace.gradient_norm)
-            dl = np.asarray(delta)
-            wn = np.asarray(wnorm)
-            m = len(lane)
+            of each REAL lane (padding lanes carry blk == -1 and never
+            land anywhere). Iterations and deltas ADD (probe + rescue
+            accumulate); the rest overwrite. SPMD mode reads the trace
+            through the allgather — a collective every rank makes."""
+            it, rs, vl, gn, dl, wn = self._gather_np(
+                (trace.iterations, trace.reason, trace.value,
+                 trace.gradient_norm, delta, wnorm)
+            )
             for i in range(len(blocks)):
-                mask = blk[:m] == i
+                mask = blk == i
                 if not mask.any():
                     continue
-                li = lane[:m][mask]
-                iters_out[i][li] += it[:m][mask]
-                reason_out[i][li] = rs[:m][mask]
-                value_out[i][li] = vl[:m][mask]
-                gnorm_out[i][li] = gn[:m][mask]
-                delta_out[i][li] += dl[:m][mask]
-                wnorm_out[i][li] = wn[:m][mask]
+                li = lane[mask]
+                iters_out[i][li] += it[mask]
+                reason_out[i][li] = rs[mask]
+                value_out[i][li] = vl[mask]
+                gnorm_out[i][li] = gn[mask]
+                delta_out[i][li] += dl[mask]
+                wnorm_out[i][li] = wn[mask]
+
+        def run_compacted(lane_masks, o: OptimizerConfig, tab):
+            """Solve only the masked lanes, grouped by (cap, d): host-mode
+            compaction over whole bucket arrays, or rank-local SPMD
+            compaction into fixed [num_ranks * R] blocks (the collective-
+            safe path). Returns (table, lanes solved, blocks run)."""
+            solved = 0
+            n_blocks = 0
+            if self.mesh is not None:
+                local = self._spmd_cache(blocks)
+                # _group_by_shape only reads shapes — fine on device blocks
+                for picks in _group_by_shape(blocks, lane_masks):
+                    tab, n = self._run_spmd_block(
+                        picks, local, o, run_block, tab, scatter_back
+                    )
+                    solved += n
+                    n_blocks += 1
+                return tab, solved, n_blocks
+            host = self._host_cache(blocks)
+            for picks in _group_by_shape(host, lane_masks):
+                pad_to = _pow2_lanes(sum(len(l) for _, l in picks))
+                fields, src_blk, src_lane = compact_lane_blocks(
+                    host, picks, pad_to=pad_to, sentinel_row=SENTINEL_ROW,
+                )
+                tab, trace, delta, wnorm = run_block(
+                    _device_block(fields), o, tab
+                )
+                scatter_back(trace, delta, wnorm,
+                             _pad_minus1(src_blk, pad_to),
+                             _pad_zeros(src_lane, pad_to))
+                solved += len(src_lane)
+                n_blocks += 1
+            return tab, solved, n_blocks
 
         # -- probe phase ----------------------------------------------------
         any_skip = any(s.any() for s in skip_h)
@@ -394,29 +479,14 @@ class LaneScheduler:
             # unscheduled path compiles
             for i, b in enumerate(blocks):
                 table, trace, delta, wnorm = run_block(b, probe_opt, table)
-                blk = np.full(e_sizes[i], i, np.int32)
+                blk = np.where(solve_h[i], i, -1).astype(np.int32)
                 lane = np.arange(e_sizes[i], dtype=np.int64)
-                real = solve_h[i]
-                scatter_back(
-                    _np_trace_subset(trace, real), _np_subset(delta, real),
-                    _np_subset(wnorm, real), blk[real], lane[real],
-                )
+                scatter_back(trace, delta, wnorm, blk, lane)
             stats.lanes_probed = int(sum(s.sum() for s in solve_h))
         else:
             # active-set compaction: only unfrozen lanes probe
-            host = self._host_cache(blocks)
-            groups = _group_by_shape(host, solve_h)
-            for picks in groups:
-                fields, src_blk, src_lane = compact_lane_blocks(
-                    host, picks,
-                    pad_to=_pow2_lanes(sum(len(l) for _, l in picks)),
-                    sentinel_row=SENTINEL_ROW,
-                )
-                table, trace, delta, wnorm = run_block(
-                    _device_block(fields), probe_opt, table
-                )
-                scatter_back(trace, delta, wnorm, src_blk, src_lane)
-                stats.lanes_probed += len(src_lane)
+            table, probed, _ = run_compacted(solve_h, probe_opt, table)
+            stats.lanes_probed = probed
 
         # -- rescue phase ---------------------------------------------------
         rescue_h = [
@@ -425,19 +495,10 @@ class LaneScheduler:
         ]
         n_rescue = int(sum(r.sum() for r in rescue_h))
         if rescue_opt is not None and n_rescue:
-            host = self._host_cache(blocks)
-            groups = _group_by_shape(host, rescue_h)
-            for picks in groups:
-                fields, src_blk, src_lane = compact_lane_blocks(
-                    host, picks,
-                    pad_to=_pow2_lanes(sum(len(l) for _, l in picks)),
-                    sentinel_row=SENTINEL_ROW,
-                )
-                table, trace, delta, wnorm = run_block(
-                    _device_block(fields), rescue_opt, table
-                )
-                scatter_back(trace, delta, wnorm, src_blk, src_lane)
-                stats.rescue_blocks += 1
+            table, _, rescue_blocks = run_compacted(
+                rescue_h, rescue_opt, table
+            )
+            stats.rescue_blocks += rescue_blocks
             stats.lanes_rescued = n_rescue
 
         # -- active-set update ----------------------------------------------
@@ -485,6 +546,101 @@ class LaneScheduler:
             table = _strip_scratch(table)
         return table, traces, stats
 
+    def _run_spmd_block(self, picks, local, opt: OptimizerConfig,
+                        run_block, table, scatter_back):
+        """One same-(cap, d) group's compacted solve, collective-safe.
+
+        Every rank computes the identical global layout (per-rank straggler
+        assignment from the owner maps, R from the global per-rank maximum),
+        builds ONLY its own rank's [R]-lane block from its addressable
+        shard rows (sentinel-padding the spare lanes), and assembles the
+        global [num_ranks * R] block via ``assemble_partitioned`` — so the
+        solve jit (a collective SPMD program) sees the same signature on
+        every rank, every sweep. Returns (table, lanes solved).
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from photon_ml_tpu.parallel.multihost import assemble_partitioned
+
+        num_ranks = jax.process_count()
+        my_rank = jax.process_index()
+        data_axis = int(self.mesh.shape["data"])
+        if data_axis % num_ranks:
+            raise ValueError(
+                f"SPMD lane scheduling: mesh data axis {data_axis} must be "
+                f"a multiple of the process count {num_ranks}"
+            )
+        dpr = data_axis // num_ranks  # devices per rank along "data"
+
+        per_rank: list[list[tuple[int, np.ndarray]]] = [
+            [] for _ in range(num_ranks)
+        ]
+        for b, lanes in picks:
+            owner = local[b]["owner"]
+            for r in range(num_ranks):
+                sel = lanes[owner[lanes] == r]
+                if len(sel):
+                    per_rank[r].append((b, sel))
+        max_count = max(
+            sum(len(l) for _, l in pr) for pr in per_rank
+        )
+        rescue_lanes = _pow2_lanes(max(max_count, dpr))
+        # round up to a multiple of the per-rank device count so the fixed
+        # [num_ranks * rescue_lanes] block shards evenly over "data" on a
+        # non-power-of-two dpr too (one value per pow2 tier, so the jit
+        # signature set stays bounded; spare lanes are sentinel-padded)
+        rescue_lanes = -(-rescue_lanes // dpr) * dpr
+
+        # the global (block, lane) source map — identical on every rank
+        src_blk = np.full(num_ranks * rescue_lanes, -1, np.int32)
+        src_lane = np.zeros(num_ranks * rescue_lanes, np.int64)
+        for r in range(num_ranks):
+            j = r * rescue_lanes
+            for b, lanes in per_rank[r]:
+                src_blk[j: j + len(lanes)] = b
+                src_lane[j: j + len(lanes)] = lanes
+                j += len(lanes)
+
+        # THIS rank's block only, from its addressable shard rows
+        loc_picks = [
+            (b, lanes - local[b]["base"]) for b, lanes in per_rank[my_rank]
+        ]
+        for (b, lanes), (_, loc) in zip(per_rank[my_rank], loc_picks):
+            if len(loc) and (loc.min() < 0 or loc.max() >= local[b]["size"]):
+                raise ValueError(
+                    f"bucket {b}: owned lanes fall outside this rank's "
+                    "addressable shard — the mesh 'data' axis must be "
+                    "process-contiguous"
+                )
+        if loc_picks:
+            fields, _, _ = compact_lane_blocks(
+                [l["fields"] for l in local], loc_picks,
+                pad_to=rescue_lanes, sentinel_row=SENTINEL_ROW,
+            )
+        else:
+            fields = _sentinel_block(
+                local[picks[0][0]]["fields"], rescue_lanes
+            )
+
+        specs = {
+            "features": P("data", None, None),
+            "labels": P("data", None),
+            "weights": P("data", None),
+            "sample_rows": P("data", None),
+            "entity_rows": P("data"),
+            "col_index": P("data", None),
+        }
+        assembled = {
+            k: assemble_partitioned(
+                {my_rank: v}, self.mesh, specs[k], num_ranks
+            )
+            for k, v in fields.items()
+        }
+        table, trace, delta, wnorm = run_block(assembled, opt, table)
+        scatter_back(trace, delta, wnorm, src_blk, src_lane)
+        return table, int((src_blk >= 0).sum())
+
     def _record(self, stats: SchedulerStats, traces: Sequence[LaneTrace]):
         """Feed the scheduler counters and the solver/lane_iters histogram
         (telemetry/registry.py conventions; journaled by the drivers'
@@ -509,18 +665,118 @@ class LaneScheduler:
             )
 
 
-def _np_subset(arr, mask: np.ndarray) -> np.ndarray:
-    return np.asarray(arr)[mask]
+def make_schedulers(re_specs, mesh=None, registry=None) -> dict:
+    """One LaneScheduler per RE spec whose OptimizerConfig carries a
+    scheduler config — the ONE mode-selection rule shared by
+    ``train_distributed`` and ``train_partitioned``: collective-safe SPMD
+    mode on multi-process runs (requires the training mesh), single-process
+    host mode otherwise (bit-for-bit the pre-SPMD behavior)."""
+    import jax
+
+    spmd_mesh = mesh if jax.process_count() > 1 else None
+    return {
+        s.re_type: LaneScheduler(
+            s.optimizer.scheduler, registry=registry, mesh=spmd_mesh
+        )
+        for s in re_specs
+        if s.optimizer.scheduler is not None
+    }
 
 
-def _np_trace_subset(trace: LaneTrace, mask: np.ndarray) -> LaneTrace:
-    return LaneTrace(
-        iterations=_np_subset(trace.iterations, mask),
-        reason=_np_subset(trace.reason, mask),
-        value=_np_subset(trace.value, mask),
-        gradient_norm=_np_subset(trace.gradient_norm, mask),
-        valid=_np_subset(trace.valid, mask),
-    )
+def _pad_minus1(arr: np.ndarray, length: int) -> np.ndarray:
+    out = np.full(length, -1, np.int32)
+    out[: len(arr)] = arr
+    return out
+
+
+def _pad_zeros(arr: np.ndarray, length: int) -> np.ndarray:
+    out = np.zeros(length, np.int64)
+    out[: len(arr)] = arr
+    return out
+
+
+def _sentinel_block(sample_fields: Mapping[str, np.ndarray],
+                    lanes: int) -> dict[str, np.ndarray]:
+    """An all-padding [lanes] block shaped like ``sample_fields`` — what a
+    rank with zero stragglers contributes (weight 0 / sample_rows -1 /
+    entity_rows sentinel: inert in the solve, dropped by the scatter)."""
+    out = {}
+    for k, arr in sample_fields.items():
+        if k == "entity_rows":
+            out[k] = np.full(lanes, SENTINEL_ROW, np.int32)
+        elif k == "sample_rows":
+            out[k] = np.full((lanes,) + arr.shape[1:], -1, arr.dtype)
+        else:
+            out[k] = np.zeros((lanes,) + arr.shape[1:], arr.dtype)
+    return out
+
+
+def _addressable_rows(arr) -> tuple[int, int, np.ndarray]:
+    """(base, stop, rows) — the contiguous lane-axis slice of ``arr`` this
+    process can read. Model-axis replicas (same row range on several local
+    devices) dedup; a non-contiguous addressable range is rejected (SPMD
+    lane scheduling requires the standard process-contiguous 'data'
+    layout, the same contract as multihost.assemble_partitioned)."""
+    arr = jnp.asarray(arr)
+    pieces: dict[tuple[int, int], object] = {}
+    for s in arr.addressable_shards:
+        sl = s.index[0] if s.index else slice(None)
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(arr.shape[0]) if sl.stop is None else int(sl.stop)
+        pieces.setdefault((start, stop), s)
+    spans = sorted(pieces)
+    expect = spans[0][0]
+    datas = []
+    for start, stop in spans:
+        if start != expect:
+            raise ValueError(
+                "addressable shards are not contiguous along the lane "
+                "axis; SPMD lane scheduling needs a process-contiguous "
+                "'data' axis"
+            )
+        expect = stop
+        datas.append(np.asarray(pieces[(start, stop)].data))
+    return spans[0][0], expect, np.concatenate(datas, axis=0)
+
+
+def _owner_map(arr) -> np.ndarray:
+    """[lanes] int32: the lowest process index holding each lane — the
+    rank that compacts it. Identical on every rank (computed from the
+    GLOBAL device->index map, not from addressable state)."""
+    arr = jnp.asarray(arr)
+    owner = np.full(int(arr.shape[0]), np.iinfo(np.int32).max, np.int32)
+    for dev, idx in arr.sharding.devices_indices_map(arr.shape).items():
+        sl = idx[0] if idx else slice(None)
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(arr.shape[0]) if sl.stop is None else int(sl.stop)
+        p = np.int32(getattr(dev, "process_index", 0))
+        owner[start:stop] = np.minimum(owner[start:stop], p)
+    return owner
+
+
+def _rank_local_block(b: Mapping[str, Array]) -> dict:
+    """SPMD cache entry for one bucket: this rank's addressable field
+    slices (one device-to-host read each, amortized across sweeps), their
+    common base row, and the global lane->owner-rank map."""
+    fields = {}
+    base = size = None
+    for k, v in b.items():
+        lo, hi, rows = _addressable_rows(v)
+        if base is None:
+            base, size = lo, hi - lo
+        elif (lo, hi - lo) != (base, size):
+            raise ValueError(
+                f"bucket field '{k}' spans rows [{lo}, {hi}) but other "
+                f"fields span [{base}, {base + size}) — bucket fields "
+                "must share one lane-axis sharding"
+            )
+        fields[k] = rows
+    return {
+        "fields": fields,
+        "base": int(base),
+        "size": int(size),
+        "owner": _owner_map(b["entity_rows"]),
+    }
 
 
 def _device_block(fields: dict[str, np.ndarray]) -> dict[str, Array]:

@@ -88,20 +88,14 @@ def run(
         )
     partitioned = partitioned_io and jax.process_count() > 1
     if partitioned and not (distributed or mesh_shape):
-        raise ValueError("--partitioned-io requires --distributed or --mesh")
-    if partitioned_io and any(
-        getattr(cfg, "hybrid", False)
-        for cfg in (feature_shards or {}).values()
-    ):
-        # same up-front rejection as the training driver: hot-column
-        # ranking is a GLOBAL nnz statistic — per-rank partitioned blocks
-        # would each elect a different head before scoring even starts
         raise ValueError(
-            "hybrid feature shards cannot combine with --partitioned-io "
-            "(hot-column selection is a global statistic; per-rank blocks "
-            "would disagree on the head) — drop hybrid=true or read "
-            "unpartitioned"
+            "--partitioned-io requires --distributed or --mesh (the "
+            "partitioned blocks feed a mesh's addressable shards)"
         )
+    # hybrid x --partitioned-io composes since ISSUE 6: the partitioned
+    # reader resolves one GLOBAL hot head over the metadata exchange, so
+    # every rank's layout agrees (io/partitioned_reader.py); scores are
+    # layout-independent either way.
     from photon_ml_tpu.telemetry import RunJournal
     from photon_ml_tpu.util.timed import reset_timings, timing_summary
 
@@ -299,7 +293,8 @@ def _run_inner(
                 model, mesh, fe_feature_sharded=fe_feature_sharded
             )
             local_scores = scorer.score_partitioned(
-                {partition.rank: data.dataset}, partition
+                {partition.rank: data.dataset}, partition,
+                exchange=exchange,
             )[partition.rank]
         n_local = partition.local_n
         with Timed("save scores"):

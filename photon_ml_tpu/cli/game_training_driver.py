@@ -162,20 +162,11 @@ class GameTrainingParams:
             )
         if self.max_restarts < 0:
             problems.append("--max-restarts must be >= 0")
-        if self.partitioned_io and any(
-            getattr(cfg, "hybrid", False)
-            for cfg in self.feature_shards.values()
-        ):
-            # rejected up front, not silently wrong: the hot-column ranking
-            # is a GLOBAL nnz statistic, and per-rank partitioned blocks
-            # would each elect a different head (different k_hot/hot sets
-            # per rank feeding one collective program)
-            problems.append(
-                "hybrid feature shards cannot combine with --partitioned-io"
-                " (hot-column selection is a global statistic; per-rank "
-                "blocks would disagree on the head) — drop hybrid=true or "
-                "read unpartitioned"
-            )
+        # hybrid x --partitioned-io is a SUPPORTED composition since ISSUE
+        # 6: the hot-column ranking is a global nnz statistic, so the
+        # partitioned reader ships per-rank histograms through the metadata
+        # exchange and every rank resolves the SAME head
+        # (io/partitioned_reader._resolve_global_sparse_layout)
         sequence = self.update_sequence or tuple(self.coordinates.keys())
         for cid in sequence:
             if cid not in self.coordinates:

@@ -154,7 +154,9 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
         # data is read.
         raise ValueError(
             "coefficient box constraints cannot combine with feature "
-            "normalization"
+            "normalization (bounds are stated in original feature space; "
+            "the solvers work in normalized space) — drop "
+            "normalization.type or the box constraints"
         )
     os.makedirs(params.output_dir, exist_ok=True)
     # per-run phase timings + solver/layout tallies (sweeps may call run()

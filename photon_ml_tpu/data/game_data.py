@@ -172,8 +172,11 @@ def _pad_game_dataset_rows(dataset: GameDataset, pad: int) -> GameDataset:
     host_cache = {"labels": labels_h, "offsets": offsets_h, "weights": weights_h}
     for k, v in dataset.feature_shards.items():
         if isinstance(v, SparseShard):
+            # _coalesced survives (entries unchanged) but the hybrid split
+            # caches a dense [n, k_hot] head whose n is now stale
             shards[k] = dataclasses.replace(
-                v, num_samples=v.num_samples + pad, _device=None
+                v, num_samples=v.num_samples + pad, _device=None,
+                _hybrid_cache=None,
             )
         else:
             arr = np.asarray(v)

@@ -330,10 +330,11 @@ class GameEstimator:
     def _check_partition_supported(
         self, sequence, locked, dataset, validation_dataset
     ) -> None:
-        """The partitioned-training v1 surface (dense FE + IDENTITY REs,
-        no global-statistics riders) — anything outside it must fail
-        loudly BEFORE any rank-local work could silently diverge from the
-        full-read semantics."""
+        """The partitioned-training surface (dense or sparse/hybrid primary
+        FE + dense IDENTITY REs, scheduled or not, no global-statistics
+        riders) — anything outside it must fail loudly BEFORE any
+        rank-local work could silently diverge from the full-read
+        semantics."""
         problems: list[str] = []
         if self.mesh is None:
             problems.append("a mesh is required")
@@ -350,6 +351,16 @@ class GameEstimator:
             )
         if self.checkpointer is not None:
             problems.append("checkpointing")
+        # the primary FE (first trainable fixed effect in the sequence) is
+        # the one coordinate that may be sparse — its hybrid head / ELL
+        # width were made globally consistent by the partitioned reader
+        primary_fe = next(
+            (cid for cid in sequence
+             if cid not in locked and isinstance(
+                 self.coordinate_configs[cid], FixedEffectCoordinateConfig
+             )),
+            None,
+        )
         for cid in sequence:
             cfg = self.coordinate_configs[cid]
             if isinstance(cfg, MatrixFactorizationCoordinateConfig):
@@ -364,16 +375,15 @@ class GameEstimator:
                 )
             if cfg.optimization.down_sampling_rate < 1.0:
                 problems.append(f"down-sampling on '{cid}'")
-            if cfg.optimization.optimizer.scheduler is not None:
-                # lane-scheduler host compaction reads bucket shards, which
-                # a multi-process partitioned run cannot address
-                problems.append(f"lane scheduling on '{cid}'")
             if cfg.optimization.compute_variance:
                 problems.append(f"compute_variance on '{cid}'")
-            if isinstance(
+            if cid != primary_fe and isinstance(
                 dataset.feature_shards.get(cfg.feature_shard_id), SparseShard
             ):
-                problems.append(f"sparse feature shard on '{cid}'")
+                problems.append(
+                    f"sparse feature shard on '{cid}' (only the primary "
+                    "fixed effect may be sparse)"
+                )
         if problems:
             raise ValueError(
                 "partitioned training does not support: "
@@ -742,7 +752,10 @@ class GameEstimator:
             # this rank contributes only its local block; the fused step
             # sees the assembled global arrays. No validation/metric riders
             # (the guard rejected them) — score + evaluate partitioned via
-            # parallel/scoring.py instead.
+            # parallel/scoring.py instead. Scheduled RE coordinates compose:
+            # multi-process runs get the collective-safe SPMD scheduler.
+            from photon_ml_tpu.algorithm.lane_scheduler import make_schedulers
+
             result = train_partitioned(
                 program,
                 {partition.info.rank: (train_ds, re_datasets)},
@@ -752,6 +765,7 @@ class GameEstimator:
                 state=warm_state,
                 fe_feature_sharded=self.fe_feature_sharded,
                 check_finite=self.check_finite,
+                schedulers=make_schedulers(re_specs, mesh=self.mesh) or None,
             )
         else:
             result = train_distributed(

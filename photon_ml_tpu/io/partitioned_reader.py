@@ -45,6 +45,7 @@ counters record bytes decoded vs the total input (telemetry/io_counters).
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import hashlib
 import itertools
@@ -207,7 +208,7 @@ def _remap_sparse(shard: SparseShard, local_map: IndexMap,
     new_cols = gidx[cols] if len(cols) else cols
     return dataclasses.replace(
         shard, cols=new_cols, feature_dim=global_map.size,
-        _device=None, _coalesced=None,
+        _device=None, _coalesced=None, _hybrid_cache=None,
     )
 
 
@@ -431,6 +432,13 @@ def read_partitioned(
             provided_vocabs=entity_vocabs,
         )
 
+    # ---- globally consistent sparse layout decisions (hybrid hot head,
+    # ELL width): layout statistics are GLOBAL, a rank's 1/P block must
+    # never elect its own (arXiv:2004.02414's per-partition-statistics-vs-
+    # global-solution pitfall, solved the same way the vocabs were)
+    result = _resolve_global_sparse_layout(result, exchange, tag,
+                                           pad_multiple=pad_multiple)
+
     # ---- uid-less inputs: shift the reader's auto-assigned row-number
     # uids into the global row space (the full read numbers 0..N-1)
     if _schema_lacks_uid(files):
@@ -540,6 +548,183 @@ def _remap_to_global_maps(
         ),
         index_maps=dict(global_maps),
         intercept_indices=intercepts,
+    )
+
+
+def _pack_i64(a: np.ndarray) -> str:
+    """int64 array -> base64 string for the JSON exchange payloads: the
+    hot-ranking histograms carry one entry per distinct column a rank
+    observed (millions at giant d), and a per-int Python list would cost
+    tens of MB of JSON per rank through the KV transport."""
+    return base64.b64encode(
+        np.ascontiguousarray(a, dtype="<i8").tobytes()
+    ).decode("ascii")
+
+
+def _unpack_i64(s: str) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(s), dtype="<i8")
+
+
+def _resolve_global_sparse_layout(
+    local: ReadResult,
+    exchange,
+    tag: str,
+    *,
+    pad_multiple: int = 1,
+) -> ReadResult:
+    """Make every sparse shard's LAYOUT decisions globally consistent:
+
+    - **Hybrid hot head** (shards carrying a ``hybrid_policy``): each rank
+      ships its local per-column nnz histogram — already in the GLOBAL
+      column space after the index-map remap — through one metadata
+      allgather; every rank sums the histograms and applies the identical
+      ``rank_hot_columns`` sizing rule, so the resolved ``hot_ids`` (and
+      therefore the [n, k_hot] head shape, column order, and
+      parallel/column_sharded.py's per-block hot sub-blocks) agree bitwise
+      across ranks. This is exactly how the entity vocabs were made
+      globally consistent above, and the reason hybrid now composes with
+      --partitioned-io instead of being rejected.
+    - **ELL width + flat overflow length** (every sparse shard): each rank
+      ships its post-hybrid-split per-row-count histogram (row counts over
+      TRUE local rows) in the same allgather; the agreed width applies the
+      full read's EXACT auto rule (``_ell_auto_width_from_hist`` — the
+      98th-percentile/waste-cap rule evaluated on the summed histogram,
+      with the zero-count rows train_distributed's mesh padding would
+      append mirrored in, since the full read picks its width AFTER that
+      padding), so the composed ELL/overflow split is bitwise what the
+      unpartitioned read would build. Every rank's overflow beyond that width is also
+      derivable from the same gathered histograms, so all ranks agree a
+      common ``flat_block_nnz`` (max overflow, rounded up to
+      ``pad_multiple`` so device shards never cross rank blocks) with no
+      extra exchange — parallel/distributed._assemble_sparse_fe assembles
+      that fixed-length flat tail across ranks. (Hybrid shards take two
+      allgathers per shard: the tail histogram depends on the globally
+      resolved hot head.)
+
+    Histograms ride the existing exchange deadlines: a rank that never
+    publishes surfaces as a rank-attributed ExchangeTimeout, never a hang
+    (tests/test_resilience.py pins it with a WithholdingExchange).
+    """
+    ds = local.dataset
+    sparse_shards = {
+        k: v for k, v in ds.feature_shards.items()
+        if isinstance(v, SparseShard)
+    }
+    if not sparse_shards:
+        return local
+    from photon_ml_tpu.data.sparse_batch import (
+        _ell_auto_width_from_hist,
+        rank_hot_columns,
+    )
+    from photon_ml_tpu.telemetry.layout import record_global_hot_ranking
+
+    new_shards = dict(ds.feature_shards)
+    for name in sorted(sparse_shards):  # fixed order: SPMD call discipline
+        shard = sparse_shards[name]
+        rows, cols, _ = shard.coalesced()
+        rows = np.asarray(rows)
+        cols = np.asarray(cols)
+        policy = shard.hybrid_policy
+        hot = None
+        if policy is not None and policy.hot_ids is None:
+            uniq, cnt = (
+                np.unique(cols, return_counts=True) if len(cols)
+                else (np.zeros(0, np.int64), np.zeros(0, np.int64))
+            )
+            # packed int64 bytes, not per-int Python lists: unique columns
+            # reach millions at giant d, and a list-of-ints JSON payload
+            # would cost tens of MB per rank through the KV transport
+            gathered_hist = exchange.allgather(
+                f"hybrid_hot/{tag}/{name}",
+                {"cols": _pack_i64(uniq), "counts": _pack_i64(cnt)},
+            )
+            all_cols = np.concatenate(
+                [_unpack_i64(g["cols"]) for g in gathered_hist]
+            )
+            all_cnts = np.concatenate(
+                [_unpack_i64(g["counts"]) for g in gathered_hist]
+            )
+            # sum per-rank histograms into the global one (sorted by id,
+            # exactly what np.unique over the full read would produce)
+            guniq, inv = np.unique(all_cols, return_inverse=True)
+            gcnt = np.zeros(len(guniq), dtype=np.int64)
+            np.add.at(gcnt, inv, all_cnts)
+            gnnz = int(gcnt.sum())
+            hot = rank_hot_columns(guniq, gcnt, gnnz, policy)
+            if len(hot) == 0:
+                raise ValueError(
+                    f"feature shard '{name}': hybrid=true but no rank "
+                    "decoded any nonzero entry — nothing to rank"
+                )
+            policy = dataclasses.replace(
+                policy, hot_ids=tuple(int(c) for c in hot)
+            )
+            record_global_hot_ranking(
+                policy.label, k_hot=len(hot), global_nnz=gnnz,
+                num_ranks=exchange.num_ranks,
+            )
+        elif policy is not None:
+            hot = np.asarray(policy.hot_ids, dtype=np.int64)
+
+        # agreed ELL width + flat overflow length: the full read's EXACT
+        # auto rule evaluated on the summed per-row-count histograms
+        if hot is not None and len(cols):
+            pos = np.searchsorted(hot, cols)
+            is_hot = hot[np.minimum(pos, len(hot) - 1)] == cols
+            tail_rows = rows[~is_hot]
+        else:
+            tail_rows = rows
+        n_local = int(shard.num_samples)
+        counts = (
+            np.bincount(tail_rows, minlength=n_local).astype(np.int64)
+            if n_local else np.zeros(0, np.int64)
+        )
+        freq = np.bincount(counts) if n_local else np.zeros(1, np.int64)
+        gathered_rows = exchange.allgather(
+            f"ell_width/{tag}/{name}",
+            {"freq": freq.astype(int).tolist(), "n": n_local},
+        )
+        depth = max(len(g["freq"]) for g in gathered_rows)
+        gfreq = np.zeros(depth, dtype=np.int64)
+        rank_freqs = []
+        for g in gathered_rows:
+            f = np.zeros(depth, dtype=np.int64)
+            f[: len(g["freq"])] = np.asarray(g["freq"], dtype=np.int64)
+            rank_freqs.append(f)
+            gfreq += f
+        gn = int(sum(int(g["n"]) for g in gathered_rows))
+        widths = np.arange(depth, dtype=np.int64)
+        gnnz = int((gfreq * widths).sum())
+        # the full read computes its auto width AFTER train_distributed
+        # pads the sample axis to a mesh-data-axis multiple (data_axis =
+        # pad_multiple * num_ranks, the documented read contract): mirror
+        # those zero-count padding rows in the histogram, or the agreed
+        # width drifts from the full read's whenever the global row count
+        # is not a mesh multiple (the 0.98 quantile shifts down as zero
+        # rows are appended)
+        data_axis = pad_multiple * exchange.num_ranks
+        pad0 = (-gn) % data_axis
+        if pad0:
+            gfreq[0] += pad0
+            gn += pad0
+        width = _ell_auto_width_from_hist(gfreq, gn, gnnz)
+        # per-rank overflow beyond the agreed width, from the SAME
+        # gathered histograms — every rank lands on one flat block length
+        flat = max(
+            int((f * np.maximum(widths - width, 0)).sum())
+            for f in rank_freqs
+        )
+        if flat:
+            flat = -(-flat // pad_multiple) * pad_multiple
+        new_shards[name] = dataclasses.replace(
+            shard, hybrid_policy=policy, ell_width=width,
+            flat_block_nnz=int(flat),
+            _device=None, _hybrid_cache=None,
+        )
+    return ReadResult(
+        dataset=dataclasses.replace(ds, feature_shards=new_shards),
+        index_maps=local.index_maps,
+        intercept_indices=local.intercept_indices,
     )
 
 

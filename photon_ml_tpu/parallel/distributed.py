@@ -829,8 +829,10 @@ class GameTrainProgram:
         cached jitted program so the scheduler can read per-lane converged
         flags between the probe and rescue solves and compact only the
         unconverged lanes. Strictly opt-in — ``train_distributed`` uses it
-        only when an RE spec's OptimizerConfig carries a scheduler config;
-        single-process only (host compaction reads bucket shards).
+        only when an RE spec's OptimizerConfig carries a scheduler config.
+        Multi-process runs use schedulers built with the training mesh
+        (``make_schedulers``): rank-local compaction into a fixed
+        [num_ranks * R] rescue-block signature, collectives on every rank.
 
         schedulers: re_type -> LaneScheduler, persisted across sweeps by
         the caller (bucket host caches + cross-sweep active sets live
@@ -1898,28 +1900,28 @@ def train_distributed(
         state = program.init_state(dataset, re_datasets, mf_datasets)
 
     # probe/rescue lane scheduling (algorithm/lane_scheduler.py): opt-in per
-    # RE spec via OptimizerConfig.scheduler. Host compaction reads bucket
-    # shards, so a multi-process run (not addressable) falls back to the
-    # fused one-jit step with a warning rather than crashing mid-sweep.
+    # RE spec via OptimizerConfig.scheduler. Multi-process runs use the
+    # collective-safe SPMD mode (rank-local compaction into a fixed
+    # [num_ranks * R] rescue-block signature, per-lane flags through tiled
+    # allgathers — collectives on every rank); single-process keeps the
+    # host mode unchanged. No more multi-process fallback.
     schedulers = None
     scheduled_specs = [
         s for s in program.re_specs if s.optimizer.scheduler is not None
     ]
     if scheduled_specs:
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and mesh is None:
             logger.warning(
-                "lane scheduler configured on %s but this is a multi-process "
-                "run — host compaction needs addressable bucket shards; "
-                "falling back to the unscheduled fused step",
+                "lane scheduler configured on %s but this multi-process run "
+                "has no mesh — falling back to the unscheduled fused step; "
+                "pass mesh= (the SPMD scheduler assembles rescue blocks "
+                "over it)",
                 [s.re_type for s in scheduled_specs],
             )
         else:
-            from photon_ml_tpu.algorithm.lane_scheduler import LaneScheduler
+            from photon_ml_tpu.algorithm.lane_scheduler import make_schedulers
 
-            schedulers = {
-                s.re_type: LaneScheduler(s.optimizer.scheduler)
-                for s in scheduled_specs
-            }
+            schedulers = make_schedulers(scheduled_specs, mesh=mesh)
 
     # per-sweep FE down-sampling multipliers (stable-id splitmix64, identical
     # to the CD path's FixedEffectCoordinate seed rotation); keyed per FE
@@ -2155,25 +2157,124 @@ def train_distributed(
 
 
 def _partitioned_guards(program: GameTrainProgram, prepared: dict) -> None:
-    """The partitioned v1 surface: dense FE (+ dense extra FEs) and
-    IDENTITY random effects. Everything else still trains through the
-    full-read path — fail loudly, never silently mis-shard."""
+    """The partitioned surface: dense or sparse (incl. hybrid) primary FE,
+    dense extra FEs, and IDENTITY random effects. Everything else still
+    trains through the full-read path — fail loudly, never silently
+    mis-shard."""
     if program.mf_specs:
         raise ValueError(
             "partitioned training does not support matrix-factorization "
             "coordinates; use the full-read path"
         )
     for data, buckets in prepared.values():
-        if "fe_sparse_batch" in data or "re_sparse" in data:
+        if "re_sparse" in data:
             raise ValueError(
-                "partitioned training does not support sparse feature "
-                "shards; use the full-read path"
+                "partitioned training does not support sparse RANDOM-"
+                "EFFECT shards (the primary fixed effect may be sparse); "
+                "use the full-read path"
             )
         if "__projections__" in buckets:
             raise ValueError(
                 "partitioned training does not support projected random "
                 "effects; use the full-read path"
             )
+
+
+def _assemble_sparse_fe(prepared: dict, ranks, mesh: Mesh,
+                        num_ranks: int, put) -> "SparseLabeledPointBatch":
+    """Assemble per-rank local sparse-FE batches into ONE mesh-sharded
+    global batch (the sparse twin of the dense ``asm`` closure in
+    prepare_partitioned_inputs).
+
+    The per-sample arrays (labels/offsets/weights, the [n, L] ELL tail,
+    the [n, k_hot] hybrid head) are per-rank ROW blocks and assemble like
+    any dense field; the hot column ids are model-sized, must be IDENTICAL
+    on every rank (io/partitioned_reader.py's global hot ranking
+    guarantees it), and replicate. The flat COO overflow tail is padded to
+    one agreed per-rank length (SparseShard.flat_block_nnz, also from the
+    reader's layout exchange; pads carry value 0 / col 0 / the rank's last
+    real row id) and assembles over "data" with each rank's row ids
+    shifted into the global sample axis — the concatenation stays
+    nondecreasing, preserving the flat segment-sum's sorted promise. An
+    un-exchanged local batch (mismatched shapes) fails here loudly.
+    """
+    from photon_ml_tpu.data.sparse_batch import SparseLabeledPointBatch
+    from photon_ml_tpu.parallel.multihost import assemble_partitioned
+
+    sbs = {r: prepared[r][0]["fe_sparse_batch"] for r in ranks}
+    first = sbs[ranks[0]]
+    for r, sb in sbs.items():
+        if not sb.has_ell_view:
+            raise ValueError(
+                f"rank {r}: the sparse FE batch has no ELL view; "
+                "partitioned sparse training rides the fixed-width ELL "
+                "layout (read through read_partitioned)"
+            )
+        if sb.dim != first.dim or (
+            sb.ell_vals.shape != first.ell_vals.shape
+        ) or sb.nnz != first.nnz:
+            raise ValueError(
+                f"rank {r}: sparse FE batch shapes disagree across ranks "
+                f"(dim {sb.dim} vs {first.dim}, ELL "
+                f"{sb.ell_vals.shape} vs {first.ell_vals.shape}, flat "
+                f"{sb.nnz} vs {first.nnz}) — ingest through "
+                "io/partitioned_reader.read_partitioned, which agrees the "
+                "global layout"
+            )
+        if sb.has_hybrid_view != first.has_hybrid_view or (
+            sb.has_hybrid_view
+            and not bool(
+                jnp.array_equal(sb.hot_col_ids, first.hot_col_ids)
+            )
+        ):
+            raise ValueError(
+                f"rank {r}: hybrid hot heads disagree across ranks — the "
+                "hot ranking must be resolved globally "
+                "(read_partitioned's hybrid_hot exchange)"
+            )
+
+    vec_spec = P("data")
+    row2 = P("data", None)
+
+    def asm(field, spec):
+        blocks = {r: np.asarray(getattr(sbs[r], field)) for r in ranks}
+        return assemble_partitioned(blocks, mesh, spec, num_ranks)
+
+    # the fixed-length flat COO overflow tail (SparseShard.flat_block_nnz,
+    # already padded per rank by from_shard): row ids shift by the rank's
+    # base row into the global sample axis — each rank's block is
+    # row-major and its pads carry the rank's last real row, so the
+    # concatenation stays nondecreasing (the flat segment-sum's sorted
+    # promise); pad values are 0, bitwise inert in every per-row sum
+    n_rank = int(np.asarray(first.labels).shape[0])
+
+    def asm_rows(r):
+        return (
+            np.asarray(sbs[r].row_ids, np.int64) + r * n_rank
+        ).astype(np.int32)
+
+    extra = {}
+    if first.has_hybrid_view:
+        extra = dict(
+            hot_vals=asm("hot_vals", row2),
+            hot_col_ids=put(
+                np.asarray(first.hot_col_ids), NamedSharding(mesh, P())
+            ),
+        )
+    return SparseLabeledPointBatch(
+        values=asm("values", vec_spec),
+        col_indices=asm("col_indices", vec_spec),
+        row_ids=assemble_partitioned(
+            {r: asm_rows(r) for r in ranks}, mesh, vec_spec, num_ranks
+        ),
+        labels=asm("labels", vec_spec),
+        offsets=asm("offsets", vec_spec),
+        weights=asm("weights", vec_spec),
+        dim=int(first.dim),
+        ell_vals=asm("ell_vals", row2),
+        ell_cols=asm("ell_cols", row2),
+        **extra,
+    )
 
 
 def prepare_partitioned_inputs(
@@ -2211,6 +2312,7 @@ def prepare_partitioned_inputs(
     vec = P("data")
     row2 = P("data", None)
     fe_fspec = P("data", "model") if fe_feature_sharded else row2
+    put = default_put()
 
     def asm(getter, spec):
         blocks = {r: np.asarray(getter(prepared[r][0])) for r in ranks}
@@ -2232,6 +2334,13 @@ def prepare_partitioned_inputs(
             for t in prepared[ranks[0]][0]["entity_idx"]
         },
     }
+    if "fe_sparse_batch" in prepared[ranks[0]][0]:
+        # sparse (possibly hybrid) primary FE: per-rank row blocks of the
+        # hot head / ELL tail assemble like dense fields; the reader's
+        # global layout exchange guarantees the shapes agree
+        data["fe_sparse_batch"] = _assemble_sparse_fe(
+            prepared, ranks, mesh, num_ranks, put
+        )
 
     def asm_b(key, i, field, spec):
         blocks = {
@@ -2266,7 +2375,6 @@ def prepare_partitioned_inputs(
     r0 = ranks[0]
     if state is None:
         state = program.init_state(parts[r0][0], parts[r0][1], None)
-    put = default_put()
     rep = NamedSharding(mesh, P())
     ent2 = NamedSharding(mesh, P("data", None))
     data_axis = int(mesh.shape["data"])
@@ -2301,13 +2409,20 @@ def train_partitioned(
     state: GameTrainState | None = None,
     fe_feature_sharded: bool = False,
     check_finite: bool = True,
+    schedulers: "Mapping[str, object] | None" = None,
 ) -> DistributedTrainResult:
     """``train_distributed`` over partitioned ingest blocks: each rank
     contributes only its local slice of the data/bucket arrays (every rank
     decoded ~1/P of the input; see io/partitioned_reader.py), the fused
     step runs unchanged, and only the MODEL-sized final state is host-
-    gathered. v1 scope: dense FE + IDENTITY REs, no checkpoint/validation
-    riders (score + evaluate partitioned via parallel/scoring.py)."""
+    gathered. Scope: dense or sparse/hybrid primary FE + dense IDENTITY
+    REs, no checkpoint/validation riders (score + evaluate partitioned via
+    parallel/scoring.py).
+
+    schedulers: optional re_type -> algorithm.lane_scheduler.LaneScheduler
+    (see ``make_schedulers`` — SPMD mode on multi-process runs): sweeps
+    then run through ``step_scheduled``, composing probe/rescue lane
+    scheduling with partitioned ingestion. None keeps the one-jit step."""
     data, buckets, st = prepare_partitioned_inputs(
         program, parts, mesh, num_ranks,
         fe_feature_sharded=fe_feature_sharded, state=state,
@@ -2320,7 +2435,13 @@ def train_partitioned(
 
     losses: list[float] = []
     for sweep in range(num_iterations):
-        st, loss = program.step(data, buckets, st)
+        if schedulers:
+            st, loss = program.step_scheduled(
+                data, buckets, st, schedulers=schedulers,
+                final_sweep=(sweep + 1 == num_iterations),
+            )
+        else:
+            st, loss = program.step(data, buckets, st)
         losses.append(float(loss))
         if check_finite and not np.isfinite(losses[-1]):
             from photon_ml_tpu.io.checkpoint import DivergenceError

@@ -43,17 +43,30 @@ Array = jax.Array
 
 
 def _pad_nnz(arrays: dict, data_axis: int, pad_values: dict | None = None,
-             xp=jnp) -> dict:
-    """Pad flat nnz-axis arrays to a mesh multiple: values pad with 0 (they
-    contribute nothing), "rows" repeats its last id (keeps the row
-    segment-sum's sorted promise), and ``pad_values`` overrides per key.
-    ``xp`` (numpy on mesh paths) keeps the padding on the host so placement
-    never round-trips through the local device."""
+             xp=jnp, target: int | None = None) -> dict:
+    """Pad flat nnz-axis arrays to a mesh multiple — or, when ``target`` is
+    given, to exactly that length (the partitioned path's agreed per-rank
+    entry-block length). Values pad with 0 (they contribute nothing),
+    "rows" repeats its last id (keeps the row segment-sum's sorted
+    promise; an EMPTY block takes ``pad_values["rows"]`` so a rank's pad
+    rows stay inside its own global row block), and ``pad_values``
+    overrides the other keys. ``xp`` (numpy on mesh paths) keeps the
+    padding on the host so placement never round-trips through the local
+    device."""
     nnz = int(arrays["vals"].shape[0])
-    pad = (-nnz) % data_axis
+    pad = (target - nnz) if target is not None else (-nnz) % data_axis
+    if pad < 0:
+        raise ValueError(
+            f"flat block has {nnz} entries but the agreed length is "
+            f"{target}"
+        )
     if not pad:
         return arrays
-    last_row = arrays["rows"][-1:] if nnz else xp.zeros(1, np.int32)
+    last_row = (
+        arrays["rows"][-1:] if nnz
+        else xp.full(1, (pad_values or {}).get("rows", 0),
+                     arrays["rows"].dtype)
+    )
     out = {}
     for k, v in arrays.items():
         if k == "rows":
@@ -491,7 +504,8 @@ class DistributedScorer:
 
     # -- partitioned scoring: no O(n) gather, each rank keeps its rows ------
 
-    def score_partitioned(self, parts, partition) -> "dict[int, np.ndarray]":
+    def score_partitioned(self, parts, partition,
+                          exchange=None) -> "dict[int, np.ndarray]":
         """Score partitioned-ingest blocks and return each provided rank's
         LOCAL scores — the replacement for the ``process_allgather`` score
         funnel: the [n] vector stays mesh-sharded end to end and every
@@ -502,8 +516,11 @@ class DistributedScorer:
         layout); multi-process callers pass their own rank only, single-
         process simulations pass all. partition: the reader's
         PartitionInfo. Model params are model-sized and placed normally.
-        Sparse FE / compact-RE coordinates are not in the partitioned v1
-        surface (their flat-nnz axes need a different block contract)."""
+        Sparse (incl. hybrid-read) FIXED-EFFECT coordinates are supported:
+        per-rank flat entry triples pad to one agreed nnz block (rows
+        shifted to the global sample axis) — multi-process runs must pass
+        the run's MetadataExchange so ranks agree on the block length.
+        Compact-RE coordinates are not supported; use score_dataset."""
         from photon_ml_tpu.parallel.multihost import assemble_partitioned
 
         if self.mesh is None:
@@ -518,11 +535,11 @@ class DistributedScorer:
         built = {r: self._build_host(parts[r], np) for r in ranks}
         for r in ranks:
             for cid, c in built[r][0]["coords"].items():
-                if "sparse" in c or "entries" in c:
+                if "entries" in c:
                     raise ValueError(
-                        f"coordinate '{cid}': sparse/compact coordinates "
-                        "are not supported by partitioned scoring; use "
-                        "score_dataset"
+                        f"coordinate '{cid}': compact random-effect "
+                        "coordinates are not supported by partitioned "
+                        "scoring; use score_dataset"
                     )
 
         vec = P("data")
@@ -557,12 +574,74 @@ class DistributedScorer:
                 out["col_idx"] = asm(
                     lambda d, _c=cid: d["coords"][_c]["col_idx"], vec
                 )
+            if "sparse" in c:
+                out["sparse"] = self._assemble_sparse_coord(
+                    cid, built, ranks, partition, exchange
+                )
             data["coords"][cid] = out
         params = self._place_params(built[ranks[0]][1])
 
         scores = self._score_prepared(data, params)
         return {
             r: self._extract_rank_rows(scores, partition, r) for r in ranks
+        }
+
+    def _assemble_sparse_coord(self, cid, built, ranks, partition,
+                               exchange) -> dict:
+        """One sparse FE coordinate's per-rank flat entry triples as global
+        mesh-sharded arrays: each rank's (rows, cols, vals) pads to the
+        agreed per-rank entry-block length (pads carry value 0 and the
+        rank's LAST global row id, keeping the row segment-sum's sorted
+        promise across rank boundaries), rows shift by the rank's base row
+        into the global sample axis, and the blocks assemble over "data".
+        """
+        from photon_ml_tpu.parallel.multihost import assemble_partitioned
+
+        local_nnz = {
+            r: int(built[r][0]["coords"][cid]["sparse"]["vals"].shape[0])
+            for r in ranks
+        }
+        if len(ranks) == partition.num_ranks:
+            block_nnz = max(local_nnz.values())
+        else:
+            if exchange is None:
+                raise ValueError(
+                    f"coordinate '{cid}': multi-process partitioned "
+                    "scoring of a sparse shard needs the run's "
+                    "MetadataExchange (pass score_partitioned("
+                    "exchange=...)) so ranks agree on the entry-block "
+                    "length"
+                )
+            gathered = exchange.allgather(
+                f"score_sparse_nnz/{cid}", max(local_nnz.values())
+            )
+            block_nnz = max(int(g) for g in gathered)
+        data_axis = int(self.mesh.shape["data"])
+        block_nnz = max(-(-block_nnz // data_axis) * data_axis, data_axis)
+
+        blocks: dict[str, dict[int, np.ndarray]] = {
+            "rows": {}, "cols": {}, "vals": {}
+        }
+        for r in ranks:
+            sp = built[r][0]["coords"][cid]["sparse"]
+            padded = _pad_nnz(
+                {
+                    "rows": np.asarray(sp["rows"], np.int64)
+                    + r * partition.block_rows,
+                    "cols": np.asarray(sp["cols"]),
+                    "vals": np.asarray(sp["vals"]),
+                },
+                data_axis, xp=np, target=block_nnz,
+                pad_values={"rows": r * partition.block_rows},
+            )
+            blocks["rows"][r] = padded["rows"].astype(np.int32)
+            blocks["cols"][r] = padded["cols"]
+            blocks["vals"][r] = padded["vals"]
+        return {
+            k: assemble_partitioned(
+                v, self.mesh, P("data"), partition.num_ranks
+            )
+            for k, v in blocks.items()
         }
 
     @staticmethod

@@ -69,6 +69,31 @@ def record_hybrid_layout(
     ))
 
 
+def record_global_hot_ranking(
+    label: str,
+    *,
+    k_hot: int,
+    global_nnz: int,
+    num_ranks: int,
+    registry=None,
+) -> None:
+    """One partitioned-ingest GLOBAL hot-column resolution
+    (io/partitioned_reader.py): the head was elected from the summed
+    per-rank nnz histograms, not this rank's local block — the gauge trio
+    is the journal evidence that a composed hybrid x --partitioned-io run
+    ranked globally (every rank records identical values)."""
+    from photon_ml_tpu.telemetry.registry import default_registry
+
+    reg = registry or default_registry()
+    base = f"{LAYOUT_METRIC_PREFIX}{label}"
+    reg.counter(f"{base}/global_hot_rankings").inc()
+    _set_gauges(reg, base, (
+        ("global_hot_k", k_hot),
+        ("global_hot_nnz", global_nnz),
+        ("global_hot_ranks", num_ranks),
+    ))
+
+
 def record_block_head(
     label: str,
     *,

@@ -27,6 +27,7 @@ EXPECTED_METRICS = [
     "fused_game_sweep_scheduled_ms",
     "sparse_giant_fe_entry_iters_per_sec",
     "sparse_giant_fe_hybrid",
+    "sparse_giant_fe_composed",
     "sparse_1e8_fe_tron_ms_per_iter",
 ]
 

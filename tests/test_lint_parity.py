@@ -269,3 +269,47 @@ def test_lint_catches_broad_excepts(tmp_path):
     assert not any("reraiser.py" in p for p in problems)
     assert not any("policy.py:5" in p for p in problems)  # allowlisted
     assert any("policy.py:10" in p for p in problems)  # wrong function
+
+
+def test_lint_catches_dead_end_flag_rejections(tmp_path):
+    """Check 8 fires: a cli/ guard rejecting a flag COMBINATION without
+    pointing at the composing alternative is reported; rejections that
+    name an actionable alternative pass, plain (non-combination)
+    requirement messages are not the lint's business, and modules outside
+    cli/ are not scanned."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    cli = tmp_path / "photon_ml_tpu" / "cli"
+    cli.mkdir(parents=True)
+    (cli / "bad_driver.py").write_text(
+        '"""No reference analogue."""\n'
+        "def validate(problems):\n"
+        "    raise ValueError(\n"
+        "        'flag A cannot combine with flag B'\n"
+        "    )\n"
+        "def validate2(problems):\n"
+        "    problems.append('X and Y are mutually exclusive')\n"
+        "def ok(problems):\n"
+        "    raise ValueError(\n"
+        "        'flag A cannot combine with flag B — drop B or use C'\n"
+        "    )\n"
+        "def ok2(problems):\n"
+        "    problems.append('--foo requires --bar')  # not a combination\n"
+    )
+    elsewhere = tmp_path / "photon_ml_tpu" / "io"
+    elsewhere.mkdir(parents=True)
+    (elsewhere / "outside.py").write_text(
+        '"""No reference analogue."""\n'
+        "def f():\n"
+        "    raise ValueError('a cannot combine with b')  # not cli/\n"
+    )
+    problems = lint_parity.run_lints(tmp_path)
+    assert any("bad_driver.py:3" in p and "dead-end" in p for p in problems)
+    assert any("bad_driver.py:7" in p for p in problems)
+    assert not any("bad_driver.py:9" in p for p in problems)
+    assert not any("bad_driver.py:13" in p for p in problems)
+    assert not any("outside.py" in p for p in problems)

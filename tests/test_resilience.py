@@ -386,6 +386,52 @@ class TestExchangeDeadlines:
         assert ex.allgather("meta", {"x": 1}) == [{"x": 1}]
         assert client.failures == 0
 
+    def test_withheld_hot_ranking_allgather_times_out_attributed(
+        self, tmp_path
+    ):
+        """The composed-path seam (ISSUE 6): the global hot-column ranking
+        rides the SAME exchange deadlines as the vocab exchanges — a rank
+        that crashes before publishing its nnz histogram surfaces on every
+        other rank as a rank-attributed ExchangeTimeout naming the
+        hybrid_hot tag, within the bounded deadline, never a hang."""
+        from test_composed_path import _shard_configs, _write_input
+
+        from photon_ml_tpu.io.partitioned_reader import read_partitioned
+        from photon_ml_tpu.parallel.multihost import InProcessExchange
+
+        path = _write_input(tmp_path, num_files=2, rows_per_file=8)
+        group = InProcessExchange.create_group(2, timeout=0.4)
+        # rank 1 participates in the vocab/index-map exchanges but
+        # crashes at the hot-ranking allgather
+        exchanges = [
+            group[0],
+            faultinject.WithholdingExchange(group[1], ("hybrid_hot",)),
+        ]
+        boxes = [{} for _ in range(2)]
+
+        def run(r):
+            try:
+                read_partitioned(
+                    path, _shard_configs(), exchange=exchanges[r],
+                    random_effect_id_columns=("userId",),
+                )
+                boxes[r]["error"] = None
+            except BaseException as e:  # asserted on below
+                boxes[r]["error"] = e
+
+        threads = [threading.Thread(target=run, args=(r,), daemon=True)
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20.0)
+            assert not t.is_alive(), "partitioned read hung"
+        assert isinstance(boxes[1]["error"], faultinject.InjectedCrash)
+        error = boxes[0]["error"]
+        assert isinstance(error, ExchangeTimeout)
+        assert error.missing_ranks == (1,)
+        assert "hybrid_hot" in str(error)
+
 
 # ---------------------------------------------------------------------------
 # checkpoint atomicity + intact-step fallback

@@ -667,10 +667,11 @@ class TestHybridSplitCache:
         reset_layout_metrics()
 
 
-class TestPartitionedIoGuard:
-    def test_hybrid_plus_partitioned_io_rejected_up_front(self):
-        """hybrid + --partitioned-io is rejected at validate() — before any
-        data is read — instead of silently electing per-rank hot sets."""
+class TestPartitionedIoComposition:
+    def test_hybrid_plus_partitioned_io_accepted(self):
+        """hybrid + --partitioned-io is a LEGAL composition since ISSUE 6:
+        the partitioned reader resolves one GLOBAL hot head over the
+        metadata exchange, so validate() no longer rejects the pair."""
         from photon_ml_tpu.cli.configs import CoordinateCliConfig
         from photon_ml_tpu.cli.game_training_driver import GameTrainingParams
         from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
@@ -691,25 +692,54 @@ class TestPartitionedIoGuard:
                 partitioned_io=partitioned_io,
             )
 
-        with pytest.raises(ValueError, match="partitioned-io"):
-            params(True).validate()
-        params(False).validate()  # hybrid alone is fine
+        params(True).validate()
+        params(False).validate()
 
-    def test_scoring_driver_rejects_hybrid_partitioned_io(self):
-        """The scoring driver rejects the combination up front too — before
-        any input decode, not via a late unrelated partitioned-v1 error."""
-        from photon_ml_tpu.cli import game_scoring_driver
-        from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
+    def test_global_hot_ids_policy(self):
+        """A policy carrying pre-resolved hot_ids (the partitioned
+        reader's global ranking) builds exactly those columns — even ones
+        the local block never observed, and even on an empty block — so
+        the head SHAPE agrees across ranks."""
+        from photon_ml_tpu.data.sparse_batch import _hybrid_arrays
 
-        with pytest.raises(ValueError, match="partitioned-io"):
-            game_scoring_driver.run(
-                input_data_path="/nonexistent",
-                model_input_dir="/nonexistent-model",
-                output_dir="/nonexistent-out",
-                feature_shards={
-                    "g": FeatureShardConfiguration(
-                        feature_bags=("features",), sparse=True, hybrid=True
-                    )
-                },
-                partitioned_io=True,
-            )
+        rows = np.array([0, 0, 1, 2])
+        cols = np.array([3, 7, 3, 9])
+        vals = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        policy = HybridPolicy(
+            hot_ids=(3, 5), pad_multiple=2, label="gids"
+        )
+        hot, ids, tr, tc, tv = _hybrid_arrays(rows, cols, vals, 3, 16, policy)
+        np.testing.assert_array_equal(ids, [3, 5])
+        np.testing.assert_array_equal(
+            hot, [[1.0, 0.0], [3.0, 0.0], [0.0, 0.0]]
+        )
+        np.testing.assert_array_equal(tc, [7, 9])  # cold tail preserved
+        # an empty local block still builds the agreed head shape
+        hot0, ids0, *_tail = _hybrid_arrays(
+            np.zeros(0, np.int64), np.zeros(0, np.int64),
+            np.zeros(0, np.float32), 3, 16, policy,
+        )
+        assert hot0.shape == (3, 2)
+        np.testing.assert_array_equal(ids0, [3, 5])
+
+    def test_hot_ids_validation(self):
+        with pytest.raises(ValueError, match="sorted"):
+            HybridPolicy(hot_ids=(5, 3))
+        with pytest.raises(ValueError, match="at least one"):
+            HybridPolicy(hot_ids=())
+
+    def test_shard_ell_width_fixes_signature(self):
+        """SparseShard.ell_width (the partitioned reader's agreed width)
+        overrides the auto rule so every rank's batch block shares one
+        shape, with an empty flat overflow tail when wide enough."""
+        rows, cols, vals, labels, _, _ = _data(seed=51)
+        shard = SparseShard(
+            rows=rows, cols=cols, vals=vals, num_samples=80, feature_dim=40,
+            ell_width=int(np.bincount(rows).max()),
+        )
+        b = SparseLabeledPointBatch.from_shard(
+            shard, labels, np.zeros(80), np.ones(80)
+        )
+        assert b.has_ell_view
+        assert b.ell_vals.shape == (80, int(np.bincount(rows).max()))
+        assert b.nnz == 0  # wide enough: no overflow entries
