@@ -274,6 +274,11 @@ def check_score_allgathers(root: pathlib.Path) -> list[str]:
 BROAD_EXCEPT_ALLOWED = {
     (f"{PACKAGE}/resilience/policy.py", "call"),
     (f"{PACKAGE}/resilience/recovery.py", "run_with_recovery"),
+    # the chunk-prefetch producer thread: the retry policy already
+    # classified and retried; a thread cannot re-raise usefully, so the
+    # handler classifies and FORWARDS the failure to the consumer's
+    # stack, which re-raises it attributed (io/stream_reader.py)
+    (f"{PACKAGE}/io/stream_reader.py", "_producer"),
     (f"{PACKAGE}/telemetry/probes.py", "live_buffer_bytes"),
     (f"{PACKAGE}/telemetry/journal.py", "_process_index"),
     (f"{PACKAGE}/io/offheap_index_map.py", "__del__"),
@@ -488,6 +493,74 @@ def check_cli_dead_end_rejections(root: pathlib.Path) -> list[str]:
     return problems
 
 
+#: the out-of-core streaming modules (check 9): every chunk-consuming jit
+#: must live at module scope with the chunk batch in its ARGUMENT list — a
+#: jit built inside a function can close over chunk-sized arrays, which
+#: serialize as CONSTANTS into the remote-compile request and blow the
+#: tunnel's HTTP limit at ~250 MB (the measured 413 landmine)
+STREAMING_MODULES = (
+    f"{PACKAGE}/io/stream_reader.py",
+    f"{PACKAGE}/algorithm/streaming.py",
+)
+
+
+def _jit_references(node: ast.AST):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr == "jit":
+            yield n
+        elif isinstance(n, ast.Name) and n.id == "jit":
+            yield n
+
+
+def check_streaming_jit_closures(root: pathlib.Path) -> list[str]:
+    problems = []
+    for rel in STREAMING_MODULES:
+        path = root / rel
+        if not path.exists():
+            continue
+        tree = ast.parse(path.read_text())
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # module-scope decorator jits are the sanctioned form —
+                # compiled once, chunks enter through the argument list —
+                # but the chunk batch must actually BE an argument
+                deco_jits = [
+                    n for d in stmt.decorator_list for n in _jit_references(d)
+                ]
+                args = {
+                    a.arg
+                    for a in (
+                        stmt.args.posonlyargs
+                        + stmt.args.args
+                        + stmt.args.kwonlyargs
+                    )
+                }
+                if deco_jits and "batch" not in args:
+                    problems.append(
+                        f"{rel}:{stmt.lineno}: module-level jit "
+                        f"'{stmt.name}' has no 'batch' parameter — the "
+                        "chunk must ride the jit's argument list, never a "
+                        "closure (the HTTP-413 landmine; lint check 9)"
+                    )
+                scopes = stmt.body
+            elif isinstance(stmt, ast.ClassDef):
+                scopes = [stmt]
+            else:
+                scopes = [stmt]
+            for scope in scopes:
+                for n in _jit_references(scope):
+                    problems.append(
+                        f"{rel}:{n.lineno}: jit nested inside a "
+                        "function/class in a streaming module — a jit "
+                        "built per call can close over chunk-sized arrays, "
+                        "which serialize as constants into the "
+                        "remote-compile request (HTTP 413 past ~250 MB); "
+                        "define the jitted step at module scope and pass "
+                        "the chunk as an argument (lint check 9)"
+                    )
+    return problems
+
+
 def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
     root = pathlib.Path(root) if root else pathlib.Path(__file__).resolve().parents[1]
     return (
@@ -499,6 +572,7 @@ def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
         + check_vmapped_pallas(root)
         + check_segment_sum_num_segments(root)
         + check_cli_dead_end_rejections(root)
+        + check_streaming_jit_closures(root)
     )
 
 

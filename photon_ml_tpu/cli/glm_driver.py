@@ -31,8 +31,10 @@ from photon_ml_tpu.io.partitioned_reader import read_partitioned
 from photon_ml_tpu.io.model_io import write_glm_text
 from photon_ml_tpu.ops.normalization import NormalizationType, build_normalization
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+from photon_ml_tpu.telemetry import io_counters
 from photon_ml_tpu.telemetry import RunJournal, SolverTelemetry, default_registry
 from photon_ml_tpu.telemetry.layout import reset_layout_metrics
+from photon_ml_tpu.telemetry.stream_counters import reset_stream_metrics
 from photon_ml_tpu.telemetry.probes import CompileMonitor
 from photon_ml_tpu.telemetry.solver_trace import reset_solver_metrics
 from photon_ml_tpu.types import TaskType
@@ -107,6 +109,16 @@ class GLMDriverParams:
     #: default) or "quarantine" (skip-and-count corrupt container blocks;
     #: io/avro.py + resilience layer)
     on_corrupt: str = "raise"
+    #: out-of-core streaming epochs: records per chunk (> 0 opts in). The
+    #: training data is never materialized in core — each solver objective
+    #: evaluation is one exact chunked epoch with host Avro decode
+    #: double-buffered behind device accumulation
+    #: (io/stream_reader.py + algorithm/streaming.py). 0 = off (default),
+    #: byte-identical to the in-core path.
+    streaming_chunks: int = 0
+    #: disable the background prefetch thread (chunks decode inline) — the
+    #: same-run OFF baseline for overlap measurements; streaming mode only
+    streaming_prefetch: bool = True
 
 
 @dataclasses.dataclass
@@ -144,7 +156,43 @@ def _read_batch(path: str, fmt: str, shard_cfg, index_maps=None,
     return batch, result.index_maps, result.intercept_indices.get("features")
 
 
+def _check_streaming_supported(params: "GLMDriverParams") -> None:
+    """Fail fast, with the alternative named, before any data is read:
+    the streaming path never materializes the full batch, so stages that
+    re-fit or decompose on the in-core batch cannot ride it."""
+    if params.input_format != "avro":
+        raise ValueError(
+            "--streaming-chunks streams Avro container blocks; for "
+            "libsvm inputs drop --streaming-chunks (or convert with "
+            "cli/libsvm_to_avro.py and stream the result)"
+        )
+    if params.grid_parallel:
+        raise ValueError(
+            "--streaming-chunks trains the λ grid sequentially with warm "
+            "starts (vmapped grid lanes need the in-core batch); drop "
+            "--grid-parallel"
+        )
+    if params.enable_diagnostics or params.num_bootstraps:
+        raise ValueError(
+            "diagnostics re-fit on the in-core batch; drop "
+            "--enable-diagnostics/--num-bootstraps or run without "
+            "--streaming-chunks"
+        )
+    if params.compute_variance:
+        raise ValueError(
+            "coefficient variances decompose the in-core Hessian; drop "
+            "--compute-variance or run without --streaming-chunks"
+        )
+    if params.optimizer == OptimizerType.NEWTON:
+        raise ValueError(
+            "NEWTON needs the dense [d, d] Hessian; use --optimizer TRON "
+            "for streamed second-order solves"
+        )
+
+
 def run(params: GLMDriverParams) -> GLMDriverResult:
+    if params.streaming_chunks > 0:
+        _check_streaming_supported(params)
     if (
         params.coefficient_box_constraints
         and params.normalization != NormalizationType.NONE
@@ -159,11 +207,12 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
             "normalization.type or the box constraints"
         )
     os.makedirs(params.output_dir, exist_ok=True)
-    # per-run phase timings + solver/layout tallies (sweeps may call run()
-    # repeatedly)
+    # per-run phase timings + solver/layout/stream tallies (sweeps may call
+    # run() repeatedly)
     reset_timings()
     reset_solver_metrics()
     reset_layout_metrics()
+    reset_stream_metrics()
     journal = (
         RunJournal(params.telemetry_dir) if params.telemetry_dir else None
     )
@@ -184,6 +233,8 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
         "max_iterations": params.max_iterations,
         "tolerance": params.tolerance,
         "normalization": params.normalization.name,
+        "streaming_chunks": params.streaming_chunks,
+        "streaming_prefetch": params.streaming_prefetch,
     }
     events.send(SetupEvent(config_summary=json.dumps(config_summary)))
     events.send(TrainingStartEvent(job_name="glm-training"))
@@ -213,39 +264,126 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
             journal.close()
 
 
+def _prepare_streaming(params: GLMDriverParams, shard_cfg):
+    """Streaming PREPROCESS: global index maps from one discarding vocab
+    pass, the chunked epoch source over the block plan, per-chunk
+    validation, and (when requested) normalization statistics from one
+    streaming summary pass — the full batch is never materialized."""
+    from photon_ml_tpu.algorithm.streaming import streaming_summarize
+    from photon_ml_tpu.io.avro import list_avro_files
+    from photon_ml_tpu.io.index_map import INTERCEPT_KEY
+    from photon_ml_tpu.io.stream_reader import (
+        AvroChunkSource,
+        ChunkPrefetcher,
+        DenseRecordAssembler,
+        build_streaming_index_maps,
+    )
+    from photon_ml_tpu.resilience import default_io_policy
+
+    cfg = shard_cfg["features"]
+    files = list_avro_files(params.input_data_path)
+    # same journal evidence as the full-read path (read_partitioned sets
+    # it there; plan_partitioned_stream on the multi-process path)
+    io_counters.set_input_bytes_total(
+        sum(int(os.path.getsize(f)) for f in files)
+    )
+    index_maps = default_io_policy().call(
+        lambda: build_streaming_index_maps(
+            files, shard_cfg, on_corrupt=params.on_corrupt
+        ),
+        description=f"streaming vocab pass over {params.input_data_path}",
+    )
+    imap = index_maps["features"]
+    intercept_index = imap.get_index(INTERCEPT_KEY)
+    if intercept_index < 0:
+        intercept_index = None
+    source = AvroChunkSource(
+        files,
+        DenseRecordAssembler(imap, cfg),
+        chunk_records=params.streaming_chunks,
+        on_corrupt=params.on_corrupt,
+    )
+    if params.data_validation != DataValidationType.VALIDATE_DISABLED:
+        # one inline pass, validating each chunk's TRUE rows (weight-0
+        # chunk padding is layout, not data)
+        with ChunkPrefetcher(source, prefetch=False) as chunks:
+            for batch, spec in zip(chunks, source.specs):
+                n = spec.num_records
+                validate_arrays(
+                    labels=np.asarray(batch.labels)[:n],
+                    task=params.task_type,
+                    offsets=np.asarray(batch.offsets)[:n],
+                    weights=np.asarray(batch.weights)[:n],
+                    feature_shards={
+                        "features": np.asarray(batch.features)[:n]
+                    },
+                    validation_type=params.data_validation,
+                )
+    norm = None
+    if params.normalization != NormalizationType.NONE:
+        stats = streaming_summarize(
+            source, prefetch=params.streaming_prefetch
+        )
+        import jax.numpy as jnp
+
+        norm = build_normalization(
+            params.normalization,
+            mean=jnp.asarray(stats["mean"]),
+            variance=jnp.asarray(stats["variance"]),
+            max_magnitude=jnp.asarray(stats["max_magnitude"]),
+            intercept_index=intercept_index,
+        )
+    return source, index_maps, intercept_index, norm
+
+
 def _run_stages(params: GLMDriverParams, telemetry: SolverTelemetry) -> GLMDriverResult:
     stage = DriverStage.INIT
     shard_cfg = {"features": FeatureShardConfiguration(feature_bags=("features",))}
+    streaming = params.streaming_chunks > 0
 
     with PhotonLogger(os.path.join(params.output_dir, "driver.log")) as job_log:
         # PREPROCESS
+        batch = None
         with Timed("glm preprocess"):
-            batch, index_maps, intercept_index = _read_batch(
-                params.input_data_path, params.input_format, shard_cfg,
-                on_corrupt=params.on_corrupt,
-            )
-            validate_arrays(
-                labels=np.asarray(batch.labels),
-                task=params.task_type,
-                offsets=np.asarray(batch.offsets),
-                weights=np.asarray(batch.weights),
-                feature_shards={"features": np.asarray(batch.features)},
-                validation_type=params.data_validation,
-            )
-            norm = None
-            if params.normalization != NormalizationType.NONE:
-                stats = summarize(np.asarray(batch.features), np.asarray(batch.weights))
-                import jax.numpy as jnp
-
-                norm = build_normalization(
-                    params.normalization,
-                    mean=jnp.asarray(stats["mean"]),
-                    variance=jnp.asarray(stats["variance"]),
-                    max_magnitude=jnp.asarray(stats["max_magnitude"]),
-                    intercept_index=intercept_index,
+            if streaming:
+                source, index_maps, intercept_index, norm = (
+                    _prepare_streaming(params, shard_cfg)
                 )
+            else:
+                batch, index_maps, intercept_index = _read_batch(
+                    params.input_data_path, params.input_format, shard_cfg,
+                    on_corrupt=params.on_corrupt,
+                )
+                validate_arrays(
+                    labels=np.asarray(batch.labels),
+                    task=params.task_type,
+                    offsets=np.asarray(batch.offsets),
+                    weights=np.asarray(batch.weights),
+                    feature_shards={"features": np.asarray(batch.features)},
+                    validation_type=params.data_validation,
+                )
+                norm = None
+                if params.normalization != NormalizationType.NONE:
+                    stats = summarize(np.asarray(batch.features), np.asarray(batch.weights))
+                    import jax.numpy as jnp
+
+                    norm = build_normalization(
+                        params.normalization,
+                        mean=jnp.asarray(stats["mean"]),
+                        variance=jnp.asarray(stats["variance"]),
+                        max_magnitude=jnp.asarray(stats["max_magnitude"]),
+                        intercept_index=intercept_index,
+                    )
         stage = DriverStage.PREPROCESSED
-        job_log.info("preprocessed %d samples, %d features", batch.num_samples, batch.dim)
+        if streaming:
+            job_log.info(
+                "preprocessed %d samples, %d features (streaming: %d "
+                "chunks of <=%d records)",
+                source.total_records, source.dim, source.num_chunks,
+                params.streaming_chunks,
+            )
+        else:
+            job_log.info("preprocessed %d samples, %d features", batch.num_samples, batch.dim)
 
         # TRAIN
         opt = OptimizerConfig(
@@ -279,9 +417,26 @@ def _run_stages(params: GLMDriverParams, telemetry: SolverTelemetry) -> GLMDrive
             )
 
         with Timed("glm train"):
-            # telemetry only on the primary grid: diagnostics re-fits below
-            # would repeat per-λ convergence rows
-            models = fit(batch, params.regularization_weights, tel=telemetry)
+            if streaming:
+                from photon_ml_tpu.estimators import train_glm_streaming
+
+                models = train_glm_streaming(
+                    source,
+                    params.task_type,
+                    optimizer=opt,
+                    regularization_weights=params.regularization_weights,
+                    elastic_net_alpha=params.elastic_net_alpha,
+                    normalization=norm,
+                    intercept_index=intercept_index,
+                    telemetry=telemetry,
+                    prefetch=params.streaming_prefetch,
+                    lower_bounds=lower_bounds,
+                    upper_bounds=upper_bounds,
+                )
+            else:
+                # telemetry only on the primary grid: diagnostics re-fits
+                # below would repeat per-λ convergence rows
+                models = fit(batch, params.regularization_weights, tel=telemetry)
         stage = DriverStage.TRAINED
         write_glm_text(
             os.path.join(params.output_dir, "models-text"),
@@ -406,6 +561,16 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
                    choices=["raise", "quarantine"],
                    help="corrupt Avro blocks: 'raise' (strict, default) "
                         "or 'quarantine' (skip-and-count)")
+    p.add_argument("--streaming-chunks", type=int, default=0,
+                   help="out-of-core streaming epochs: records per chunk "
+                        "(> 0 opts in; the training data never "
+                        "materializes in core — host Avro decode is "
+                        "double-buffered behind device accumulation). "
+                        "0 = off (default, byte-identical in-core path)")
+    p.add_argument("--no-streaming-prefetch", action="store_true",
+                   help="decode chunks inline instead of on the "
+                        "background prefetch thread (the same-run OFF "
+                        "baseline for overlap measurements)")
     args = p.parse_args(argv)
     return run(
         GLMDriverParams(
@@ -430,6 +595,8 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
             input_format=args.input_format,
             telemetry_dir=args.telemetry_dir,
             on_corrupt=args.on_corrupt,
+            streaming_chunks=args.streaming_chunks,
+            streaming_prefetch=not args.no_streaming_prefetch,
         )
     )
 

@@ -1184,3 +1184,124 @@ def train_glm(
             "trained λ=%g: value=%g iters=%d", lam, float(result.value), int(result.iterations)
         )
     return models
+
+
+def train_glm_streaming(
+    source,
+    task: TaskType,
+    *,
+    optimizer: OptimizerConfig | None = None,
+    regularization_weights: Sequence[float] = (0.0,),
+    elastic_net_alpha: float = 0.0,
+    normalization: NormalizationContext | None = None,
+    intercept_index: int | None = None,
+    telemetry=None,
+    mesh=None,
+    exchange=None,
+    prefetch: bool = True,
+    retry_policy=None,
+    chunk_timeout: float | None = None,
+    lower_bounds=None,
+    upper_bounds=None,
+) -> dict[float, GeneralizedLinearModel]:
+    """Single-GLM regularization path over an OUT-OF-CORE chunk stream.
+
+    The streaming twin of :func:`train_glm` (reference
+    ModelTraining.scala:106-228's warm-started foldLeft over sorted λs):
+    ``source`` is an ``io.stream_reader.ChunkSource`` whose data never
+    materializes in core — every objective evaluation is one exact chunked
+    epoch (algorithm/streaming.StreamingGLMObjective), host decode
+    double-buffered behind device accumulation, and the solvers run their
+    identical per-iteration math in ``host_loop`` mode. Final
+    loss/coefficients match the in-core solve to float round-off (chunked
+    summation order is the only difference; tests/test_streaming.py pins
+    it on dense and hybrid-sparse fixtures).
+
+    LBFGS/OWLQN/TRON only (NEWTON needs the dense [d, d] Hessian — use
+    TRON for streamed second-order solves). ``exchange``: optional
+    ``parallel.multihost.MetadataExchange`` — each rank streams its own
+    block assignment and the per-epoch accumulators sum in rank order.
+    ``prefetch=False`` decodes inline (the same-run OFF baseline the bench
+    row measures against).
+    """
+    from photon_ml_tpu.algorithm.streaming import StreamingGLMObjective
+    from photon_ml_tpu.io.stream_reader import DEFAULT_CHUNK_TIMEOUT
+
+    optimizer = optimizer or OptimizerConfig()
+    if optimizer.optimizer_type == OptimizerType.NEWTON:
+        raise ValueError(
+            "NEWTON cannot stream (dense [d, d] Hessian); use TRON for "
+            "streamed second-order solves"
+        )
+    has_bounds = lower_bounds is not None or upper_bounds is not None
+    if has_bounds and (
+        elastic_net_alpha > 0.0
+        or optimizer.optimizer_type
+        not in (OptimizerType.LBFGS, OptimizerType.LBFGSB)
+    ):
+        # same rule as train_glm: fail before any lambda trains
+        raise ValueError(
+            "box constraints require the LBFGS family without L1 "
+            "(elastic_net_alpha must be 0)"
+        )
+    loss = loss_for_task(task)
+    solve_dtype = jnp.float32
+    src_dtype = getattr(source, "dtype", None)
+    if src_dtype is None and hasattr(source, "features"):
+        src_dtype = source.features.dtype
+    if src_dtype is not None:
+        from photon_ml_tpu.data.batch import solve_dtype_of
+
+        solve_dtype = solve_dtype_of(src_dtype)
+    models: dict[float, GeneralizedLinearModel] = {}
+    w = jnp.zeros((source.dim,), dtype=solve_dtype)
+    for lam in sorted(regularization_weights):
+        l1 = elastic_net_alpha * lam
+        l2 = (1.0 - elastic_net_alpha) * lam
+        objective = StreamingGLMObjective(
+            source, loss,
+            l2_weight=l2,
+            normalization=normalization,
+            mesh=mesh,
+            exchange=exchange,
+            prefetch=prefetch,
+            retry_policy=retry_policy,
+            chunk_timeout=(
+                DEFAULT_CHUNK_TIMEOUT if chunk_timeout is None
+                else chunk_timeout
+            ),
+        )
+        opt = optimizer
+        if l1 > 0.0:
+            opt = dataclasses.replace(
+                optimizer.with_l1(l1), optimizer_type=OptimizerType.OWLQN
+            )
+        result = solve(
+            opt, objective, w,
+            lower_bounds=(
+                None if lower_bounds is None
+                else jnp.asarray(lower_bounds, solve_dtype)
+            ),
+            upper_bounds=(
+                None if upper_bounds is None
+                else jnp.asarray(upper_bounds, solve_dtype)
+            ),
+            host_loop=True,
+        )
+        w = result.coefficients
+        if telemetry is not None:
+            telemetry.record_solve(
+                "glm_streaming", result,
+                extra={"lambda": lam, "epochs": objective.epochs,
+                       "chunks": source.num_chunks},
+            )
+        norm = objective.objective.normalization
+        models[lam] = GeneralizedLinearModel(
+            Coefficients(means=norm.to_model_space(w, intercept_index)), task
+        )
+        logger.info(
+            "streamed λ=%g: value=%g iters=%d epochs=%d",
+            lam, float(result.value), int(result.iterations),
+            objective.epochs,
+        )
+    return models

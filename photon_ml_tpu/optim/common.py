@@ -31,6 +31,32 @@ class ConvergenceReason(enum.IntEnum):
     LINE_SEARCH_FAILED = 4
 
 
+def run_while(cond, body, init, *, host: bool = False):
+    """``lax.while_loop`` — or, with ``host=True``, the IDENTICAL loop body
+    driven from Python with concrete arrays.
+
+    The host mode exists for out-of-core streaming solves
+    (algorithm/streaming.py): there ``value_and_grad_fn`` is a HOST
+    function (one chunked epoch over data that never fits on device), so
+    it cannot be traced into a ``lax.while_loop`` body — tracing would
+    both consume the chunk stream at trace time and bake every chunk into
+    the program as constants (the HTTP-413 landmine). Every per-iteration
+    operation is the same jax code either way; only the control-flow
+    driver changes, so the host loop follows the in-core solve's
+    arithmetic step for step (differences come only from the chunked
+    summation order inside the objective, i.e. float round-off).
+
+    The default (``host=False``) compiles to the exact same
+    ``lax.while_loop`` call as before this parameter existed.
+    """
+    if not host:
+        return lax.while_loop(cond, body, init)
+    state = init
+    while bool(cond(state)):
+        state = body(state)
+    return state
+
+
 @flax.struct.dataclass
 class SolverResult:
     """Final state + per-iteration history of one solve.
@@ -189,8 +215,13 @@ def wolfe_line_search(
     c1: float = 1e-4,
     c2: float = 0.9,
     max_steps: int = 25,
+    host_loop: bool = False,
 ) -> LineSearchResult:
     """Weak-Wolfe bisection line search, fully jittable.
+
+    ``host_loop=True`` drives the same trial-step body from Python (see
+    :func:`run_while`) so a host-level chunked ``value_and_grad_fn`` can be
+    searched over; the default stays the one ``lax.while_loop``.
 
     Bracketing bisection: shrink on Armijo failure, expand (or bisect within
     the bracket) on curvature failure. Each trial costs one value_and_grad —
@@ -249,6 +280,8 @@ def wolfe_line_search(
         jnp.asarray(False),
         jnp.asarray(False),
     )
-    _, _, _, _, t_best, f_best, g_best, has_best, _done = lax.while_loop(cond, body, init)
+    _, _, _, _, t_best, f_best, g_best, has_best, _done = run_while(
+        cond, body, init, host=host_loop
+    )
     success = has_best & (f_best < f0)
     return LineSearchResult(step=t_best, value=f_best, gradient=g_best, success=success)

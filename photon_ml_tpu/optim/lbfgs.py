@@ -26,6 +26,7 @@ from photon_ml_tpu.optim.common import (
     ConvergenceReason,
     SolverResult,
     check_convergence,
+    run_while,
     wolfe_line_search,
 )
 
@@ -105,8 +106,14 @@ def minimize_lbfgs(
     lower_bounds: Array | None = None,
     upper_bounds: Array | None = None,
     max_line_search_steps: int = 25,
+    host_loop: bool = False,
 ) -> SolverResult:
     """Minimize a smooth function with L-BFGS. Jit- and vmap-safe.
+
+    ``host_loop=True`` runs the identical per-iteration body from a Python
+    loop (optim/common.run_while) so ``value_and_grad_fn`` may be a HOST
+    function — the out-of-core streaming epoch accumulator
+    (algorithm/streaming.py). The default compiles exactly as before.
 
     With ``lower_bounds``/``upper_bounds`` set, iterates are projected onto
     the box after every accepted step and convergence is tested on the
@@ -222,10 +229,11 @@ def minimize_lbfgs(
                 i, _t, _w, _f, _g, ok = s
                 return (i < max_line_search_steps) & ~ok
 
-            _, _, w_new, f_new, g_new, ls_ok = lax.while_loop(
+            _, _, w_new, f_new, g_new, ls_ok = run_while(
                 ls_cond,
                 ls_body,
                 (jnp.int32(0), t_init, state.w, state.f, state.g, jnp.asarray(False)),
+                host=host_loop,
             )
             ls_success = ls_ok
         else:
@@ -237,6 +245,7 @@ def minimize_lbfgs(
                 direction,
                 t_init,
                 max_steps=max_line_search_steps,
+                host_loop=host_loop,
             )
             w_new = state.w + ls.step * direction
             f_new, g_new = ls.value, ls.gradient
@@ -296,7 +305,7 @@ def minimize_lbfgs(
             grad_norm_history=state.grad_norm_history.at[it].set(gnorm),
         )
 
-    final = lax.while_loop(cond, body, init)
+    final = run_while(cond, body, init, host=host_loop)
     reason = jnp.where(
         final.reason == ConvergenceReason.NOT_CONVERGED,
         jnp.int32(ConvergenceReason.MAX_ITERATIONS),

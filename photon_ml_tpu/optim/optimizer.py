@@ -105,9 +105,21 @@ def solve(
     *,
     lower_bounds: Array | None = None,
     upper_bounds: Array | None = None,
+    host_loop: bool = False,
 ) -> SolverResult:
-    """Run the configured solver on a bound objective. Pure; jit/vmap-safe."""
+    """Run the configured solver on a bound objective. Pure; jit/vmap-safe.
+
+    ``host_loop=True`` drives the solver's identical per-iteration math
+    from Python loops so the objective may be a host-level chunked-epoch
+    accumulator (algorithm/streaming.py); LBFGS/OWLQN/TRON only — NEWTON
+    needs a dense [d, d] Hessian no streaming objective materializes.
+    """
     t = config.optimizer_type
+    if host_loop and t == OptimizerType.NEWTON:
+        raise ValueError(
+            "NEWTON has no host-loop (streaming) mode — it needs the dense "
+            "[d, d] Hessian; use TRON for streamed second-order solves"
+        )
     if (lower_bounds is not None or upper_bounds is not None) and t not in (
         OptimizerType.LBFGS, OptimizerType.LBFGSB
     ):
@@ -127,6 +139,7 @@ def solve(
             rel_function_tolerance=config.rel_function_tolerance,
             lower_bounds=lower_bounds,
             upper_bounds=upper_bounds,
+            host_loop=host_loop,
         )
     if t == OptimizerType.LBFGSB:
         if lower_bounds is None and upper_bounds is None:
@@ -140,6 +153,7 @@ def solve(
             rel_function_tolerance=config.rel_function_tolerance,
             lower_bounds=lower_bounds,
             upper_bounds=upper_bounds,
+            host_loop=host_loop,
         )
     if t == OptimizerType.OWLQN:
         return minimize_owlqn(
@@ -150,6 +164,7 @@ def solve(
             history=config.history,
             tolerance=config.tolerance,
             rel_function_tolerance=config.rel_function_tolerance,
+            host_loop=host_loop,
         )
     if t == OptimizerType.TRON:
         loss = objective.objective.loss
@@ -166,6 +181,7 @@ def solve(
             tolerance=config.tolerance,
             rel_function_tolerance=config.rel_function_tolerance,
             max_cg_iter=config.max_cg_iterations,
+            host_loop=host_loop,
         )
     if t == OptimizerType.NEWTON:
         loss = objective.objective.loss

@@ -22,6 +22,7 @@ from photon_ml_tpu.optim.common import (
     ConvergenceReason,
     SolverResult,
     check_convergence,
+    run_while,
 )
 from photon_ml_tpu.optim.lbfgs import two_loop_direction
 
@@ -70,12 +71,16 @@ def minimize_owlqn(
     tolerance: float = 1e-7,
     rel_function_tolerance: float | None = None,
     max_line_search_steps: int = 30,
+    host_loop: bool = False,
 ) -> SolverResult:
     """Minimize smooth(w) + l1_weight * ‖w‖₁.
 
     ``value_and_grad_fn`` covers only the smooth part (loss + optional L2).
     ``rel_function_tolerance``: live function-decrease stop for warm-started
     vmapped lanes (None = use ``tolerance``; optim/common.check_convergence).
+    ``host_loop=True``: identical body math driven from Python so
+    ``value_and_grad_fn`` may be a host-level streaming epoch accumulator
+    (optim/common.run_while).
     """
     dtype = w0.dtype
     d = w0.shape[0]
@@ -160,10 +165,11 @@ def minimize_owlqn(
             i, _t, _w, _f, _g, done = ls_state
             return (i < max_line_search_steps) & ~done
 
-        _, _, w_new, f_new, g_new, ls_ok = lax.while_loop(
+        _, _, w_new, f_new, g_new, ls_ok = run_while(
             ls_cond,
             ls_body,
             (jnp.int32(0), t_init, state.w, state.f, state.g, jnp.asarray(False)),
+            host=host_loop,
         )
 
         s = w_new - state.w
@@ -217,7 +223,7 @@ def minimize_owlqn(
             grad_norm_history=state.grad_norm_history.at[it].set(gnorm),
         )
 
-    final = lax.while_loop(cond, body, init)
+    final = run_while(cond, body, init, host=host_loop)
     reason = jnp.where(
         final.reason == ConvergenceReason.NOT_CONVERGED,
         jnp.int32(ConvergenceReason.MAX_ITERATIONS),

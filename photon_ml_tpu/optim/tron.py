@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from photon_ml_tpu.optim.common import ConvergenceReason, SolverResult
+from photon_ml_tpu.optim.common import ConvergenceReason, SolverResult, run_while
 
 Array = jax.Array
 
@@ -32,11 +32,14 @@ ETA0, ETA1, ETA2 = 1e-4, 0.25, 0.75
 SIGMA1, SIGMA2, SIGMA3 = 0.25, 0.5, 4.0
 
 
-def _truncated_cg(hv_fn, g: Array, delta: Array, max_cg: int, cg_tol: Array):
+def _truncated_cg(hv_fn, g: Array, delta: Array, max_cg: int, cg_tol: Array,
+                  host_loop: bool = False):
     """Solve H z ≈ -g within the trust region ‖z‖ <= delta.
 
     Returns (z, hit_boundary, cg_iters). Steihaug-Toint truncated CG
     (reference TRON.truncatedConjugateGradientMethod, TRON.scala:278-338).
+    ``host_loop=True`` drives the same CG body from Python so ``hv_fn`` may
+    be a host-level streaming epoch accumulator (optim/common.run_while).
     """
     d0 = -g
     r0 = -g
@@ -76,8 +79,10 @@ def _truncated_cg(hv_fn, g: Array, delta: Array, max_cg: int, cg_tol: Array):
         return (i < max_cg) & ~done
 
     z0 = jnp.zeros_like(g)
-    z, _r, _d, iters, hit, _done = lax.while_loop(
-        cond, body, (z0, r0, d0, jnp.int32(0), jnp.asarray(False), jnp.asarray(False))
+    z, _r, _d, iters, hit, _done = run_while(
+        cond, body,
+        (z0, r0, d0, jnp.int32(0), jnp.asarray(False), jnp.asarray(False)),
+        host=host_loop,
     )
     return z, hit, iters
 
@@ -104,11 +109,16 @@ def minimize_tron(
     rel_function_tolerance: float | None = None,
     max_cg_iter: int = 20,
     cg_forcing: float = 0.1,
+    host_loop: bool = False,
 ) -> SolverResult:
     """Minimize a twice-differentiable convex objective with TRON.
 
     ``hessian_vector_fn(w, v)`` returns H(w) @ v. Convergence when
     ‖g‖ <= tolerance * ‖g0‖ (LIBLINEAR's test, TRON.scala:208).
+
+    ``host_loop=True``: the identical outer/CG body math driven from
+    Python loops so both callbacks may be host-level streaming epoch
+    accumulators (optim/common.run_while).
 
     ``rel_function_tolerance`` (None = reference behavior, no function
     test): live relative function-decrease stop on accepted rounds — the
@@ -148,7 +158,8 @@ def minimize_tron(
         gnorm = jnp.linalg.norm(state.g)
         hv = lambda v: hessian_vector_fn(state.w, v)
         step, hit_boundary, _cg_iters = _truncated_cg(
-            hv, state.g, state.delta, max_cg_iter, cg_forcing * gnorm
+            hv, state.g, state.delta, max_cg_iter, cg_forcing * gnorm,
+            host_loop=host_loop,
         )
 
         gs = jnp.vdot(state.g, step)
@@ -228,7 +239,7 @@ def minimize_tron(
             grad_norm_history=state.grad_norm_history.at[it].set(gnorm_acc),
         )
 
-    final = lax.while_loop(cond, body, init)
+    final = run_while(cond, body, init, host=host_loop)
     reason = jnp.where(
         final.reason == ConvergenceReason.NOT_CONVERGED,
         jnp.int32(ConvergenceReason.MAX_ITERATIONS),

@@ -313,3 +313,51 @@ def test_lint_catches_dead_end_flag_rejections(tmp_path):
     assert not any("bad_driver.py:9" in p for p in problems)
     assert not any("bad_driver.py:13" in p for p in problems)
     assert not any("outside.py" in p for p in problems)
+
+
+def test_lint_catches_streaming_jit_closures(tmp_path):
+    """Check 9 fires: in the streaming modules, a jit built inside a
+    function (closure risk over chunk-sized arrays — the HTTP-413
+    landmine) is reported, as is a module-level jit whose signature lacks
+    the chunk 'batch' argument; the sanctioned module-scope
+    decorator-with-batch form passes, and non-streaming modules are not
+    scanned."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    io_pkg = tmp_path / "photon_ml_tpu" / "io"
+    io_pkg.mkdir(parents=True)
+    (io_pkg / "stream_reader.py").write_text(
+        '"""Cites AvroDataReader.scala:1."""\n'
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('objective',))\n"
+        "def good_step(acc, batch, *, objective):\n"
+        "    return acc + objective(batch)\n"
+        "@jax.jit\n"
+        "def bad_no_batch(acc, w):\n"
+        "    return acc + w\n"
+        "def bad_nested(chunks, w):\n"
+        "    step = jax.jit(lambda acc: acc + chunks[0] @ w)\n"
+        "    return step(0.0)\n"
+    )
+    alg = tmp_path / "photon_ml_tpu" / "algorithm"
+    alg.mkdir(parents=True)
+    (alg / "other.py").write_text(
+        '"""Cites Foo.scala:1."""\n'
+        "import jax\n"
+        "def not_scanned(x):\n"
+        "    return jax.jit(lambda v: v)(x)  # not a streaming module\n"
+    )
+    problems = lint_parity.run_lints(tmp_path)
+    assert any(
+        "stream_reader.py:8" in p and "batch" in p for p in problems
+    ), problems
+    assert any(
+        "stream_reader.py:11" in p and "nested" in p for p in problems
+    ), problems
+    assert not any("good_step" in p for p in problems)
+    assert not any("other.py" in p for p in problems)

@@ -720,3 +720,152 @@ class TestDriverQuarantineJournal:
         events = rc.drain_quarantine_events()
         assert events
         json.dumps(events)  # journal rows must be strict JSON
+
+
+# ---------------------------------------------------------------------------
+# Out-of-core streaming epochs (io/stream_reader.py): the prefetch pipeline
+# ---------------------------------------------------------------------------
+
+
+class TestStreamingChaos:
+    """The chunk-prefetch pipeline under injected faults: transient decode
+    errors heal via RetryPolicy, a truncated mid-epoch block fails FAST
+    with the chunk attributed (or quarantines when opted in), and a wedged
+    or dead producer surfaces within the pipeline's own bounded timeouts —
+    never a hang (no pytest-timeout exists to save these)."""
+
+    def _chunk_source(self, tmp_path, *, on_corrupt="raise"):
+        from photon_ml_tpu.io.stream_reader import (
+            AvroChunkSource,
+            DenseRecordAssembler,
+        )
+        from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
+        from photon_ml_tpu.io.stream_reader import build_streaming_index_maps
+
+        path = str(tmp_path / "s.avro")
+        _write(path)  # 30 records, 3 blocks of 10
+        cfg = {"features": FeatureShardConfiguration(
+            feature_bags=("features",), has_intercept=False)}
+        imaps = build_streaming_index_maps([path], cfg)
+        source = AvroChunkSource(
+            [path],
+            DenseRecordAssembler(imaps["features"], cfg["features"]),
+            chunk_records=10,
+            on_corrupt=on_corrupt,
+        )
+        return path, source
+
+    def test_truncated_mid_epoch_block_fails_fast_attributed(self, tmp_path):
+        import time
+
+        from photon_ml_tpu.io.stream_reader import (
+            ChunkPrefetcher,
+            StreamDecodeError,
+        )
+
+        path, source = self._chunk_source(tmp_path)
+        assert source.num_chunks == 3
+        # torn AFTER planning: the epoch is mid-flight when decode hits it
+        faultinject.truncate_avro_block(path, block=1)
+        t0 = time.perf_counter()
+        got = []
+        with pytest.raises(StreamDecodeError, match=r"chunk 1") as ei:
+            with ChunkPrefetcher(
+                source, prefetch=True, retry_policy=_policy(),
+                chunk_timeout=10.0,
+            ) as chunks:
+                for batch in chunks:
+                    got.append(batch)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 8.0, f"not fail-fast: {elapsed:.1f}s"
+        assert len(got) == 1  # the intact chunk before the tear arrived
+        assert "runs=" in str(ei.value)  # file/block-span attribution
+
+    def test_truncated_mid_epoch_block_quarantines_when_opted_in(
+            self, tmp_path):
+        from photon_ml_tpu.io.stream_reader import ChunkPrefetcher
+
+        path, source = self._chunk_source(tmp_path, on_corrupt="quarantine")
+        faultinject.truncate_avro_block(path, block=1)
+        before = rc.quarantined_blocks()
+        true_rows = 0
+        with ChunkPrefetcher(
+            source, prefetch=True, retry_policy=_policy(),
+        ) as chunks:
+            for batch in chunks:
+                true_rows += int((np.asarray(batch.weights) != 0).sum())
+        # the tear costs exactly the unreachable span; intact data survives
+        assert true_rows == 10
+        assert rc.quarantined_blocks() > before
+        rc.drain_quarantine_events()
+
+    def test_transient_decode_failure_retries_and_heals(self):
+        from photon_ml_tpu.io.stream_reader import (
+            ArrayChunkSource,
+            ChunkPrefetcher,
+        )
+
+        x = np.arange(40.0).reshape(20, 2)
+        y = np.zeros(20)
+        source = ArrayChunkSource(
+            x, y, chunk_rows=5,
+            decode_hook=faultinject.flaky(failures=2),
+        )
+        before = rc.retries()
+        n = 0
+        with ChunkPrefetcher(
+            source, prefetch=True, retry_policy=_policy(max_attempts=3),
+        ) as chunks:
+            for _ in chunks:
+                n += 1
+        assert n == 4  # every chunk arrived; the flaky window healed
+        assert rc.retries() - before == 2
+
+    def test_fatal_decode_failure_surfaces_attributed_and_joins(self):
+        import time
+
+        from photon_ml_tpu.io.stream_reader import (
+            ArrayChunkSource,
+            ChunkPrefetcher,
+            StreamDecodeError,
+        )
+
+        def boom():
+            raise ValueError("bad bytes")  # classified FATAL: no retry
+
+        x = np.arange(40.0).reshape(20, 2)
+        source = ArrayChunkSource(x, np.zeros(20), chunk_rows=5,
+                                  decode_hook=boom)
+        t0 = time.perf_counter()
+        pf = ChunkPrefetcher(source, prefetch=True, retry_policy=_policy())
+        with pytest.raises(StreamDecodeError, match="chunk 0"):
+            with pf:
+                for _ in pf:
+                    pass
+        assert time.perf_counter() - t0 < 5.0
+        assert pf._thread is None  # close() joined and cleared the producer
+
+    def test_wedged_decode_times_out_within_bound(self):
+        import time
+
+        from photon_ml_tpu.io.stream_reader import (
+            ArrayChunkSource,
+            ChunkPrefetcher,
+            StreamDecodeError,
+        )
+
+        x = np.arange(40.0).reshape(20, 2)
+        source = ArrayChunkSource(
+            x, np.zeros(20), chunk_rows=5,
+            decode_hook=lambda: time.sleep(1.0),
+        )
+        t0 = time.perf_counter()
+        with pytest.raises(StreamDecodeError, match="wedged"):
+            with ChunkPrefetcher(
+                source, prefetch=True, retry_policy=_policy(),
+                chunk_timeout=0.2,
+            ) as chunks:
+                for _ in chunks:
+                    pass
+        # consumer bound (0.2 s) + bounded join over the 1 s sleeper
+        assert time.perf_counter() - t0 < 4.0
