@@ -19,6 +19,13 @@ ISSUE 3 names — each maps to a recovery path the chaos tests
 - :func:`poison_coordinate_updates` — NaN-poisons the first K model
   updates of one coordinate class (exercises DivergenceError +
   checkpoint-restore recovery).
+- :func:`crash_after_chunks` — kills the run mid-streaming-epoch after N
+  accumulated chunk decodes (exercises SolverCheckpointer resume through
+  run_with_recovery; ISSUE 8).
+- :func:`preempt_after_calls` / :func:`device_loss_error` — a simulated
+  pool preemption: a classified-transient device-loss error after N
+  jitted steps of any method (exercises preemption classification +
+  exchange-consistent partitioned checkpoint resume; ISSUE 8).
 
 Dev-tooling, not shipped API: lives next to dev/lint_parity.py and is
 imported only by tests.
@@ -137,6 +144,74 @@ def corrupt_checkpoint_step(directory: str | os.PathLike, step: int,
     size = os.path.getsize(path)
     with open(path, "r+b") as f:
         f.truncate(max(size // 2, 1))
+
+
+# ---------------------------------------------------------------------------
+# Simulated preemption / mid-epoch crash (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+def device_loss_error() -> RuntimeError:
+    """The device-loss shape a preemptible pool produces: jaxlib surfaces
+    it as a RuntimeError (XlaRuntimeError) whose TYPE carries no signal —
+    only the message does. Classified TRANSIENT (restart-worthy) and
+    ``resilience.errors.is_preemption``-positive."""
+    return RuntimeError(
+        "INTERNAL: TPU device lost: worker preempted by the pool "
+        "scheduler; Socket closed"
+    )
+
+
+@contextlib.contextmanager
+def crash_after_chunks(n: int, exc_factory=device_loss_error):
+    """Kill the streaming pipeline after its ``n``-th successful chunk
+    decode — the mid-epoch crash of a preemptible run. Patches
+    ``ChunkPrefetcher._load_timed`` (below the retry policy, so the error
+    surfaces undamped); fires ONCE, so a restarted attempt heals — the
+    resume-skips-completed-work assertion is then meaningful. Yields the
+    counter dict (tests assert ``fired`` to prove the crash happened)."""
+    from photon_ml_tpu.io.stream_reader import ChunkPrefetcher
+
+    real = ChunkPrefetcher._load_timed
+    state = {"loads": 0, "fired": False}
+
+    def wrapped(self, spec):
+        state["loads"] += 1
+        if not state["fired"] and state["loads"] > n:
+            state["fired"] = True
+            raise exc_factory()
+        return real(self, spec)
+
+    ChunkPrefetcher._load_timed = wrapped
+    try:
+        yield state
+    finally:
+        ChunkPrefetcher._load_timed = real
+
+
+@contextlib.contextmanager
+def preempt_after_calls(obj, method: str, n: int,
+                        exc_factory=device_loss_error):
+    """Simulated pool preemption: patch ``obj.method`` (a class or an
+    instance — e.g. ``GameTrainProgram.step``, the fused sweep's jitted
+    step) to raise a classified-transient device-loss error after ``n``
+    successful calls. Fires ONCE (the preempted worker comes back), so a
+    recovery restart completes. Yields the counter dict."""
+    real = getattr(obj, method)
+    state = {"calls": 0, "fired": False}
+
+    def wrapped(*args, **kwargs):
+        state["calls"] += 1
+        if not state["fired"] and state["calls"] > n:
+            state["fired"] = True
+            raise exc_factory()
+        return real(*args, **kwargs)
+
+    setattr(obj, method, wrapped)
+    try:
+        yield state
+    finally:
+        setattr(obj, method, real)
 
 
 # ---------------------------------------------------------------------------

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static parity-convention lints for photon_ml_tpu (CLAUDE.md conventions).
 
-Eight checks, all pure-AST (no jax import; runs in milliseconds):
+Ten checks, all pure-AST (no jax import; runs in milliseconds):
 
 1. **Docstring citations** — every ``photon_ml_tpu/**/*.py`` module (except
    ``__init__.py`` re-export shims) must carry a module docstring that
@@ -70,6 +70,23 @@ Eight checks, all pure-AST (no jax import; runs in milliseconds):
    hybrid x --partitioned-io rejection into a supported composition; the
    rejections that remain must never strand an operator without naming
    the composing alternative or the flag to change.
+
+9. **Nested jit in streaming modules** — every chunk-consuming jit in
+   io/stream_reader.py + algorithm/streaming.py must live at module scope
+   with the chunk batch in its ARGUMENT list: a jit built inside a
+   function can close over chunk-sized arrays, which serialize as
+   CONSTANTS into the remote-compile request and blow the tunnel's HTTP
+   limit at ~250 MB (the measured 413 landmine).
+
+10. **Ungated checkpoint writes in training loops** — every
+   ``TrainingCheckpointer``/``SolverCheckpointer`` write site in
+   ``parallel/`` and ``algorithm/`` must go through
+   ``io.checkpoint.commit_checkpoint`` (rank-0-gated per the
+   multi-process convention, barrier-committed when a MetadataExchange is
+   attached). A bare ``checkpointer.save(...)`` in a training loop lets a
+   worker rank race rank 0 on the shared directory, or commit a
+   checkpoint for a sweep some rank never finished (ISSUE 8's
+   exchange-consistency rule).
 
 Exit status 0 = clean; 1 = violations (printed one per line as
 ``path:lineno: message``). Run from the repo root:
@@ -561,6 +578,51 @@ def check_streaming_jit_closures(root: pathlib.Path) -> list[str]:
     return problems
 
 
+#: training-loop packages whose checkpoint writes must ride the commit
+#: helper (check 10); io/ itself (the helper + checkpointer internals)
+#: and estimators/cli (single-rank solver checkpointing, rank-gated at
+#: the library layer) are out of scope
+CHECKPOINT_WRITE_PREFIXES = (
+    f"{PACKAGE}/parallel/",
+    f"{PACKAGE}/algorithm/",
+)
+
+#: a receiver is "a checkpointer" when any identifier in its attribute
+#: chain mentions one — matches this repo's naming (checkpointer, ckpt,
+#: self.checkpointer); a same-named method on unrelated objects
+#: (imap.save, model saves) never matches
+_CHECKPOINTER_NAME_RE = re.compile(r"checkpoint|(^|\.)ckpt(\.|$)",
+                                   re.IGNORECASE)
+
+
+def check_checkpoint_commit_sites(root: pathlib.Path) -> list[str]:
+    problems = []
+    for path in sorted((root / PACKAGE).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if not rel.startswith(CHECKPOINT_WRITE_PREFIXES):
+            continue
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in ("save", "save_progress")
+            ):
+                continue
+            receiver = ".".join(_attribute_chain(fn)[:-1])
+            if _CHECKPOINTER_NAME_RE.search(receiver):
+                problems.append(
+                    f"{rel}:{node.lineno}: direct checkpointer "
+                    f"{fn.attr}() in a training-loop module — multi-rank "
+                    "checkpoint writes must go through io.checkpoint."
+                    "commit_checkpoint (rank-0-gated, barrier-committed; "
+                    "lint check 10)"
+                )
+    return problems
+
+
 def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
     root = pathlib.Path(root) if root else pathlib.Path(__file__).resolve().parents[1]
     return (
@@ -573,6 +635,7 @@ def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
         + check_segment_sum_num_segments(root)
         + check_cli_dead_end_rejections(root)
         + check_streaming_jit_closures(root)
+        + check_checkpoint_commit_sites(root)
     )
 
 

@@ -97,9 +97,13 @@ def run(
     # every rank's layout agrees (io/partitioned_reader.py); scores are
     # layout-independent either way.
     from photon_ml_tpu.telemetry import RunJournal
+    from photon_ml_tpu.telemetry.resilience_counters import (
+        reset_resilience_metrics,
+    )
     from photon_ml_tpu.util.timed import reset_timings, timing_summary
 
     reset_timings()
+    reset_resilience_metrics()
     journal = RunJournal(telemetry_dir) if telemetry_dir else None
     try:
         summary = _run_inner(

@@ -60,6 +60,7 @@ from photon_ml_tpu.ops.normalization import NormalizationType
 from photon_ml_tpu.telemetry import RunJournal, SolverTelemetry, default_registry
 from photon_ml_tpu.telemetry.layout import reset_layout_metrics
 from photon_ml_tpu.telemetry.probes import CompileMonitor, live_buffer_bytes
+from photon_ml_tpu.telemetry.resilience_counters import reset_resilience_metrics
 from photon_ml_tpu.telemetry.solver_trace import reset_solver_metrics
 from photon_ml_tpu.types import TaskType
 from photon_ml_tpu.util import (
@@ -273,6 +274,7 @@ def run(params: GameTrainingParams) -> dict:
     reset_timings()
     reset_solver_metrics()
     reset_layout_metrics()
+    reset_resilience_metrics()
     events.send(TrainingStartEvent(job_name="game-training"))
     job_log = PhotonLogger(os.path.join(out, "driver.log"))
     # rank-gated journal: inert on worker ranks, so telemetry calls below

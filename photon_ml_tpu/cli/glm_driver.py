@@ -31,9 +31,11 @@ from photon_ml_tpu.io.partitioned_reader import read_partitioned
 from photon_ml_tpu.io.model_io import write_glm_text
 from photon_ml_tpu.ops.normalization import NormalizationType, build_normalization
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+from photon_ml_tpu.resilience import run_with_recovery
 from photon_ml_tpu.telemetry import io_counters
 from photon_ml_tpu.telemetry import RunJournal, SolverTelemetry, default_registry
 from photon_ml_tpu.telemetry.layout import reset_layout_metrics
+from photon_ml_tpu.telemetry.resilience_counters import reset_resilience_metrics
 from photon_ml_tpu.telemetry.stream_counters import reset_stream_metrics
 from photon_ml_tpu.telemetry.probes import CompileMonitor
 from photon_ml_tpu.telemetry.solver_trace import reset_solver_metrics
@@ -119,6 +121,22 @@ class GLMDriverParams:
     #: disable the background prefetch thread (chunks decode inline) — the
     #: same-run OFF baseline for overlap measurements; streaming mode only
     streaming_prefetch: bool = True
+    #: crash-safe resume for streaming solves (io/checkpoint.
+    #: SolverCheckpointer): optimizer state + λ-grid position + epoch
+    #: cursor persist at every epoch boundary; a restarted run
+    #: fast-forwards past completed λs and resumes mid-solve. Requires
+    #: --streaming-chunks (the in-core solve has no epoch-granular state
+    #: to persist). None = disabled.
+    checkpoint_dir: str | None = None
+    #: iteration cadence for mid-solve snapshots (λ-boundary snapshots
+    #: always save): the solver state is model-sized, so giant-d runs
+    #: widen this instead of paying a blocking save every iteration
+    checkpoint_every: int = 1
+    #: crash-safe recovery budget (resilience/recovery.py): a classified-
+    #: transient failure (incl. device-loss/pool-preemption shapes)
+    #: restarts the run — resuming from the latest intact checkpoint when
+    #: --checkpoint-dir is set — up to this many times. 0 disables.
+    max_restarts: int = 2
 
 
 @dataclasses.dataclass
@@ -190,9 +208,24 @@ def _check_streaming_supported(params: "GLMDriverParams") -> None:
         )
 
 
+def _check_checkpoint_supported(params: "GLMDriverParams") -> None:
+    if params.checkpoint_dir and params.streaming_chunks <= 0:
+        raise ValueError(
+            "--checkpoint-dir resumes STREAMING solves (epoch-granular "
+            "solver state; io/checkpoint.SolverCheckpointer) — pass "
+            "--streaming-chunks N to opt in, or drop --checkpoint-dir "
+            "(the in-core path re-runs from scratch under --max-restarts)"
+        )
+    if params.max_restarts < 0:
+        raise ValueError("--max-restarts must be >= 0")
+    if params.checkpoint_every < 1:
+        raise ValueError("--checkpoint-every must be >= 1")
+
+
 def run(params: GLMDriverParams) -> GLMDriverResult:
     if params.streaming_chunks > 0:
         _check_streaming_supported(params)
+    _check_checkpoint_supported(params)
     if (
         params.coefficient_box_constraints
         and params.normalization != NormalizationType.NONE
@@ -213,6 +246,7 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
     reset_solver_metrics()
     reset_layout_metrics()
     reset_stream_metrics()
+    reset_resilience_metrics()
     journal = (
         RunJournal(params.telemetry_dir) if params.telemetry_dir else None
     )
@@ -235,15 +269,37 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
         "normalization": params.normalization.name,
         "streaming_chunks": params.streaming_chunks,
         "streaming_prefetch": params.streaming_prefetch,
+        "checkpoint_dir": params.checkpoint_dir,
+        "max_restarts": params.max_restarts,
     }
     events.send(SetupEvent(config_summary=json.dumps(config_summary)))
     events.send(TrainingStartEvent(job_name="glm-training"))
     if journal is not None:
         journal.record("config", **config_summary)
     compiles = CompileMonitor()
+    # crash-safe recovery (resilience/recovery.py — today GAME-only, now
+    # here too): a classified-transient failure (dropped tunnel, device
+    # loss/preemption) restarts the stages up to --max-restarts times; with
+    # --checkpoint-dir the streaming solve resumes from the latest intact
+    # epoch-boundary snapshot instead of from scratch
+    checkpointer = None
+    if params.checkpoint_dir:
+        from photon_ml_tpu.io.checkpoint import SolverCheckpointer
+
+        checkpointer = SolverCheckpointer(
+            params.checkpoint_dir, save_every=params.checkpoint_every
+        )
     try:
         with compiles:
-            result = _run_stages(params, telemetry)
+            result = run_with_recovery(
+                lambda restart: _run_stages(
+                    params, telemetry, checkpointer=checkpointer
+                ),
+                max_restarts=params.max_restarts,
+                checkpointer=checkpointer,
+                journal=journal,
+                description="glm training",
+            )
         events.send(TrainingFinishEvent(job_name="glm-training", succeeded=True))
         return result
     except Exception:
@@ -336,7 +392,8 @@ def _prepare_streaming(params: GLMDriverParams, shard_cfg):
     return source, index_maps, intercept_index, norm
 
 
-def _run_stages(params: GLMDriverParams, telemetry: SolverTelemetry) -> GLMDriverResult:
+def _run_stages(params: GLMDriverParams, telemetry: SolverTelemetry,
+                checkpointer=None) -> GLMDriverResult:
     stage = DriverStage.INIT
     shard_cfg = {"features": FeatureShardConfiguration(feature_bags=("features",))}
     streaming = params.streaming_chunks > 0
@@ -432,6 +489,7 @@ def _run_stages(params: GLMDriverParams, telemetry: SolverTelemetry) -> GLMDrive
                     prefetch=params.streaming_prefetch,
                     lower_bounds=lower_bounds,
                     upper_bounds=upper_bounds,
+                    checkpointer=checkpointer,
                 )
             else:
                 # telemetry only on the primary grid: diagnostics re-fits
@@ -571,6 +629,22 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
                    help="decode chunks inline instead of on the "
                         "background prefetch thread (the same-run OFF "
                         "baseline for overlap measurements)")
+    p.add_argument("--checkpoint-dir",
+                   help="crash-safe resume for --streaming-chunks runs: "
+                        "solver state + λ-grid position + epoch cursor "
+                        "persist at epoch boundaries; a restarted run "
+                        "fast-forwards past completed λs and resumes "
+                        "mid-solve")
+    p.add_argument("--checkpoint-every", type=int, default=1,
+                   help="save the mid-solve snapshot every N solver "
+                        "iterations (λ-boundary snapshots always save; "
+                        "widen for giant-d runs where the state is large)")
+    p.add_argument("--max-restarts", type=int, default=2,
+                   help="recovery budget: restart after a classified-"
+                        "transient failure (incl. device-loss/preemption "
+                        "shapes) up to N times, resuming from the latest "
+                        "intact checkpoint when --checkpoint-dir is set "
+                        "(0 disables)")
     args = p.parse_args(argv)
     return run(
         GLMDriverParams(
@@ -597,6 +671,9 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
             on_corrupt=args.on_corrupt,
             streaming_chunks=args.streaming_chunks,
             streaming_prefetch=not args.no_streaming_prefetch,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            max_restarts=args.max_restarts,
         )
     )
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import os
 from typing import Mapping, Sequence
 
 import jax
@@ -349,8 +350,11 @@ class GameEstimator:
             problems.append(
                 "normalization (feature stats would be rank-local)"
             )
-        if self.checkpointer is not None:
-            problems.append("checkpointing")
+        # checkpointing composes since ISSUE 8: train_partitioned gathers
+        # the model-sized state on every rank and commits through the
+        # rank-0-gated, exchange-barrier'd io.checkpoint.commit_checkpoint,
+        # with the partition plan + agreed sparse layout fingerprinted in
+        # meta.json (a resume under a different topology fails fast)
         # the primary FE (first trainable fixed effect in the sequence) is
         # the one coordinate that may be sparse — its hybrid head / ELL
         # width were made globally consistent by the partitioned reader
@@ -766,6 +770,13 @@ class GameEstimator:
                 fe_feature_sharded=self.fe_feature_sharded,
                 check_finite=self.check_finite,
                 schedulers=make_schedulers(re_specs, mesh=self.mesh) or None,
+                checkpointer=self.checkpointer,
+                checkpoint_every=self.checkpoint_every,
+                resume=self.resume,
+                # the ingest exchange also gates the checkpoint commit
+                # barriers (exchange-consistent: a checkpoint exists only
+                # for sweeps every rank completed)
+                exchange=partition.exchange,
             )
         else:
             result = train_distributed(
@@ -1186,6 +1197,25 @@ def train_glm(
     return models
 
 
+def _normalization_digest(norm) -> str | None:
+    """16-hex content digest of a NormalizationContext's factor/shift
+    arrays (None for no normalization) — the streaming checkpoint
+    fingerprint field that makes a resume under DIFFERENT normalization
+    statistics fail fast (the class name cannot: every non-NONE type is
+    the same NormalizationContext)."""
+    if norm is None:
+        return None
+    import hashlib
+
+    h = hashlib.sha256()
+    for part in (norm.factors, norm.shifts):
+        if part is None:
+            h.update(b"none")
+        else:
+            h.update(np.ascontiguousarray(jax.device_get(part)).tobytes())
+    return h.hexdigest()[:16]
+
+
 def train_glm_streaming(
     source,
     task: TaskType,
@@ -1203,6 +1233,7 @@ def train_glm_streaming(
     chunk_timeout: float | None = None,
     lower_bounds=None,
     upper_bounds=None,
+    checkpointer=None,
 ) -> dict[float, GeneralizedLinearModel]:
     """Single-GLM regularization path over an OUT-OF-CORE chunk stream.
 
@@ -1223,9 +1254,26 @@ def train_glm_streaming(
     block assignment and the per-epoch accumulators sum in rank order.
     ``prefetch=False`` decodes inline (the same-run OFF baseline the bench
     row measures against).
+
+    ``checkpointer``: optional ``io.checkpoint.SolverCheckpointer`` —
+    crash-safe resume for the streaming path. Every outer solver iteration
+    (an epoch boundary: each iteration is an integral number of chunked
+    epochs) persists the full optimizer state + λ-grid position + epoch
+    cursor through the atomic checkpoint contract; a restarted run
+    fast-forwards past completed λs, re-enters the in-flight solve
+    MID-STATE (no epochs redone — counted on ``resilience/
+    epochs_resumed``), and continues bitwise where it left off (one eval
+    path, state arrays round-trip exactly). A checkpoint written under a
+    different λ grid/optimizer/input fingerprint fails fast with the
+    differing fields named. None (default) is bitwise the un-checkpointed
+    path. With ``exchange``, only rank 0 writes (shared directory); every
+    rank restores the same snapshot — the per-rank solves are
+    deterministic replicas after the rank-ordered accumulator sums.
     """
     from photon_ml_tpu.algorithm.streaming import StreamingGLMObjective
     from photon_ml_tpu.io.stream_reader import DEFAULT_CHUNK_TIMEOUT
+    from photon_ml_tpu.optim.optimizer import solver_state_class
+    from photon_ml_tpu.telemetry import resilience_counters
 
     optimizer = optimizer or OptimizerConfig()
     if optimizer.optimizer_type == OptimizerType.NEWTON:
@@ -1253,9 +1301,85 @@ def train_glm_streaming(
         from photon_ml_tpu.data.batch import solve_dtype_of
 
         solve_dtype = solve_dtype_of(src_dtype)
+    lams = sorted(float(l) for l in regularization_weights)
+
+    # -- crash-safe resume: the fingerprint pins everything a restored
+    # solver state is only valid under; a stale/mismatched checkpoint
+    # fails fast attributed instead of silently resuming a different solve
+    fingerprint = None
+    start_index = 0
+    completed: list[tuple[float, np.ndarray]] = []
+    resume_state_arrays = None
+    epochs_total = 0
+    resume_epochs_lambda = 0
+    writes = exchange is None or exchange.rank == 0
+    if checkpointer is not None:
+        # EVERYTHING a restored solver state is only valid under — a
+        # changed history size would mis-slot L-BFGS curvature pairs, a
+        # changed tolerance/task/normalization would silently resume a
+        # different solve; all of it fails fast attributed instead
+        fingerprint = {
+            "kind": "glm_streaming",
+            "task": task.name,
+            "lambdas": lams,
+            "optimizer": optimizer.optimizer_type.name,
+            "max_iterations": int(optimizer.max_iterations),
+            "history": int(optimizer.history),
+            "tolerance": float(optimizer.tolerance),
+            "rel_function_tolerance": (
+                None if optimizer.rel_function_tolerance is None
+                else float(optimizer.rel_function_tolerance)
+            ),
+            "max_cg_iterations": int(optimizer.max_cg_iterations),
+            "elastic_net_alpha": float(elastic_net_alpha),
+            # content digest, not a class name: every non-NONE
+            # normalization type builds the same NormalizationContext
+            # class — only the factor/shift ARRAYS distinguish the solve
+            # space a restored state is valid in
+            "normalization": _normalization_digest(normalization),
+            "intercept_index": (
+                None if intercept_index is None else int(intercept_index)
+            ),
+            "bounded": bool(
+                lower_bounds is not None or upper_bounds is not None
+            ),
+            "dim": int(source.dim),
+            "num_chunks": int(source.num_chunks),
+            "total_records": int(source.total_records),
+            "num_ranks": 1 if exchange is None else int(exchange.num_ranks),
+            # input IDENTITY, not just shape: a daily re-run against new
+            # data of the same geometry must fail fast, not resume the old
+            # run's mid-solve state against different bytes (file-backed
+            # sources only; in-memory sources carry no stable identity)
+            "input": (
+                None if getattr(source, "files", None) is None
+                else [
+                    [os.path.basename(f), int(os.path.getsize(f))]
+                    for f in source.files
+                ]
+            ),
+        }
+        progress = checkpointer.restore_progress(fingerprint)
+        if progress is not None:
+            start_index = progress.lam_index
+            completed = list(progress.completed)
+            resume_state_arrays = progress.state_arrays
+            epochs_total = progress.epochs_total
+            resume_epochs_lambda = progress.epochs_lambda
+            resilience_counters.record_checkpoint_restore()
+            resilience_counters.record_epochs_resumed(
+                progress.epochs_total + progress.epochs_lambda
+            )
+            logger.info(
+                "resuming streaming solve from checkpoint: λ %d/%d, "
+                "iteration %d (%d epochs not redone)",
+                start_index, len(lams), progress.iteration,
+                progress.epochs_total + progress.epochs_lambda,
+            )
+
     models: dict[float, GeneralizedLinearModel] = {}
     w = jnp.zeros((source.dim,), dtype=solve_dtype)
-    for lam in sorted(regularization_weights):
+    for li, lam in enumerate(lams):
         l1 = elastic_net_alpha * lam
         l2 = (1.0 - elastic_net_alpha) * lam
         objective = StreamingGLMObjective(
@@ -1271,11 +1395,49 @@ def train_glm_streaming(
                 else chunk_timeout
             ),
         )
+        norm = objective.objective.normalization
+        if li < start_index:
+            # completed before the restored checkpoint: the saved
+            # solve-space coefficients ARE the model (and the next λ's
+            # warm start) — zero epochs spent
+            w = jnp.asarray(completed[li][1], solve_dtype)
+            models[lam] = GeneralizedLinearModel(
+                Coefficients(means=norm.to_model_space(w, intercept_index)),
+                task,
+            )
+            continue
         opt = optimizer
         if l1 > 0.0:
             opt = dataclasses.replace(
                 optimizer.with_l1(l1), optimizer_type=OptimizerType.OWLQN
             )
+        resume_state = None
+        if li == start_index and resume_state_arrays is not None:
+            cls = solver_state_class(opt)
+            resume_state = cls(**{
+                k: jnp.asarray(v) for k, v in resume_state_arrays.items()
+            })
+            objective.epochs = resume_epochs_lambda
+        state_observer = None
+        if checkpointer is not None and writes:
+            def state_observer(state, _li=li, _obj=objective,
+                               _mi=opt.max_iterations):
+                if int(state.iteration) % checkpointer.save_every:
+                    return  # cadence: model-sized snapshots are not free
+                if int(state.reason) != 0 or int(state.iteration) >= _mi:
+                    # the loop exits on this state; the λ-boundary
+                    # snapshot right after solve() covers it — don't pay
+                    # a second model-sized save for the same progress
+                    return
+                checkpointer.save_progress(
+                    fingerprint=fingerprint,
+                    lam_index=_li,
+                    iteration=int(state.iteration),
+                    epochs_total=epochs_total,
+                    epochs_lambda=_obj.epochs,
+                    completed=completed,
+                    solver_state=state,
+                )
         result = solve(
             opt, objective, w,
             lower_bounds=(
@@ -1287,15 +1449,31 @@ def train_glm_streaming(
                 else jnp.asarray(upper_bounds, solve_dtype)
             ),
             host_loop=True,
+            state_observer=state_observer,
+            resume_state=resume_state,
         )
         w = result.coefficients
+        if checkpointer is not None:
+            completed.append((lam, np.asarray(jax.device_get(w))))
+            epochs_total += objective.epochs
+            if writes:
+                # λ-boundary snapshot: a crash between λs resumes with
+                # this λ done and no in-flight solver state
+                checkpointer.save_progress(
+                    fingerprint=fingerprint,
+                    lam_index=li + 1,
+                    iteration=0,
+                    epochs_total=epochs_total,
+                    epochs_lambda=0,
+                    completed=completed,
+                    solver_state=None,
+                )
         if telemetry is not None:
             telemetry.record_solve(
                 "glm_streaming", result,
                 extra={"lambda": lam, "epochs": objective.epochs,
                        "chunks": source.num_chunks},
             )
-        norm = objective.objective.normalization
         models[lam] = GeneralizedLinearModel(
             Coefficients(means=norm.to_model_space(w, intercept_index)), task
         )

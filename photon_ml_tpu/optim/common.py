@@ -31,7 +31,7 @@ class ConvergenceReason(enum.IntEnum):
     LINE_SEARCH_FAILED = 4
 
 
-def run_while(cond, body, init, *, host: bool = False):
+def run_while(cond, body, init, *, host: bool = False, observer=None):
     """``lax.while_loop`` — or, with ``host=True``, the IDENTICAL loop body
     driven from Python with concrete arrays.
 
@@ -46,14 +46,27 @@ def run_while(cond, body, init, *, host: bool = False):
     arithmetic step for step (differences come only from the chunked
     summation order inside the objective, i.e. float round-off).
 
+    ``observer`` (host mode only): called with the state after every body
+    step — the epoch-boundary hook solver-state checkpointing rides
+    (io/checkpoint.SolverCheckpointer). It observes, never rewrites: the
+    state it receives is the state the loop continues with, so a solve
+    with an observer is bitwise the solve without one.
+
     The default (``host=False``) compiles to the exact same
     ``lax.while_loop`` call as before this parameter existed.
     """
     if not host:
+        if observer is not None:
+            raise ValueError(
+                "run_while(observer=...) requires host=True — a compiled "
+                "lax.while_loop body cannot call back to the host"
+            )
         return lax.while_loop(cond, body, init)
     state = init
     while bool(cond(state)):
         state = body(state)
+        if observer is not None:
+            observer(state)
     return state
 
 

@@ -107,6 +107,8 @@ def minimize_lbfgs(
     upper_bounds: Array | None = None,
     max_line_search_steps: int = 25,
     host_loop: bool = False,
+    state_observer=None,
+    resume_state: "_LBFGSState | None" = None,
 ) -> SolverResult:
     """Minimize a smooth function with L-BFGS. Jit- and vmap-safe.
 
@@ -114,6 +116,15 @@ def minimize_lbfgs(
     loop (optim/common.run_while) so ``value_and_grad_fn`` may be a HOST
     function — the out-of-core streaming epoch accumulator
     (algorithm/streaming.py). The default compiles exactly as before.
+
+    ``state_observer`` / ``resume_state`` (host_loop only — crash-safe
+    streaming solves, io/checkpoint.SolverCheckpointer): the observer sees
+    the full ``_LBFGSState`` after every outer iteration (an epoch
+    boundary — each iteration is an integral number of chunked epochs);
+    ``resume_state`` re-enters the loop from a checkpointed state WITHOUT
+    re-evaluating the initial point (the whole saving — the skipped
+    iterations each cost epochs). Both default to None, which is bitwise
+    the pre-existing solve.
 
     With ``lower_bounds``/``upper_bounds`` set, iterates are projected onto
     the box after every accepted step and convergence is tested on the
@@ -125,6 +136,11 @@ def minimize_lbfgs(
     while_loop condition, so warm-started vmapped lanes can actually exit
     instead of paying max_iter (optim/common.check_convergence).
     """
+    if (state_observer is not None or resume_state is not None) and not host_loop:
+        raise ValueError(
+            "state_observer/resume_state require host_loop=True (solver-"
+            "state checkpointing exists for host-driven streaming solves)"
+        )
     dtype = w0.dtype
     d = w0.shape[0]
     m = history
@@ -142,36 +158,42 @@ def minimize_lbfgs(
         # norm of P(w - g) - w: zero iff w is box-stationary
         return jnp.linalg.norm(project(w - g) - w)
 
-    w0 = project(jnp.asarray(w0, dtype))
-    f0, g0 = value_and_grad_fn(w0)
-    g0_norm = projected_grad_norm(w0, g0)
+    if resume_state is not None:
+        # checkpointed re-entry: the saved state already holds f/g/history
+        # for its iterate — re-evaluating w0 would cost an epoch for
+        # numbers the checkpoint carries
+        init = resume_state
+    else:
+        w0 = project(jnp.asarray(w0, dtype))
+        f0, g0 = value_and_grad_fn(w0)
+        g0_norm = projected_grad_norm(w0, g0)
 
-    nan_hist = jnp.full((max_iter + 1,), jnp.nan, dtype)
-    init = _LBFGSState(
-        w=w0,
-        f=f0,
-        g=g0,
-        s_hist=jnp.zeros((m, d), dtype),
-        y_hist=jnp.zeros((m, d), dtype),
-        rho=jnp.zeros((m,), dtype),
-        count=jnp.int32(0),
-        head=jnp.int32(0),
-        iteration=jnp.int32(0),
-        reason=jnp.int32(ConvergenceReason.NOT_CONVERGED),
-        prev_f=jnp.asarray(jnp.inf, dtype),
-        g0_norm=g0_norm,
-        value_history=nan_hist.at[0].set(f0),
-        grad_norm_history=nan_hist.at[0].set(g0_norm),
-    )
-
-    # Already stationary at the initial point?
-    init = init.replace(
-        reason=jnp.where(
-            g0_norm <= tolerance,
-            jnp.int32(ConvergenceReason.GRADIENT_WITHIN_TOLERANCE),
-            init.reason,
+        nan_hist = jnp.full((max_iter + 1,), jnp.nan, dtype)
+        init = _LBFGSState(
+            w=w0,
+            f=f0,
+            g=g0,
+            s_hist=jnp.zeros((m, d), dtype),
+            y_hist=jnp.zeros((m, d), dtype),
+            rho=jnp.zeros((m,), dtype),
+            count=jnp.int32(0),
+            head=jnp.int32(0),
+            iteration=jnp.int32(0),
+            reason=jnp.int32(ConvergenceReason.NOT_CONVERGED),
+            prev_f=jnp.asarray(jnp.inf, dtype),
+            g0_norm=g0_norm,
+            value_history=nan_hist.at[0].set(f0),
+            grad_norm_history=nan_hist.at[0].set(g0_norm),
         )
-    )
+
+        # Already stationary at the initial point?
+        init = init.replace(
+            reason=jnp.where(
+                g0_norm <= tolerance,
+                jnp.int32(ConvergenceReason.GRADIENT_WITHIN_TOLERANCE),
+                init.reason,
+            )
+        )
 
     def cond(state: _LBFGSState):
         return (state.iteration < max_iter) & (
@@ -305,7 +327,7 @@ def minimize_lbfgs(
             grad_norm_history=state.grad_norm_history.at[it].set(gnorm),
         )
 
-    final = run_while(cond, body, init, host=host_loop)
+    final = run_while(cond, body, init, host=host_loop, observer=state_observer)
     reason = jnp.where(
         final.reason == ConvergenceReason.NOT_CONVERGED,
         jnp.int32(ConvergenceReason.MAX_ITERATIONS),

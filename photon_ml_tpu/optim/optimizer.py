@@ -106,6 +106,8 @@ def solve(
     lower_bounds: Array | None = None,
     upper_bounds: Array | None = None,
     host_loop: bool = False,
+    state_observer=None,
+    resume_state=None,
 ) -> SolverResult:
     """Run the configured solver on a bound objective. Pure; jit/vmap-safe.
 
@@ -113,8 +115,21 @@ def solve(
     from Python loops so the objective may be a host-level chunked-epoch
     accumulator (algorithm/streaming.py); LBFGS/OWLQN/TRON only — NEWTON
     needs a dense [d, d] Hessian no streaming objective materializes.
+
+    ``state_observer`` / ``resume_state`` (host_loop only): the solver-
+    state checkpoint hooks (io/checkpoint.SolverCheckpointer) — the
+    observer sees the solver's state struct after every outer iteration,
+    ``resume_state`` re-enters from a restored one. The matching state
+    class is ``solver_state_class(config)``.
     """
     t = config.optimizer_type
+    if (state_observer is not None or resume_state is not None) and (
+        not host_loop or t == OptimizerType.NEWTON
+    ):
+        raise ValueError(
+            "state_observer/resume_state cover the host-loop LBFGS/OWLQN/"
+            "TRON solvers only (streaming solver checkpointing)"
+        )
     if host_loop and t == OptimizerType.NEWTON:
         raise ValueError(
             "NEWTON has no host-loop (streaming) mode — it needs the dense "
@@ -140,6 +155,8 @@ def solve(
             lower_bounds=lower_bounds,
             upper_bounds=upper_bounds,
             host_loop=host_loop,
+            state_observer=state_observer,
+            resume_state=resume_state,
         )
     if t == OptimizerType.LBFGSB:
         if lower_bounds is None and upper_bounds is None:
@@ -154,6 +171,8 @@ def solve(
             lower_bounds=lower_bounds,
             upper_bounds=upper_bounds,
             host_loop=host_loop,
+            state_observer=state_observer,
+            resume_state=resume_state,
         )
     if t == OptimizerType.OWLQN:
         return minimize_owlqn(
@@ -165,6 +184,8 @@ def solve(
             tolerance=config.tolerance,
             rel_function_tolerance=config.rel_function_tolerance,
             host_loop=host_loop,
+            state_observer=state_observer,
+            resume_state=resume_state,
         )
     if t == OptimizerType.TRON:
         loss = objective.objective.loss
@@ -182,6 +203,8 @@ def solve(
             rel_function_tolerance=config.rel_function_tolerance,
             max_cg_iter=config.max_cg_iterations,
             host_loop=host_loop,
+            state_observer=state_observer,
+            resume_state=resume_state,
         )
     if t == OptimizerType.NEWTON:
         loss = objective.objective.loss
@@ -209,6 +232,29 @@ def solve(
             rel_function_tolerance=config.rel_function_tolerance,
         )
     raise ValueError(f"Unknown optimizer type {t}")
+
+
+def solver_state_class(config: OptimizerConfig):
+    """The flax-struct state class ``solve(config, ..., host_loop=True)``
+    hands to a ``state_observer`` — the (de)serialization contract of
+    io/checkpoint.SolverCheckpointer. The effective solver for an
+    elastic-net λ is OWLQN whenever ``l1_weight`` > 0 (estimators'
+    per-λ switch), which this lookup mirrors via ``optimizer_type``."""
+    from photon_ml_tpu.optim.lbfgs import _LBFGSState
+    from photon_ml_tpu.optim.owlqn import _OWLQNState
+    from photon_ml_tpu.optim.tron import _TRONState
+
+    t = config.optimizer_type
+    if t in (OptimizerType.LBFGS, OptimizerType.LBFGSB):
+        return _LBFGSState
+    if t == OptimizerType.OWLQN:
+        return _OWLQNState
+    if t == OptimizerType.TRON:
+        return _TRONState
+    raise ValueError(
+        f"{t.name} has no host-loop (streaming) mode, so no checkpointable "
+        "solver state"
+    )
 
 
 def default_config_for(optimizer_type: OptimizerType) -> OptimizerConfig:

@@ -72,6 +72,8 @@ def minimize_owlqn(
     rel_function_tolerance: float | None = None,
     max_line_search_steps: int = 30,
     host_loop: bool = False,
+    state_observer=None,
+    resume_state: "_OWLQNState | None" = None,
 ) -> SolverResult:
     """Minimize smooth(w) + l1_weight * ‖w‖₁.
 
@@ -81,7 +83,16 @@ def minimize_owlqn(
     ``host_loop=True``: identical body math driven from Python so
     ``value_and_grad_fn`` may be a host-level streaming epoch accumulator
     (optim/common.run_while).
+
+    ``state_observer`` / ``resume_state`` (host_loop only): per-iteration
+    state hook + checkpointed re-entry for crash-safe streaming solves —
+    same contract as optim/lbfgs.minimize_lbfgs.
     """
+    if (state_observer is not None or resume_state is not None) and not host_loop:
+        raise ValueError(
+            "state_observer/resume_state require host_loop=True (solver-"
+            "state checkpointing exists for host-driven streaming solves)"
+        )
     dtype = w0.dtype
     d = w0.shape[0]
     m = history
@@ -90,32 +101,35 @@ def minimize_owlqn(
     def full_value(w, smooth_f):
         return smooth_f + l1 * jnp.sum(jnp.abs(w))
 
-    w0 = jnp.asarray(w0, dtype)
-    sf0, g0 = value_and_grad_fn(w0)
-    f0 = full_value(w0, sf0)
-    pg0 = pseudo_gradient(w0, g0, l1)
-    g0_norm = jnp.linalg.norm(pg0)
+    if resume_state is not None:
+        init = resume_state
+    else:
+        w0 = jnp.asarray(w0, dtype)
+        sf0, g0 = value_and_grad_fn(w0)
+        f0 = full_value(w0, sf0)
+        pg0 = pseudo_gradient(w0, g0, l1)
+        g0_norm = jnp.linalg.norm(pg0)
 
-    nan_hist = jnp.full((max_iter + 1,), jnp.nan, dtype)
-    init = _OWLQNState(
-        w=w0,
-        f=f0,
-        g=g0,
-        s_hist=jnp.zeros((m, d), dtype),
-        y_hist=jnp.zeros((m, d), dtype),
-        rho=jnp.zeros((m,), dtype),
-        count=jnp.int32(0),
-        head=jnp.int32(0),
-        iteration=jnp.int32(0),
-        reason=jnp.where(
-            g0_norm <= tolerance,
-            jnp.int32(ConvergenceReason.GRADIENT_WITHIN_TOLERANCE),
-            jnp.int32(ConvergenceReason.NOT_CONVERGED),
-        ),
-        g0_norm=g0_norm,
-        value_history=nan_hist.at[0].set(f0),
-        grad_norm_history=nan_hist.at[0].set(g0_norm),
-    )
+        nan_hist = jnp.full((max_iter + 1,), jnp.nan, dtype)
+        init = _OWLQNState(
+            w=w0,
+            f=f0,
+            g=g0,
+            s_hist=jnp.zeros((m, d), dtype),
+            y_hist=jnp.zeros((m, d), dtype),
+            rho=jnp.zeros((m,), dtype),
+            count=jnp.int32(0),
+            head=jnp.int32(0),
+            iteration=jnp.int32(0),
+            reason=jnp.where(
+                g0_norm <= tolerance,
+                jnp.int32(ConvergenceReason.GRADIENT_WITHIN_TOLERANCE),
+                jnp.int32(ConvergenceReason.NOT_CONVERGED),
+            ),
+            g0_norm=g0_norm,
+            value_history=nan_hist.at[0].set(f0),
+            grad_norm_history=nan_hist.at[0].set(g0_norm),
+        )
 
     def cond(state: _OWLQNState):
         return (state.iteration < max_iter) & (
@@ -223,7 +237,7 @@ def minimize_owlqn(
             grad_norm_history=state.grad_norm_history.at[it].set(gnorm),
         )
 
-    final = run_while(cond, body, init, host=host_loop)
+    final = run_while(cond, body, init, host=host_loop, observer=state_observer)
     reason = jnp.where(
         final.reason == ConvergenceReason.NOT_CONVERGED,
         jnp.int32(ConvergenceReason.MAX_ITERATIONS),

@@ -110,6 +110,8 @@ def minimize_tron(
     max_cg_iter: int = 20,
     cg_forcing: float = 0.1,
     host_loop: bool = False,
+    state_observer=None,
+    resume_state: "_TRONState | None" = None,
 ) -> SolverResult:
     """Minimize a twice-differentiable convex objective with TRON.
 
@@ -124,30 +126,44 @@ def minimize_tron(
     test): live relative function-decrease stop on accepted rounds — the
     same warm-start exit the LBFGS/OWLQN/NEWTON family gained
     (optim/common.check_convergence semantics).
-    """
-    dtype = w0.dtype
-    w0 = jnp.asarray(w0, dtype)
-    f0, g0 = value_and_grad_fn(w0)
-    g0_norm = jnp.linalg.norm(g0)
 
-    nan_hist = jnp.full((max_iter + 1,), jnp.nan, dtype)
-    init = _TRONState(
-        w=w0,
-        f=f0,
-        g=g0,
-        delta=g0_norm,
-        iteration=jnp.int32(0),
-        # Warm starts arrive already-stationary: stop before paying a CG loop.
-        # (The in-loop test is relative to g0; at iteration 0 only an absolute
-        # test is meaningful.)
-        reason=jnp.where(
-            g0_norm <= tolerance,
-            jnp.int32(ConvergenceReason.GRADIENT_WITHIN_TOLERANCE),
-            jnp.int32(ConvergenceReason.NOT_CONVERGED),
-        ),
-        value_history=nan_hist.at[0].set(f0),
-        grad_norm_history=nan_hist.at[0].set(g0_norm),
-    )
+    ``state_observer`` / ``resume_state`` (host_loop only): per-outer-
+    iteration state hook + checkpointed re-entry for crash-safe streaming
+    solves — same contract as optim/lbfgs.minimize_lbfgs. The inner CG
+    loop is never observed or resumed mid-flight: an outer iteration is
+    the atomic (epoch-boundary) unit.
+    """
+    if (state_observer is not None or resume_state is not None) and not host_loop:
+        raise ValueError(
+            "state_observer/resume_state require host_loop=True (solver-"
+            "state checkpointing exists for host-driven streaming solves)"
+        )
+    dtype = w0.dtype
+    if resume_state is not None:
+        init = resume_state
+    else:
+        w0 = jnp.asarray(w0, dtype)
+        f0, g0 = value_and_grad_fn(w0)
+        g0_norm = jnp.linalg.norm(g0)
+
+        nan_hist = jnp.full((max_iter + 1,), jnp.nan, dtype)
+        init = _TRONState(
+            w=w0,
+            f=f0,
+            g=g0,
+            delta=g0_norm,
+            iteration=jnp.int32(0),
+            # Warm starts arrive already-stationary: stop before paying a
+            # CG loop. (The in-loop test is relative to g0; at iteration 0
+            # only an absolute test is meaningful.)
+            reason=jnp.where(
+                g0_norm <= tolerance,
+                jnp.int32(ConvergenceReason.GRADIENT_WITHIN_TOLERANCE),
+                jnp.int32(ConvergenceReason.NOT_CONVERGED),
+            ),
+            value_history=nan_hist.at[0].set(f0),
+            grad_norm_history=nan_hist.at[0].set(g0_norm),
+        )
 
     def cond(state: _TRONState):
         return (state.iteration < max_iter) & (
@@ -239,7 +255,7 @@ def minimize_tron(
             grad_norm_history=state.grad_norm_history.at[it].set(gnorm_acc),
         )
 
-    final = run_while(cond, body, init, host=host_loop)
+    final = run_while(cond, body, init, host=host_loop, observer=state_observer)
     reason = jnp.where(
         final.reason == ConvergenceReason.NOT_CONVERGED,
         jnp.int32(ConvergenceReason.MAX_ITERATIONS),

@@ -2107,17 +2107,19 @@ def train_distributed(
         if checkpointer is not None and (
             (sweep + 1) % max(1, checkpoint_every) == 0 or sweep + 1 == num_iterations
         ):
-            # every process participates in the gathers (collectives);
-            # only process 0 touches the (shared) checkpoint directory
+            # every process participates in the gathers (collectives); the
+            # commit helper gates the write to process 0 (the shared
+            # checkpoint directory convention; lint check 10)
+            from photon_ml_tpu.io.checkpoint import commit_checkpoint
+
             arrays = state_arrays(state)
             if best_state is not None:
                 arrays.update(state_arrays(best_state, prefix="best/"))
-            if jax.process_index() == 0:
-                checkpointer.save(
-                    sweep + 1, arrays,
-                    {"losses": losses, "metric_history": history,
-                     "best_metric": best_metric},
-                )
+            commit_checkpoint(
+                checkpointer, sweep + 1, arrays,
+                {"losses": losses, "metric_history": history,
+                 "best_metric": best_metric},
+            )
     def result_state(state_: GameTrainState) -> GameTrainState:
         clean = unpadded(state_)
         if jax.process_count() > 1:
@@ -2399,6 +2401,56 @@ def prepare_partitioned_inputs(
     return data, buckets, state
 
 
+def _partition_fingerprint(program: GameTrainProgram, parts,
+                           num_ranks: int) -> dict:
+    """The agreement a partitioned checkpoint is only valid under: rank
+    geometry (the per-rank block a restored table row maps to), the
+    agreed GLOBAL sparse layout (``io/partitioned_reader.
+    _resolve_global_sparse_layout``'s hybrid hot head / ELL width / flat
+    overflow — per-partition statistics must pin the global decision they
+    were trained with, arXiv:2004.02414), and the coordinate structure.
+    A resume under a different rank count or layout agreement fails fast
+    attributed (train_partitioned's restore check) instead of silently
+    training on mis-mapped rows. Computed from any single rank's LOCAL
+    part — these are exactly the globally-agreed quantities, identical on
+    every rank by the reader's exchange."""
+    import hashlib
+
+    r0 = sorted(parts)[0]
+    ds, res = parts[r0]
+    fe_shard = ds.feature_shards[program.fe.feature_shard_id]
+    if isinstance(fe_shard, SparseShard):
+        policy = fe_shard.hybrid_policy
+        hot = tuple(policy.hot_ids) if policy is not None and policy.hot_ids else ()
+        layout = {
+            "dim": int(fe_shard.feature_dim),
+            "ell_width": (
+                None if fe_shard.ell_width is None else int(fe_shard.ell_width)
+            ),
+            "flat_block_nnz": (
+                None if fe_shard.flat_block_nnz is None
+                else int(fe_shard.flat_block_nnz)
+            ),
+            "k_hot": len(hot),
+            "hot_hash": hashlib.sha256(
+                np.asarray(hot, np.int64).tobytes()
+            ).hexdigest()[:16],
+        }
+    else:
+        layout = {"dim": int(np.asarray(fe_shard).shape[1])}
+    return {
+        "num_ranks": int(num_ranks),
+        "block_rows": int(ds.num_samples),
+        "fe_shard": program.fe.feature_shard_id,
+        "fe_layout": layout,
+        "re_entities": {
+            s.re_type: int(res[s.re_type].num_entities)
+            for s in program.re_specs
+        },
+        "extra_fe": sorted(s.feature_shard_id for s in program.extra_fes),
+    }
+
+
 def train_partitioned(
     program: GameTrainProgram,
     parts: "Mapping[int, tuple[GameDataset, Mapping[str, RandomEffectDataset]]]",
@@ -2410,19 +2462,122 @@ def train_partitioned(
     fe_feature_sharded: bool = False,
     check_finite: bool = True,
     schedulers: "Mapping[str, object] | None" = None,
+    checkpointer=None,
+    checkpoint_every: int = 1,
+    resume: bool = True,
+    exchange=None,
 ) -> DistributedTrainResult:
     """``train_distributed`` over partitioned ingest blocks: each rank
     contributes only its local slice of the data/bucket arrays (every rank
     decoded ~1/P of the input; see io/partitioned_reader.py), the fused
     step runs unchanged, and only the MODEL-sized final state is host-
     gathered. Scope: dense or sparse/hybrid primary FE + dense IDENTITY
-    REs, no checkpoint/validation riders (score + evaluate partitioned via
+    REs, no validation riders (score + evaluate partitioned via
     parallel/scoring.py).
 
     schedulers: optional re_type -> algorithm.lane_scheduler.LaneScheduler
     (see ``make_schedulers`` — SPMD mode on multi-process runs): sweeps
     then run through ``step_scheduled``, composing probe/rescue lane
-    scheduling with partitioned ingestion. None keeps the one-jit step."""
+    scheduling with partitioned ingestion. None keeps the one-jit step.
+
+    checkpointer: optional ``io.checkpoint.TrainingCheckpointer`` —
+    crash-safe resume for the production configuration. Every
+    ``checkpoint_every`` sweeps the model-sized state is host-gathered on
+    EVERY rank (collectives) and committed through
+    ``io.checkpoint.commit_checkpoint``: rank 0 writes, and — when
+    ``exchange`` (the run's ``MetadataExchange``) is attached — the commit
+    is gated by its rank-attributed deadline barriers, so a checkpoint
+    exists only for sweeps every rank completed. ``meta.json`` carries a
+    fingerprint of the partition plan + the agreed global sparse layout
+    (``_partition_fingerprint``): a resume under a different rank count or
+    layout agreement FAILS FAST with the differing fields named instead of
+    silently training restored rows against a re-mapped block. An
+    explicitly-passed ``state`` (warm start) takes precedence over resume,
+    as in ``train_distributed``. ``checkpointer=None`` is bitwise the
+    un-checkpointed path."""
+    fingerprint = None
+    start_sweep = 0
+    prior_losses: list[float] = []
+    if checkpointer is not None:
+        freezing = sorted(
+            k for k, sch in (schedulers or {}).items()
+            if getattr(getattr(sch, "config", None), "freezes", False)
+        )
+        if freezing:
+            # cross-sweep active sets (frozen_rows + carried values) are
+            # scheduler-internal state the checkpoint does not capture: a
+            # restart would re-probe every lane and diverge from the
+            # uninterrupted run, breaking the resume-exactness contract
+            raise ValueError(
+                "partitioned checkpointing cannot yet resume cross-sweep "
+                f"active-set state (freeze tolerances set on {freezing}); "
+                "drop scheduler.freeze.tolerance/scheduler.freeze.gradient "
+                "(probe/rescue scheduling resumes exactly) or disable "
+                "checkpointing for this run"
+            )
+        fingerprint = _partition_fingerprint(program, parts, num_ranks)
+        if resume and state is None:
+            ckpt = checkpointer.restore()
+            if ckpt is not None:
+                from photon_ml_tpu.io.checkpoint import fingerprint_mismatch
+
+                mismatch = fingerprint_mismatch(
+                    ckpt.meta.get("partition_fingerprint"), fingerprint
+                )
+                if mismatch is not None:
+                    raise ValueError(
+                        f"partitioned checkpoint at {checkpointer.directory}"
+                        f" was written under a different partition "
+                        f"fingerprint ({mismatch}) — a restored table row "
+                        "would map onto a different rank block / sparse "
+                        "layout; resume with the original rank count and "
+                        "layout agreement, or use a fresh checkpoint "
+                        "directory"
+                    )
+                if int(ckpt.step) > num_iterations:
+                    # never silently relabel an over-trained state as an
+                    # N-sweep result: a shrunken num_iterations must fail
+                    # fast, not return the sweep-{step} model
+                    raise ValueError(
+                        f"partitioned checkpoint at {checkpointer.directory}"
+                        f" is at sweep {int(ckpt.step)}, beyond this run's "
+                        f"num_iterations={num_iterations}; raise "
+                        "num_iterations to continue training, or use a "
+                        "fresh checkpoint directory"
+                    )
+
+                def by_prefix(prefix):
+                    return {
+                        k[len(prefix):]: np.asarray(v)
+                        for k, v in ckpt.arrays.items()
+                        if k.startswith(prefix) and "/" not in k[len(prefix):]
+                    }
+
+                # host arrays; prepare_partitioned_inputs re-places them
+                # over the mesh exactly like a warm start (tables were
+                # saved UNSLICED, so shapes — and the jit signature —
+                # match the interrupted run's)
+                state = GameTrainState(
+                    fe_coefficients=np.asarray(ckpt.arrays["fe_coefficients"]),
+                    re_tables=by_prefix("re_tables/"),
+                    mf_rows={},
+                    mf_cols={},
+                    extra_fe=by_prefix("extra_fe/"),
+                )
+                start_sweep = int(ckpt.step)
+                prior_losses = [
+                    float(x) for x in ckpt.meta.get("losses", [])
+                ][:start_sweep]
+                from photon_ml_tpu.telemetry import resilience_counters
+
+                resilience_counters.record_checkpoint_restore()
+                # resumed sweeps are the fused path's epochs-not-redone
+                resilience_counters.record_epochs_resumed(start_sweep)
+                logger.info(
+                    "resuming partitioned training from checkpoint sweep "
+                    "%d/%d", start_sweep, num_iterations,
+                )
+
     data, buckets, st = prepare_partitioned_inputs(
         program, parts, mesh, num_ranks,
         fe_feature_sharded=fe_feature_sharded, state=state,
@@ -2433,8 +2588,18 @@ def train_partitioned(
         for s in program.re_specs
     }
 
-    losses: list[float] = []
-    for sweep in range(num_iterations):
+    def to_host(v):
+        """Model-sized arrays only (coefficients/tables) — every process
+        joins the gather (collective), unlike the O(n) score funnel the
+        partitioned path exists to remove."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(v, tiled=True))
+        return jax.device_get(v)
+
+    losses: list[float] = list(prior_losses)
+    for sweep in range(start_sweep, num_iterations):
         if schedulers:
             st, loss = program.step_scheduled(
                 data, buckets, st, schedulers=schedulers,
@@ -2449,17 +2614,33 @@ def train_partitioned(
             raise DivergenceError(
                 f"partitioned training step produced non-finite loss "
                 f"{losses[-1]} at sweep {sweep}"
+                + (
+                    f"; last good checkpoint: step "
+                    f"{checkpointer.latest_step()} in {checkpointer.directory}"
+                    if checkpointer is not None else ""
+                )
             )
+        if checkpointer is not None and (
+            (sweep + 1) % max(1, checkpoint_every) == 0
+            or sweep + 1 == num_iterations
+        ):
+            # every rank gathers (collectives) and calls the commit helper
+            # (its barriers are exchange calls every rank must make); only
+            # rank 0 writes the shared directory
+            from photon_ml_tpu.io.checkpoint import commit_checkpoint
 
-    def to_host(v):
-        """Model-sized arrays only (coefficients/tables) — every process
-        joins the gather (collective), unlike the O(n) score funnel the
-        partitioned path exists to remove."""
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            return np.asarray(multihost_utils.process_allgather(v, tiled=True))
-        return jax.device_get(v)
+            arrays = {
+                "fe_coefficients": np.asarray(to_host(st.fe_coefficients))
+            }
+            for k, v in st.re_tables.items():
+                arrays[f"re_tables/{k}"] = np.asarray(to_host(v))
+            for k, v in st.extra_fe.items():
+                arrays[f"extra_fe/{k}"] = np.asarray(to_host(v))
+            commit_checkpoint(
+                checkpointer, sweep + 1, arrays,
+                {"partition_fingerprint": fingerprint, "losses": losses},
+                exchange=exchange,
+            )
 
     final = GameTrainState(
         fe_coefficients=jnp.asarray(to_host(st.fe_coefficients)),

@@ -15,6 +15,7 @@ from photon_ml_tpu.resilience.errors import (
     TransientError,
     classify_exception,
     fatal_hint,
+    is_preemption,
     is_transient,
 )
 from photon_ml_tpu.resilience.policy import (
@@ -33,6 +34,7 @@ __all__ = [
     "TransientError",
     "classify_exception",
     "fatal_hint",
+    "is_preemption",
     "is_transient",
     "RetryPolicy",
     "default_dispatch_policy",

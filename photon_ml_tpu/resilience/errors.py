@@ -75,11 +75,34 @@ _FATAL_PATTERNS = re.compile(
 #: runtimes put in RuntimeError messages for genuinely transient failures.
 #: RESOURCE_EXHAUSTED stays here for its quota/rate-limit shape — the OOM
 #: shape is intercepted by the fatal "out of memory" pattern above.
+#: Device-loss / pool-preemption shapes (a preemptible TPU pool reclaiming
+#: a worker surfaces as a lost-device XlaRuntimeError or a "Socket
+#: closed"-class tunnel drop — the TYPE carries no signal) are transient
+#: WITH-RESTART: the work is gone but a restarted attempt on a fresh
+#: device resumes from the latest checkpoint (resilience/recovery.py).
 _TRANSIENT_PATTERNS = re.compile(
     r"UNAVAILABLE|DEADLINE_EXCEEDED|RESOURCE_EXHAUSTED|ABORTED"
     r"|socket closed|connection reset|connection refused|broken pipe"
     r"|connection closed|temporarily unavailable|too many requests"
-    r"|timed? ?out",
+    r"|timed? ?out"
+    r"|preempt(?:ed|ion)?|device (?:is )?lost|lost device"
+    r"|device (?:failure|halted)|worker (?:has )?(?:restarted|terminated)",
+    re.IGNORECASE,
+)
+
+#: the device-loss subset of the transient shapes: a preemptible pool
+#: reclaiming the worker mid-run. Kept separate so drivers can tally
+#: ``resilience/preemptions`` distinctly from garden-variety retries —
+#: the counter that tells an operator their checkpoint cadence is being
+#: exercised by the POOL, not by flaky I/O. A bare "socket closed" is
+#: deliberately NOT here: it stays transient (restart-worthy), but on
+#: this platform it is also how an oversized remote-compile request
+#: surfaces when the 413 is swallowed (CLAUDE.md) — tallying every
+#: dropped tunnel as a preemption would send the operator chasing the
+#: pool while a deterministic bug repeats.
+_PREEMPTION_PATTERNS = re.compile(
+    r"preempt(?:ed|ion)?|device (?:is )?lost|lost device"
+    r"|device (?:failure|halted)|worker (?:has )?(?:restarted|terminated)",
     re.IGNORECASE,
 )
 
@@ -180,6 +203,18 @@ def classify_exception(exc: BaseException) -> Transience:
 
 def is_transient(exc: BaseException) -> bool:
     return classify_exception(exc) is Transience.TRANSIENT
+
+
+def is_preemption(exc: BaseException) -> bool:
+    """True for transient failures whose shape is a device loss / pool
+    preemption (lost-device XlaRuntimeError, "Socket closed"-class tunnel
+    drop) rather than ordinary flaky I/O. Always a SUBSET of transient:
+    a fatal-classified error (e.g. an OOM that happens to mention a
+    device) is never counted as a preemption."""
+    if classify_exception(exc) is not Transience.TRANSIENT:
+        return False
+    message = f"{type(exc).__name__}: {exc}"
+    return bool(_PREEMPTION_PATTERNS.search(message))
 
 
 def fatal_hint(exc: BaseException) -> str | None:

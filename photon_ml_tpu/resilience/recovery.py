@@ -31,6 +31,7 @@ from photon_ml_tpu.resilience.errors import (
     Transience,
     classify_exception,
     fatal_hint,
+    is_preemption,
 )
 from photon_ml_tpu.telemetry import resilience_counters
 
@@ -93,6 +94,12 @@ def run_with_recovery(
                 raise
             restart += 1
             resilience_counters.record_retry()
+            # a device-loss / pool-preemption shape gets its own tally:
+            # the counter that says the POOL (not flaky I/O) is exercising
+            # the checkpoint cadence
+            preempted = is_preemption(e)
+            if preempted:
+                resilience_counters.record_preemption()
             logger.warning(
                 "%s: %s failure (%r) — restart %d/%d%s",
                 description,
@@ -115,6 +122,7 @@ def run_with_recovery(
                     max_restarts=max_restarts,
                     transient=transient,
                     divergent=divergent,
+                    preemption=preempted,
                     resumed_from_step=(
                         checkpointer.latest_step() if has_checkpoint else None
                     ),

@@ -36,6 +36,13 @@ GIVEUPS = "resilience/giveups"
 QUARANTINED_BLOCKS = "resilience/quarantined_blocks"
 #: coordinate-descent / sweep restores from a checkpoint
 CHECKPOINT_RESTORES = "resilience/checkpoint_restores"
+#: restarts whose failure shape was a device loss / pool preemption
+#: (resilience.errors.is_preemption) — distinct from flaky-I/O retries
+PREEMPTIONS = "resilience/preemptions"
+#: epochs / sweeps of completed work a checkpoint resume did NOT redo
+#: (streaming λ-grid epochs, partitioned/distributed sweeps) — the
+#: counter that prices what the checkpoint cadence actually saved
+EPOCHS_RESUMED = "resilience/epochs_resumed"
 
 #: bounded forensic ring: quarantine spans awaiting journaling (a corrupt
 #: input could hold thousands of bad blocks; the counter stays exact while
@@ -56,6 +63,26 @@ def record_giveup(n: int = 1) -> None:
 
 def record_checkpoint_restore(n: int = 1) -> None:
     default_registry().counter(CHECKPOINT_RESTORES).inc(int(n))
+
+
+def record_preemption(n: int = 1) -> None:
+    default_registry().counter(PREEMPTIONS).inc(int(n))
+
+
+def record_epochs_resumed(n: int) -> None:
+    default_registry().counter(EPOCHS_RESUMED).inc(int(n))
+
+
+def reset_resilience_metrics(registry=None) -> None:
+    """Drop the PER-RUN recovery counters (preemptions, epochs_resumed) —
+    drivers call this at run start next to ``reset_solver_metrics`` so a
+    sweep invoking ``run()`` repeatedly journals per-run tallies. The
+    ISSUE-3 counters (retries/giveups/quarantined_blocks/
+    checkpoint_restores) keep their original process-lifetime semantics:
+    existing consumers assert cumulative values across runs."""
+    reg = registry or default_registry()
+    reg.remove_prefix(PREEMPTIONS)
+    reg.remove_prefix(EPOCHS_RESUMED)
 
 
 def record_quarantined_block(
@@ -98,3 +125,11 @@ def quarantined_blocks() -> int:
 
 def checkpoint_restores() -> int:
     return int(default_registry().counter(CHECKPOINT_RESTORES).value)
+
+
+def preemptions() -> int:
+    return int(default_registry().counter(PREEMPTIONS).value)
+
+
+def epochs_resumed() -> int:
+    return int(default_registry().counter(EPOCHS_RESUMED).value)

@@ -361,3 +361,53 @@ def test_lint_catches_streaming_jit_closures(tmp_path):
     ), problems
     assert not any("good_step" in p for p in problems)
     assert not any("other.py" in p for p in problems)
+
+
+def test_lint_catches_ungated_checkpoint_saves(tmp_path):
+    """Check 10 fires: a direct checkpointer.save()/save_progress() in a
+    parallel/ or algorithm/ training-loop module is reported (multi-rank
+    writes must ride io.checkpoint.commit_checkpoint); the commit-helper
+    call itself passes, unrelated .save() receivers (index maps, models)
+    pass, and modules outside the training-loop packages are not
+    scanned."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    par = tmp_path / "photon_ml_tpu" / "parallel"
+    par.mkdir(parents=True)
+    (par / "trainer.py").write_text(
+        '"""Cites Foo.scala:1."""\n'
+        "from photon_ml_tpu.io.checkpoint import commit_checkpoint\n"
+        "def sweep(checkpointer, ckpt, imap, arrays, meta, exchange):\n"
+        "    checkpointer.save(1, arrays, meta)\n"
+        "    ckpt.save_progress(fingerprint={}, lam_index=0)\n"
+        "    self_like = object()\n"
+        "    commit_checkpoint(checkpointer, 1, arrays, meta,\n"
+        "                      exchange=exchange)\n"
+        "    imap.save('dir', 'shard')  # not a checkpointer\n"
+    )
+    alg = tmp_path / "photon_ml_tpu" / "algorithm"
+    alg.mkdir(parents=True)
+    (alg / "cd.py").write_text(
+        '"""Cites Foo.scala:1."""\n'
+        "def loop(self):\n"
+        "    self.checkpointer.save(2, {}, {})\n"
+    )
+    io_pkg = tmp_path / "photon_ml_tpu" / "io"
+    io_pkg.mkdir(parents=True)
+    (io_pkg / "checkpoint.py").write_text(
+        '"""No reference analogue."""\n'
+        "def commit_checkpoint(checkpointer, step, arrays, meta):\n"
+        "    return checkpointer.save(step, arrays, meta)  # the helper\n"
+    )
+    problems = lint_parity.run_lints(tmp_path)
+    assert any(
+        "trainer.py:4" in p and "commit_checkpoint" in p for p in problems
+    ), problems
+    assert any("trainer.py:5" in p for p in problems)
+    assert any("cd.py:3" in p for p in problems)
+    assert not any("trainer.py:9" in p for p in problems)  # imap.save
+    assert not any("checkpoint.py" in p for p in problems)  # io/ helper

@@ -869,3 +869,421 @@ class TestStreamingChaos:
                     pass
         # consumer bound (0.2 s) + bounded join over the 1 s sleeper
         assert time.perf_counter() - t0 < 4.0
+
+
+# ---------------------------------------------------------------------------
+# Crash-safe resume for the production path (ISSUE 8): epoch-granular
+# streaming checkpoints + exchange-consistent partitioned checkpointing
+# ---------------------------------------------------------------------------
+
+
+def _stream_fixture(hook=None, n=64, d=6, chunk=16, seed=0):
+    from photon_ml_tpu.io.stream_reader import ArrayChunkSource
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    wt = rng.normal(size=d).astype(np.float32)
+    y = (x @ wt + 0.1 * rng.normal(size=n)).astype(np.float32)
+    return ArrayChunkSource(x, y, chunk_rows=chunk, decode_hook=hook)
+
+
+def _stream_opt(max_iter=6):
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+
+    return OptimizerConfig(
+        optimizer_type=OptimizerType.LBFGS, max_iterations=max_iter
+    )
+
+
+class TestPreemptionClassification:
+    def test_device_loss_shapes_are_transient_preemptions(self):
+        from photon_ml_tpu.resilience import is_preemption
+
+        e = faultinject.device_loss_error()
+        assert classify_exception(e) is Transience.TRANSIENT
+        assert is_preemption(e)
+        # the same shape wrapped by the stream pipeline stays attributed
+        wrapped = RuntimeError(
+            f"streaming epoch failed decoding chunk 3: RuntimeError: {e}"
+        )
+        assert classify_exception(wrapped) is Transience.TRANSIENT
+        assert is_preemption(wrapped)
+
+    def test_preemption_is_a_subset_of_transient(self):
+        from photon_ml_tpu.resilience import is_preemption
+
+        # fatal-despite-the-smell: an OOM mentioning a device is NOT a
+        # preemption (retrying re-allocates identically)
+        oom = RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            "on the lost device"
+        )
+        assert classify_exception(oom) is Transience.FATAL
+        assert not is_preemption(oom)
+        # ordinary flaky I/O is transient but not a preemption
+        assert not is_preemption(ConnectionError("connection reset"))
+        # a BARE socket-closed tunnel drop is transient but deliberately
+        # not tallied as a preemption: on this platform it is also how a
+        # swallowed 413 surfaces (resilience/errors.py rationale)
+        bare = RuntimeError("INTERNAL: Socket closed")
+        assert classify_exception(bare) is Transience.TRANSIENT
+        assert not is_preemption(bare)
+
+
+class TestCrashSafeStreamingResume:
+    """ISSUE 8 acceptance, streaming half: a run killed mid-epoch resumes
+    via run_with_recovery — skipping completed λs/epochs — and matches the
+    uninterrupted run BITWISE (one eval path: the dense streaming
+    accumulator; the solver state round-trips through numpy exactly)."""
+
+    LAMS = (0.1, 1.0)
+
+    def _train(self, checkpointer=None, hook=None):
+        from photon_ml_tpu.estimators import train_glm_streaming
+        from photon_ml_tpu.types import TaskType
+
+        return train_glm_streaming(
+            _stream_fixture(hook),
+            TaskType.LINEAR_REGRESSION,
+            optimizer=_stream_opt(),
+            regularization_weights=self.LAMS,
+            checkpointer=checkpointer,
+        )
+
+    def test_crash_mid_epoch_resumes_and_matches_bitwise(self, tmp_path):
+        from photon_ml_tpu.io.checkpoint import SolverCheckpointer
+
+        loads = {"n": 0}
+        base = self._train(hook=lambda: loads.__setitem__("n", loads["n"] + 1))
+        assert loads["n"] > 4  # the fixture really streams epochs
+
+        ck = SolverCheckpointer(tmp_path / "ck")
+        before = (rc.checkpoint_restores(), rc.preemptions(),
+                  rc.epochs_resumed())
+        # crash halfway through the run's chunk decodes — mid-epoch,
+        # mid-λ-grid — with the device-loss/preemption shape
+        with faultinject.crash_after_chunks(loads["n"] // 2) as crash:
+            models = run_with_recovery(
+                lambda restart: self._train(checkpointer=ck),
+                max_restarts=2,
+                checkpointer=ck,
+                description="streaming chaos",
+            )
+        assert crash["fired"], "the injected crash never happened"
+        for lam in self.LAMS:
+            np.testing.assert_array_equal(
+                np.asarray(base[lam].coefficients.means),
+                np.asarray(models[lam].coefficients.means),
+            )
+        # resume evidence: restored a checkpoint, skipped epochs, and the
+        # failure shape was tallied as a preemption
+        assert rc.checkpoint_restores() > before[0]
+        assert rc.preemptions() > before[1]
+        assert rc.epochs_resumed() > before[2]
+
+    def test_checkpointing_on_is_bitwise_checkpointing_off(self, tmp_path):
+        """The observer observes, never rewrites: a checkpointed run's
+        models equal the un-checkpointed run's bitwise (checkpointing OFF
+        — the default — is trivially today's path; ON must not perturb)."""
+        from photon_ml_tpu.io.checkpoint import SolverCheckpointer
+
+        base = self._train()
+        ck = SolverCheckpointer(tmp_path / "ck")
+        withck = self._train(checkpointer=ck)
+        for lam in self.LAMS:
+            np.testing.assert_array_equal(
+                np.asarray(base[lam].coefficients.means),
+                np.asarray(withck[lam].coefficients.means),
+            )
+        assert ck.latest_step() is not None  # it really checkpointed
+
+    def test_fingerprint_mismatch_fails_fast_named(self, tmp_path):
+        from photon_ml_tpu.estimators import train_glm_streaming
+        from photon_ml_tpu.io.checkpoint import SolverCheckpointer
+        from photon_ml_tpu.types import TaskType
+
+        ck = SolverCheckpointer(tmp_path / "ck")
+        self._train(checkpointer=ck)
+        with pytest.raises(ValueError, match="fingerprint.*lambdas"):
+            train_glm_streaming(
+                _stream_fixture(),
+                TaskType.LINEAR_REGRESSION,
+                optimizer=_stream_opt(),
+                regularization_weights=(0.25,),
+                checkpointer=ck,
+            )
+
+
+def _partitioned_fixture(num_ranks=2, n=32, d=4, seed=1):
+    """In-memory dense-FE partitioned GAME fixture: ``num_ranks`` equal
+    row blocks of one tiny regression problem (no Avro, no REs — the
+    cheapest real train_partitioned invocation)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.game_data import GameDataset
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x @ rng.normal(size=d) + 0.1 * rng.normal(size=n)).astype(np.float32)
+    nb = n // num_ranks
+
+    def block(r):
+        lo = r * nb
+        return GameDataset(
+            unique_ids=np.arange(lo, lo + nb),
+            labels=jnp.asarray(y[lo:lo + nb]),
+            offsets=jnp.zeros(nb, jnp.float32),
+            weights=jnp.ones(nb, jnp.float32),
+            feature_shards={"global": jnp.asarray(x[lo:lo + nb])},
+            entity_idx={},
+            entity_vocabs={},
+        )
+
+    return {r: (block(r), {}) for r in range(num_ranks)}
+
+
+def _partitioned_program(max_iter=4):
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType
+    from photon_ml_tpu.parallel.distributed import (
+        FixedEffectStepSpec,
+        GameTrainProgram,
+    )
+    from photon_ml_tpu.types import TaskType
+
+    return GameTrainProgram(
+        TaskType.LINEAR_REGRESSION,
+        FixedEffectStepSpec(
+            "global",
+            OptimizerConfig(max_iterations=max_iter),
+            l2_weight=0.5,
+        ),
+        (),
+    )
+
+
+class TestCrashSafePartitionedResume:
+    """ISSUE 8 acceptance, partitioned half: a virtual-rank partitioned
+    run killed mid-sweep by a simulated pool preemption resumes via
+    run_with_recovery and matches the uninterrupted run bitwise; a resume
+    under a changed rank count fails fast with the fingerprint named."""
+
+    def test_preemption_mid_sweep_resumes_and_matches_bitwise(
+            self, tmp_path):
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+        from photon_ml_tpu.parallel.distributed import (
+            GameTrainProgram,
+            train_partitioned,
+        )
+        from photon_ml_tpu.parallel.multihost import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh(data=8, model=1)
+        parts = _partitioned_fixture()
+        prog = _partitioned_program()
+        ref = train_partitioned(prog, parts, mesh, 2, num_iterations=3)
+
+        ck = TrainingCheckpointer(tmp_path / "pck")
+        before = (rc.checkpoint_restores(), rc.preemptions())
+        with faultinject.preempt_after_calls(
+            GameTrainProgram, "step", 2
+        ) as crash:
+            res = run_with_recovery(
+                lambda restart: train_partitioned(
+                    prog, parts, mesh, 2, num_iterations=3, checkpointer=ck
+                ),
+                max_restarts=2,
+                checkpointer=ck,
+                description="partitioned chaos",
+            )
+        assert crash["fired"], "the injected preemption never happened"
+        np.testing.assert_array_equal(
+            np.asarray(res.state.fe_coefficients),
+            np.asarray(ref.state.fe_coefficients),
+        )
+        np.testing.assert_array_equal(res.losses, ref.losses)
+        assert rc.checkpoint_restores() > before[0]
+        assert rc.preemptions() > before[1]
+
+    def test_rank_count_change_fails_fast_with_fingerprint(self, tmp_path):
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+        from photon_ml_tpu.parallel.distributed import train_partitioned
+        from photon_ml_tpu.parallel.multihost import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh(data=8, model=1)
+        prog = _partitioned_program()
+        ck = TrainingCheckpointer(tmp_path / "pck")
+        train_partitioned(
+            prog, _partitioned_fixture(num_ranks=2), mesh, 2,
+            num_iterations=1, checkpointer=ck,
+        )
+        with pytest.raises(ValueError, match="fingerprint") as ei:
+            train_partitioned(
+                prog, _partitioned_fixture(num_ranks=1), mesh, 1,
+                num_iterations=1, checkpointer=ck,
+            )
+        # the differing agreement fields are NAMED (rank count + geometry)
+        assert "num_ranks" in str(ei.value)
+
+    def test_freezing_schedulers_reject_checkpointing_up_front(
+            self, tmp_path):
+        """Cross-sweep active sets (frozen lanes) are scheduler-internal
+        state the checkpoint cannot capture — the combination fails fast
+        with the alternative named, before any sweep runs."""
+        import types
+
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+        from photon_ml_tpu.optim.optimizer import LaneSchedulerConfig
+        from photon_ml_tpu.parallel.distributed import train_partitioned
+        from photon_ml_tpu.parallel.multihost import make_hybrid_mesh
+
+        freezer = types.SimpleNamespace(config=LaneSchedulerConfig(
+            probe_iterations=1,
+            freeze_coefficient_tolerance=1e-3,
+            freeze_gradient_tolerance=1e-3,
+        ))
+        with pytest.raises(ValueError, match="freeze"):
+            train_partitioned(
+                _partitioned_program(), _partitioned_fixture(),
+                make_hybrid_mesh(data=8, model=1), 2,
+                num_iterations=1,
+                schedulers={"userId": freezer},
+                checkpointer=TrainingCheckpointer(tmp_path / "fck"),
+            )
+
+    def test_normalization_digest_distinguishes_statistics(self):
+        """The streaming fingerprint's normalization field is a CONTENT
+        digest — different factor/shift arrays must differ (the class
+        name cannot: every non-NONE type builds NormalizationContext)."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.estimators import _normalization_digest
+        from photon_ml_tpu.ops.normalization import NormalizationContext
+
+        a = NormalizationContext(factors=jnp.asarray([1.0, 2.0]))
+        b = NormalizationContext(factors=jnp.asarray([1.0, 3.0]))
+        c = NormalizationContext(factors=jnp.asarray([1.0, 2.0]),
+                                 shifts=jnp.asarray([0.5, 0.5]))
+        assert _normalization_digest(None) is None
+        assert _normalization_digest(a) == _normalization_digest(a)
+        assert _normalization_digest(a) != _normalization_digest(b)
+        assert _normalization_digest(a) != _normalization_digest(c)
+
+    def test_commit_barrier_is_rank_attributed_not_a_hang(self, tmp_path):
+        """The exchange-consistent commit: both ranks present -> exactly
+        one step dir, written by rank 0; a withheld rank -> the writer
+        fails with a rank-attributed ExchangeTimeout WITHIN the exchange's
+        sub-second deadline, never a hang, and no checkpoint commits."""
+        from photon_ml_tpu.io.checkpoint import (
+            TrainingCheckpointer,
+            commit_checkpoint,
+        )
+        from photon_ml_tpu.parallel.multihost import InProcessExchange
+
+        arrays = {"fe_coefficients": np.zeros(3, np.float32)}
+
+        # happy path: every rank calls, rank 0 writes
+        exchanges = InProcessExchange.create_group(2, timeout=5.0)
+        cks = [TrainingCheckpointer(tmp_path / "bck") for _ in range(2)]
+        paths = [None, None]
+
+        def commit(r):
+            paths[r] = commit_checkpoint(
+                cks[r], 1, arrays, {"losses": []}, exchange=exchanges[r]
+            )
+
+        threads = [threading.Thread(target=commit, args=(r,))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert paths[0] is not None and paths[1] is None
+        assert cks[0].latest_step() == 1
+
+        # withheld rank: the present rank's pre-commit barrier deadline
+        # fires attributed; nothing new commits
+        exchanges = InProcessExchange.create_group(2, timeout=0.3)
+        ck = TrainingCheckpointer(tmp_path / "bck2")
+
+        def withheld():
+            commit_checkpoint(
+                ck, 1, arrays, {"losses": []}, exchange=exchanges[0]
+            )
+
+        err = _run_captured(withheld, timeout=5.0)
+        assert isinstance(err, ExchangeTimeout)
+        assert "1" in str(err.missing_ranks) or 1 in err.missing_ranks
+        assert ck.latest_step() is None
+
+
+class TestGLMDriverRecovery:
+    """The GLM driver's new --checkpoint-dir/--max-restarts wiring: a
+    streaming driver run killed mid-epoch restarts through
+    run_with_recovery, resumes from the solver checkpoint, succeeds, and
+    journals the restart + the resilience/* counters."""
+
+    def _input_dir(self, tmp_path):
+        from photon_ml_tpu.io import photon_schemas as schemas
+
+        data_dir = tmp_path / "train"
+        os.makedirs(data_dir, exist_ok=True)
+        rng = np.random.default_rng(5)
+        w = rng.normal(size=3)
+        records = []
+        for i in range(80):
+            x = rng.normal(size=3)
+            records.append({
+                "uid": str(i),
+                "label": float(x @ w + 0.05 * rng.normal()),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(3)
+                ],
+                "weight": 1.0, "offset": 0.0, "metadataMap": None,
+            })
+        avro_io.write_container(
+            str(data_dir / "part-00000.avro"),
+            schemas.TRAINING_EXAMPLE_AVRO, records, block_records=20,
+        )
+        return data_dir
+
+    def test_streaming_driver_crash_restarts_and_journals(self, tmp_path):
+        from photon_ml_tpu.cli import glm_driver
+        from photon_ml_tpu.telemetry import JOURNAL_FILENAME, RunJournal
+
+        args = [
+            "--input-data-path", str(self._input_dir(tmp_path)),
+            "--output-dir", str(tmp_path / "out"),
+            "--task-type", "LINEAR_REGRESSION",
+            "--regularization-weights", "0.1",
+            "--max-iterations", "4",
+            "--streaming-chunks", "20",
+            "--checkpoint-dir", str(tmp_path / "ck"),
+            "--telemetry-dir", str(tmp_path / "tel"),
+        ]
+        # the uninterrupted solve costs ~20 chunk decodes (5 epochs x 4
+        # chunks); crashing at 12 lands mid-solve AFTER the first
+        # iteration's checkpoint, so the restart truly RESUMES
+        with faultinject.crash_after_chunks(12) as crash:
+            result = glm_driver.main(args)
+        assert crash["fired"]
+        assert result.models  # the run completed after the restart
+        rows = RunJournal.read(str(tmp_path / "tel" / JOURNAL_FILENAME))
+        kinds = [r["kind"] for r in rows]
+        assert "resilience_restart" in kinds
+        restart = [r for r in rows if r["kind"] == "resilience_restart"][0]
+        assert restart["preemption"] is True
+        snapshot = [r for r in rows if r["kind"] == "metrics"][-1]["snapshot"]
+        assert snapshot["counters"]["resilience/preemptions"] >= 1
+        assert snapshot["counters"]["resilience/epochs_resumed"] >= 1
+
+    def test_checkpoint_dir_requires_streaming(self, tmp_path):
+        from photon_ml_tpu.cli.glm_driver import GLMDriverParams, run
+        from photon_ml_tpu.types import TaskType
+
+        with pytest.raises(ValueError, match="streaming-chunks"):
+            run(GLMDriverParams(
+                input_data_path=str(tmp_path / "x"),
+                output_dir=str(tmp_path / "out"),
+                task_type=TaskType.LINEAR_REGRESSION,
+                checkpoint_dir=str(tmp_path / "ck"),
+            ))
