@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static parity-convention lints for photon_ml_tpu (CLAUDE.md conventions).
 
-Ten checks, all pure-AST (no jax import; runs in milliseconds):
+Eleven checks, all pure-AST (no jax import; runs in milliseconds):
 
 1. **Docstring citations** — every ``photon_ml_tpu/**/*.py`` module (except
    ``__init__.py`` re-export shims) must carry a module docstring that
@@ -87,6 +87,16 @@ Ten checks, all pure-AST (no jax import; runs in milliseconds):
    worker rank race rank 0 on the shared directory, or commit a
    checkpoint for a sweep some rank never finished (ISSUE 8's
    exchange-consistency rule).
+
+11. **time.time() for durations** — ``time.time()`` is wall clock: it
+   steps with NTP/host clock adjustments, so differences of its readings
+   are not durations (rows ordered by it can even go backwards — the
+   reason journal rows carry ``elapsed_ms``). Every duration/ordering
+   measurement in ``photon_ml_tpu/`` must use ``time.perf_counter``.
+   ``time.time()`` calls are banned outside the reviewed
+   absolute-timestamp allowlist (the journal's ``ts`` field, the tracer's
+   wall anchor — sites whose OUTPUT is an absolute timestamp, never a
+   difference).
 
 Exit status 0 = clean; 1 = violations (printed one per line as
 ``path:lineno: message``). Run from the repo root:
@@ -298,6 +308,12 @@ BROAD_EXCEPT_ALLOWED = {
     (f"{PACKAGE}/io/stream_reader.py", "_producer"),
     (f"{PACKAGE}/telemetry/probes.py", "live_buffer_bytes"),
     (f"{PACKAGE}/telemetry/journal.py", "_process_index"),
+    # same capability probe as the journal's: rank 0 when jax is absent
+    (f"{PACKAGE}/telemetry/tracing.py", "_process_index"),
+    # driver-teardown trace flush: tracing is observability — an error in
+    # a finally must not replace the run's own outcome or skip the
+    # journal rows that follow; every error is logged with traceback
+    (f"{PACKAGE}/telemetry/tracing.py", "flush_trace_best_effort"),
     (f"{PACKAGE}/io/offheap_index_map.py", "__del__"),
     (f"{PACKAGE}/native/build.py", "native_available"),
     (f"{PACKAGE}/native/build.py", "libsvm_native_available"),
@@ -623,6 +639,74 @@ def check_checkpoint_commit_sites(root: pathlib.Path) -> list[str]:
     return problems
 
 
+#: (file, dotted class-qualified name) pairs whose ``time.time()`` reads
+#: are REVIEWED absolute-timestamp sites (the value is reported as a
+#: wall-clock stamp, never differenced): the journal's per-row ``ts`` and
+#: the tracer's wall anchor for cross-rank correlation. Class-QUALIFIED so
+#: e.g. a time.time() in another __init__ of the same file stays banned.
+#: Everything else must use ``time.perf_counter`` (check 11).
+TIME_TIME_ALLOWED = {
+    (f"{PACKAGE}/telemetry/journal.py", "RunJournal.record"),
+    (f"{PACKAGE}/telemetry/tracing.py", "Tracer.__init__"),
+}
+
+
+def check_time_time_durations(root: pathlib.Path) -> list[str]:
+    problems = []
+    for path in sorted((root / PACKAGE).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        tree = ast.parse(path.read_text())
+        # names bound to time.time by `from time import time [as t]`
+        aliases: set[str] = set()
+        # names bound to the time MODULE (`import time [as clock]`) so
+        # `clock.time()` cannot slip past the receiver-name check
+        module_aliases: set[str] = {"time"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "time":
+                        aliases.add(a.asname or a.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        module_aliases.add(a.asname or a.name)
+
+        stack: list[str] = []
+        hits: list[int] = []
+
+        def visit(node):
+            is_scope = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            )
+            if is_scope:
+                stack.append(node.name)
+            if isinstance(node, ast.Call):
+                fn = node.func
+                is_time = (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "time"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in module_aliases
+                ) or (isinstance(fn, ast.Name) and fn.id in aliases)
+                if is_time and (rel, ".".join(stack)) not in TIME_TIME_ALLOWED:
+                    hits.append(node.lineno)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_scope:
+                stack.pop()
+
+        visit(tree)
+        for lineno in hits:
+            problems.append(
+                f"{rel}:{lineno}: time.time() — wall clock steps with host "
+                "clock adjustments, so its differences are not durations; "
+                "use time.perf_counter for any timing/ordering, or add "
+                "this reviewed absolute-timestamp site to "
+                "TIME_TIME_ALLOWED in dev/lint_parity.py (check 11)"
+            )
+    return problems
+
+
 def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
     root = pathlib.Path(root) if root else pathlib.Path(__file__).resolve().parents[1]
     return (
@@ -636,6 +720,7 @@ def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
         + check_cli_dead_end_rejections(root)
         + check_streaming_jit_closures(root)
         + check_checkpoint_commit_sites(root)
+        + check_time_time_durations(root)
     )
 
 

@@ -65,6 +65,7 @@ from photon_ml_tpu.data.game_data import compact_lane_blocks
 from photon_ml_tpu.optim.common import ConvergenceReason, LaneTrace
 from photon_ml_tpu.optim.optimizer import LaneSchedulerConfig, OptimizerConfig
 from photon_ml_tpu.projector.projectors import ProjectorType
+from photon_ml_tpu.telemetry import tracing
 
 Array = jax.Array
 
@@ -459,9 +460,11 @@ class LaneScheduler:
             host = self._host_cache(blocks)
             for picks in _group_by_shape(host, lane_masks):
                 pad_to = _pow2_lanes(sum(len(l) for _, l in picks))
-                fields, src_blk, src_lane = compact_lane_blocks(
-                    host, picks, pad_to=pad_to, sentinel_row=SENTINEL_ROW,
-                )
+                with tracing.span("scheduler/compaction", cat="scheduler",
+                                  lanes=int(sum(len(l) for _, l in picks))):
+                    fields, src_blk, src_lane = compact_lane_blocks(
+                        host, picks, pad_to=pad_to, sentinel_row=SENTINEL_ROW,
+                    )
                 tab, trace, delta, wnorm = run_block(
                     _device_block(fields), o, tab
                 )
@@ -474,19 +477,22 @@ class LaneScheduler:
 
         # -- probe phase ----------------------------------------------------
         any_skip = any(s.any() for s in skip_h)
-        if not any_skip:
-            # full buckets, original shapes — the same signatures the
-            # unscheduled path compiles
-            for i, b in enumerate(blocks):
-                table, trace, delta, wnorm = run_block(b, probe_opt, table)
-                blk = np.where(solve_h[i], i, -1).astype(np.int32)
-                lane = np.arange(e_sizes[i], dtype=np.int64)
-                scatter_back(trace, delta, wnorm, blk, lane)
-            stats.lanes_probed = int(sum(s.sum() for s in solve_h))
-        else:
-            # active-set compaction: only unfrozen lanes probe
-            table, probed, _ = run_compacted(solve_h, probe_opt, table)
-            stats.lanes_probed = probed
+        with tracing.span("scheduler/probe", cat="scheduler",
+                          lanes=stats.lanes_total,
+                          frozen_skipped=stats.lanes_frozen_skipped):
+            if not any_skip:
+                # full buckets, original shapes — the same signatures the
+                # unscheduled path compiles
+                for i, b in enumerate(blocks):
+                    table, trace, delta, wnorm = run_block(b, probe_opt, table)
+                    blk = np.where(solve_h[i], i, -1).astype(np.int32)
+                    lane = np.arange(e_sizes[i], dtype=np.int64)
+                    scatter_back(trace, delta, wnorm, blk, lane)
+                stats.lanes_probed = int(sum(s.sum() for s in solve_h))
+            else:
+                # active-set compaction: only unfrozen lanes probe
+                table, probed, _ = run_compacted(solve_h, probe_opt, table)
+                stats.lanes_probed = probed
 
         # -- rescue phase ---------------------------------------------------
         rescue_h = [
@@ -495,9 +501,11 @@ class LaneScheduler:
         ]
         n_rescue = int(sum(r.sum() for r in rescue_h))
         if rescue_opt is not None and n_rescue:
-            table, _, rescue_blocks = run_compacted(
-                rescue_h, rescue_opt, table
-            )
+            with tracing.span("scheduler/rescue", cat="scheduler",
+                              lanes=n_rescue):
+                table, _, rescue_blocks = run_compacted(
+                    rescue_h, rescue_opt, table
+                )
             stats.rescue_blocks += rescue_blocks
             stats.lanes_rescued = n_rescue
 

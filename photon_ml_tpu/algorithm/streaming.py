@@ -53,6 +53,7 @@ from photon_ml_tpu.io.stream_reader import (
 )
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
+from photon_ml_tpu.telemetry import tracing
 
 Array = jax.Array
 
@@ -159,9 +160,17 @@ class StreamingGLMObjective:
         return jax.device_put(batch, shardings)
 
     def _epoch(self, fold: Callable, carry):
-        with self._prefetcher() as chunks:
-            for batch in chunks:
-                carry = fold(carry, self._place(batch))
+        # host wall-clock spans only: the accumulate step DISPATCHES
+        # asynchronously, so its span measures the host-blocking portion
+        # (transfer + dispatch), not device time — exactly the overlap
+        # seam the prefetcher's decode/wait spans complement
+        with tracing.span("stream/epoch", cat="stream", epoch=self.epochs,
+                          chunks=self.source.num_chunks):
+            with self._prefetcher() as chunks:
+                for i, batch in enumerate(chunks):
+                    with tracing.span("stream/accumulate", cat="stream",
+                                      chunk=i):
+                        carry = fold(carry, self._place(batch))
         self.epochs += 1
         return carry
 

@@ -49,6 +49,7 @@ def run(
     partitioned_io: bool = False,
     on_corrupt: str = "raise",
     telemetry_dir: str | None = None,
+    trace_dir: str | None = None,
 ) -> dict:
     """Score ``input_data_path`` with the model at ``model_input_dir``.
 
@@ -59,6 +60,11 @@ def run(
     telemetry_dir: rank-0 JSONL run journal (phase timings, io/resilience
     counters) — written on the FAILURE path too, so a scoring run that
     died mid-read still leaves its retry/quarantine evidence.
+
+    trace_dir: per-rank Chrome-trace span timelines
+    (``trace-{rank:05d}.json``; telemetry/tracing.py) + a rank-merged
+    straggler report journaled at run end — flushed on success AND
+    failure paths, before the failure journal rows.
 
     Index maps default to the ones the training driver saved next to the
     model (<root>/index-maps); feature shard configs default to one shard
@@ -105,6 +111,12 @@ def run(
     reset_timings()
     reset_resilience_metrics()
     journal = RunJournal(telemetry_dir) if telemetry_dir else None
+    tracer = None
+    if trace_dir:
+        from photon_ml_tpu.telemetry.tracing import Tracer, install_tracer
+
+        tracer = install_tracer(Tracer())
+    succeeded = False
     try:
         summary = _run_inner(
             input_data_path=input_data_path,
@@ -122,10 +134,33 @@ def run(
             partitioned=partitioned,
             on_corrupt=on_corrupt,
         )
+        succeeded = True
         if journal is not None:
             journal.record("scoring_summary", **summary)
         return summary
     finally:
+        # traces flush FIRST (before the failure journal rows) so a dead
+        # run still leaves a readable per-rank timeline; the straggler
+        # merge + barriered publish run collectives only on the success
+        # path (every rank's run() reaches this finally)
+        if tracer is not None:
+            from photon_ml_tpu.parallel.multihost import default_exchange
+            from photon_ml_tpu.telemetry.tracing import (
+                flush_trace_best_effort,
+                uninstall_tracer,
+            )
+
+            try:
+                # best-effort: a publication error never masks the run's
+                # own outcome or skips the journal rows below
+                flush_trace_best_effort(
+                    tracer, trace_dir,
+                    exchange=default_exchange() if succeeded else None,
+                    gather=succeeded,
+                    journal=journal,
+                )
+            finally:
+                uninstall_tracer()
         # failure-path journaling too: the resilience/* counters (retries,
         # giveups, quarantined_blocks) and quarantine spans are exactly
         # what a post-mortem of a dead scoring run needs
@@ -402,6 +437,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="write a rank-0 JSONL run journal (phase timings, "
                         "io + resilience counters) here — on the failure "
                         "path too")
+    p.add_argument("--trace-dir",
+                   help="write per-rank Chrome-trace span timelines "
+                        "(trace-{rank:05d}.json, open in Perfetto) + a "
+                        "rank-merged straggler report here; flushed on "
+                        "success and failure")
     return p
 
 
@@ -428,6 +468,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
         partitioned_io=args.partitioned_io,
         on_corrupt=args.on_corrupt,
         telemetry_dir=args.telemetry_dir,
+        trace_dir=args.trace_dir,
     )
 
 

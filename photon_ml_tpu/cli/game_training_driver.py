@@ -134,6 +134,12 @@ class GameTrainingParams:
     #: summary, phase timings, per-coordinate convergence rows, compile and
     #: HBM gauges) finalized on completion; None = disabled
     telemetry_dir: str | None = None
+    #: run-trace output dir (telemetry/tracing.py): EVERY rank exports its
+    #: host-side span timeline as Chrome-trace JSON (trace-{rank:05d}.json
+    #: — rank-0 mkdir, barrier, per-rank write, the score-writer carve-out)
+    #: and a rank-merged straggler report is journaled at run end. Flushed
+    #: on success AND failure paths; None = disabled (zero overhead).
+    trace_dir: str | None = None
     #: partitioned host I/O (io/partitioned_reader.py): on a multi-process
     #: run each rank decodes only ~1/P of the input bytes and feeds its
     #: local block as addressable shards of the global arrays. Opt-in:
@@ -229,6 +235,16 @@ class GameTrainingParams:
             raise ValueError("invalid driver parameters: " + "; ".join(problems))
 
 
+def _trace_exchange():
+    """Exchange for run-end trace publication + straggler merge: the
+    coordination-service KV transport on multi-process runs (EVERY rank's
+    run() reaches this finally, so the collective discipline holds),
+    trivial single-process."""
+    from photon_ml_tpu.parallel.multihost import default_exchange
+
+    return default_exchange()
+
+
 def run(params: GameTrainingParams) -> dict:
     """Execute the training pipeline; returns a result summary dict."""
     params.validate()
@@ -294,16 +310,48 @@ def run(params: GameTrainingParams) -> dict:
         registry=default_registry() if journal and journal.active else None,
     )
     compiles = CompileMonitor()
+    # span tracing is opt-in via --trace-dir; installed before any stage so
+    # a failure mid-read still leaves a timeline on every rank
+    tracer = None
+    if params.trace_dir:
+        from photon_ml_tpu.telemetry.tracing import Tracer, install_tracer
+
+        tracer = install_tracer(Tracer())
+    succeeded = False
     try:
         from photon_ml_tpu.util.timed import profile_trace
 
         with profile_trace(params.profile_dir), compiles:
             summary = _run_inner(params, job_log, telemetry)
+        succeeded = True
         return summary
     except Exception:
         events.send(TrainingFinishEvent(job_name="game-training", succeeded=False))
         raise
     finally:
+        # traces flush FIRST (before the failure journal rows) so a crash
+        # leaves a readable per-rank timeline. Success path: the straggler
+        # tables merge over the exchange and publication is barriered
+        # (rank-0 mkdir, barrier, per-rank write); failure path: no new
+        # collectives — local report, unbarriered per-rank write.
+        if tracer is not None:
+            from photon_ml_tpu.telemetry.tracing import (
+                flush_trace_best_effort,
+                uninstall_tracer,
+            )
+
+            try:
+                # best-effort: a publication error or a mixed-outcome
+                # straggler-merge timeout never masks the run's own
+                # outcome or skips the journal rows below
+                flush_trace_best_effort(
+                    tracer, params.trace_dir,
+                    exchange=_trace_exchange() if succeeded else None,
+                    gather=succeeded,
+                    journal=journal,
+                )
+            finally:
+                uninstall_tracer()
         # journal phase timings / gauges on failure too — a failed run's
         # journal is the one that most needs them. The registry snapshot
         # carries the resilience/* counters (retries, giveups,
@@ -826,6 +874,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="write a rank-0 JSONL run journal (config, phase "
                         "timings, per-coordinate convergence rows, compile/"
                         "HBM gauges) here")
+    p.add_argument("--trace-dir",
+                   help="write per-rank Chrome-trace span timelines "
+                        "(trace-{rank:05d}.json, open in Perfetto) + a "
+                        "rank-merged straggler report here; flushed on "
+                        "success and failure")
     p.add_argument("--compact-random-effect-threshold", type=int,
                    default=DEFAULT_COMPACT_RE_THRESHOLD,
                    help="warm-start RE models over this feature-space size "
@@ -899,6 +952,7 @@ def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
         resume=not args.no_resume,
         profile_dir=args.profile_dir,
         telemetry_dir=args.telemetry_dir,
+        trace_dir=args.trace_dir,
         compact_random_effect_threshold=args.compact_random_effect_threshold,
         distributed=args.distributed or bool(args.mesh),
         mesh_shape=_parse_mesh_shape(args.mesh),

@@ -107,6 +107,11 @@ class GLMDriverParams:
     #: per-λ convergence rows, compile-count gauge) finalized on completion;
     #: None = disabled
     telemetry_dir: str | None = None
+    #: run-trace output dir (telemetry/tracing.py): host-side span timeline
+    #: exported as Chrome-trace JSON (``trace-00000.json``, open in
+    #: Perfetto) + a straggler report journaled next to it — flushed on
+    #: success AND failure paths. None = disabled (zero overhead).
+    trace_dir: str | None = None
     #: corrupt-input handling for Avro ingestion: "raise" (strict,
     #: default) or "quarantine" (skip-and-count corrupt container blocks;
     #: io/avro.py + resilience layer)
@@ -271,6 +276,7 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
         "streaming_prefetch": params.streaming_prefetch,
         "checkpoint_dir": params.checkpoint_dir,
         "max_restarts": params.max_restarts,
+        "trace_dir": params.trace_dir,
     }
     events.send(SetupEvent(config_summary=json.dumps(config_summary)))
     events.send(TrainingStartEvent(job_name="glm-training"))
@@ -289,6 +295,15 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
         checkpointer = SolverCheckpointer(
             params.checkpoint_dir, save_every=params.checkpoint_every
         )
+    # span tracing is opt-in via --trace-dir; installed IMMEDIATELY before
+    # the try whose finally uninstalls it (an exception in between would
+    # leak the process-global tracer into the next run), early enough that
+    # a failure mid-read still leaves a timeline
+    tracer = None
+    if params.trace_dir:
+        from photon_ml_tpu.telemetry.tracing import Tracer, install_tracer
+
+        tracer = install_tracer(Tracer())
     try:
         with compiles:
             result = run_with_recovery(
@@ -306,6 +321,21 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
         events.send(TrainingFinishEvent(job_name="glm-training", succeeded=False))
         raise
     finally:
+        # traces flush FIRST (before the failure journal) so a crash leaves
+        # a readable timeline even if journaling itself fails; best-effort —
+        # a trace-publication error never masks the run's own outcome
+        if tracer is not None:
+            from photon_ml_tpu.telemetry.tracing import (
+                flush_trace_best_effort,
+                uninstall_tracer,
+            )
+
+            try:
+                flush_trace_best_effort(
+                    tracer, params.trace_dir, journal=journal
+                )
+            finally:
+                uninstall_tracer()
         # journal phase timings / gauges on failure too — a failed run's
         # journal is the one that most needs them (the registry snapshot
         # carries the resilience/* counters)
@@ -615,6 +645,10 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
     p.add_argument("--telemetry-dir",
                    help="write a JSONL run journal (phase timings, per-λ "
                         "convergence rows, compile counts) here")
+    p.add_argument("--trace-dir",
+                   help="write a Chrome-trace span timeline "
+                        "(trace-00000.json, open in Perfetto) + straggler "
+                        "report here; flushed on success and failure")
     p.add_argument("--on-corrupt", default="raise",
                    choices=["raise", "quarantine"],
                    help="corrupt Avro blocks: 'raise' (strict, default) "
@@ -668,6 +702,7 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
             coefficient_box_constraints=args.coefficient_box_constraints,
             input_format=args.input_format,
             telemetry_dir=args.telemetry_dir,
+            trace_dir=args.trace_dir,
             on_corrupt=args.on_corrupt,
             streaming_chunks=args.streaming_chunks,
             streaming_prefetch=not args.no_streaming_prefetch,

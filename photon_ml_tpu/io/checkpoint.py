@@ -262,18 +262,30 @@ def commit_checkpoint(
     ``train_distributed`` behavior (and a no-op gate single-process).
     Returns the step directory path on the writing rank, None elsewhere.
     """
+    from photon_ml_tpu.telemetry import tracing
+
     if checkpointer is None:
         return None
     if exchange is None:
         if jax.process_index() == 0:
-            return checkpointer.save(step, arrays, meta)
+            with tracing.span("checkpoint/write", cat="checkpoint",
+                              step=step):
+                return checkpointer.save(step, arrays, meta)
         return None
-    exchange.barrier(f"checkpoint_commit/{step}/ready")
-    path = None
-    if exchange.rank == 0:
-        path = checkpointer.save(step, arrays, meta)
-    exchange.barrier(f"checkpoint_commit/{step}/published")
-    return path
+    # the commit span brackets both barriers (their waits are recorded by
+    # the exchange's own spans, tag checkpoint_commit/*) + the rank-0
+    # write; spans observe, never gate — the barrier sequence is identical
+    # with tracing off
+    with tracing.span("checkpoint/commit", cat="checkpoint", step=step,
+                      rank=exchange.rank):
+        exchange.barrier(f"checkpoint_commit/{step}/ready")
+        path = None
+        if exchange.rank == 0:
+            with tracing.span("checkpoint/write", cat="checkpoint",
+                              step=step, rank=exchange.rank):
+                path = checkpointer.save(step, arrays, meta)
+        exchange.barrier(f"checkpoint_commit/{step}/published")
+        return path
 
 
 # -- GAME model (de)serialization to flat array dicts -------------------------

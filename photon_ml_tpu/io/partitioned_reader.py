@@ -67,7 +67,7 @@ from photon_ml_tpu.io.data_reader import (
     records_to_game_dataset,
 )
 from photon_ml_tpu.io.index_map import INTERCEPT_KEY, IndexMap
-from photon_ml_tpu.telemetry import io_counters
+from photon_ml_tpu.telemetry import io_counters, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -395,7 +395,11 @@ def read_partitioned(
             vocab_counts[t] = (vocab.tolist(), counts.astype(int).tolist())
     payload["entities"] = vocab_counts
 
-    gathered = exchange.allgather(f"partitioned_read/{tag}", payload)
+    # named layout-agreement span around the metadata allgather (the
+    # exchange's own span records the wait; this one names the seam)
+    with tracing.span("partitioned/metadata_exchange", cat="partitioned",
+                      tag=tag, rank=exchange.rank):
+        gathered = exchange.allgather(f"partitioned_read/{tag}", payload)
 
     fingerprints = {g["fingerprint"] for g in gathered}
     if len(fingerprints) != 1:
@@ -634,10 +638,13 @@ def _resolve_global_sparse_layout(
             # packed int64 bytes, not per-int Python lists: unique columns
             # reach millions at giant d, and a list-of-ints JSON payload
             # would cost tens of MB per rank through the KV transport
-            gathered_hist = exchange.allgather(
-                f"hybrid_hot/{tag}/{name}",
-                {"cols": _pack_i64(uniq), "counts": _pack_i64(cnt)},
-            )
+            with tracing.span("partitioned/hybrid_hot_exchange",
+                              cat="partitioned", shard=name,
+                              rank=exchange.rank):
+                gathered_hist = exchange.allgather(
+                    f"hybrid_hot/{tag}/{name}",
+                    {"cols": _pack_i64(uniq), "counts": _pack_i64(cnt)},
+                )
             all_cols = np.concatenate(
                 [_unpack_i64(g["cols"]) for g in gathered_hist]
             )
@@ -680,10 +687,13 @@ def _resolve_global_sparse_layout(
             if n_local else np.zeros(0, np.int64)
         )
         freq = np.bincount(counts) if n_local else np.zeros(1, np.int64)
-        gathered_rows = exchange.allgather(
-            f"ell_width/{tag}/{name}",
-            {"freq": freq.astype(int).tolist(), "n": n_local},
-        )
+        with tracing.span("partitioned/ell_width_exchange",
+                          cat="partitioned", shard=name,
+                          rank=exchange.rank):
+            gathered_rows = exchange.allgather(
+                f"ell_width/{tag}/{name}",
+                {"freq": freq.astype(int).tolist(), "n": n_local},
+            )
         depth = max(len(g["freq"]) for g in gathered_rows)
         gfreq = np.zeros(depth, dtype=np.int64)
         rank_freqs = []

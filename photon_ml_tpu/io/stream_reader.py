@@ -51,7 +51,7 @@ import numpy as np
 from photon_ml_tpu.data.batch import LabeledPointBatch
 from photon_ml_tpu.io import avro as avro_io
 from photon_ml_tpu.resilience import RetryPolicy, classify_exception, default_io_policy
-from photon_ml_tpu.telemetry import io_counters, stream_counters
+from photon_ml_tpu.telemetry import io_counters, stream_counters, tracing
 
 #: consumer-side wait bound per chunk (seconds): generous enough for a slow
 #: multi-GB chunk decode, bounded enough that a wedged producer fails
@@ -522,10 +522,15 @@ class ChunkPrefetcher:
 
     def _load_timed(self, spec: ChunkSpec):
         t0 = time.perf_counter()
-        batch = self.policy.call(
-            self.source.load, spec,
-            description=f"decode chunk {spec.index}",
-        )
+        # the decode span runs in whichever thread loads (producer when
+        # prefetching, consumer inline otherwise) — per-thread trace
+        # buffers keep both readable in the timeline
+        with tracing.span("io/decode_chunk", cat="stream",
+                          chunk=spec.index, records=spec.num_records):
+            batch = self.policy.call(
+                self.source.load, spec,
+                description=f"decode chunk {spec.index}",
+            )
         dt = time.perf_counter() - t0
         self.decode_seconds += dt
         stream_counters.record_chunk_decode_ms(dt * 1e3)
@@ -591,21 +596,25 @@ class ChunkPrefetcher:
     def _next_prefetched(self):
         deadline = time.perf_counter() + self.chunk_timeout
         t0 = time.perf_counter()
-        while True:
-            try:
-                item = self._queue.get(timeout=0.2)
-                self.wait_seconds += time.perf_counter() - t0
-                return item
-            except queue.Empty:
-                if self._thread is not None and not self._thread.is_alive():
-                    raise StreamDecodeError(
-                        "prefetch thread died without forwarding a result"
-                    ) from None
-                if time.perf_counter() > deadline:
-                    raise StreamDecodeError(
-                        f"no chunk arrived within {self.chunk_timeout:.0f}s "
-                        "(wedged decode?)"
-                    ) from None
+        # consumer-side queue wait: the complement of io/decode_chunk in
+        # the overlap story (overlap ≈ 1 - wait/decode, the
+        # stream/overlap_fraction gauge — the spans reproduce it)
+        with tracing.span("io/chunk_wait", cat="stream"):
+            while True:
+                try:
+                    item = self._queue.get(timeout=0.2)
+                    self.wait_seconds += time.perf_counter() - t0
+                    return item
+                except queue.Empty:
+                    if self._thread is not None and not self._thread.is_alive():
+                        raise StreamDecodeError(
+                            "prefetch thread died without forwarding a result"
+                        ) from None
+                    if time.perf_counter() > deadline:
+                        raise StreamDecodeError(
+                            f"no chunk arrived within "
+                            f"{self.chunk_timeout:.0f}s (wedged decode?)"
+                        ) from None
 
     def __iter__(self):
         if not self.prefetch:
@@ -737,7 +746,9 @@ def plan_partitioned_stream(
             for shard, cfg in shard_configs.items()
         },
     }
-    gathered = exchange.allgather(f"stream_plan/{tag}", payload)
+    with tracing.span("partitioned/stream_plan_exchange", cat="partitioned",
+                      tag=tag, rank=exchange.rank):
+        gathered = exchange.allgather(f"stream_plan/{tag}", payload)
     fingerprints = {g["fingerprint"] for g in gathered}
     if len(fingerprints) != 1:
         raise RuntimeError(

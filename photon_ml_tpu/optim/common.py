@@ -62,11 +62,19 @@ def run_while(cond, body, init, *, host: bool = False, observer=None):
                 "lax.while_loop body cannot call back to the host"
             )
         return lax.while_loop(cond, body, init)
+    from photon_ml_tpu.telemetry import tracing
+
     state = init
+    i = 0
     while bool(cond(state)):
-        state = body(state)
+        # per-iteration host wall-clock span (a streaming solve's iteration
+        # IS an epoch or several); observes only — the body/observer
+        # sequence is identical with tracing off
+        with tracing.span("solver/iteration", cat="solver", i=i):
+            state = body(state)
         if observer is not None:
             observer(state)
+        i += 1
     return state
 
 

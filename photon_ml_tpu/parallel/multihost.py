@@ -38,6 +38,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
+from photon_ml_tpu.telemetry import tracing
+
 logger = logging.getLogger(__name__)
 
 _INITIALIZED = False
@@ -229,13 +231,18 @@ class MetadataExchange:
 
 
 class SingleProcessExchange(MetadataExchange):
-    """The trivial exchange: one rank, no waiting."""
+    """The trivial exchange: one rank, no waiting. Still traced (zero-wait
+    spans) so a single-process timeline shows where exchanges would sit."""
 
     def allgather(self, tag: str, payload) -> list:
-        return [payload]
+        with tracing.span("exchange/allgather", cat=tracing.EXCHANGE_CAT,
+                          tag=tag, rank=self.rank):
+            return [payload]
 
     def barrier(self, tag: str) -> None:
-        return None
+        with tracing.span("exchange/barrier", cat=tracing.EXCHANGE_CAT,
+                          tag=tag, rank=self.rank):
+            return None
 
 
 class InProcessExchange(MetadataExchange):
@@ -271,7 +278,10 @@ class InProcessExchange(MetadataExchange):
         key = (self._seq, tag)
         self._seq += 1
         cond, slot = self._store["cond"], self._store["gather"]
-        with cond:
+        # the span OBSERVES the blocking wait (tag + seq + rank for the
+        # straggler tables); it never gates or reorders the exchange
+        with tracing.span("exchange/allgather", cat=tracing.EXCHANGE_CAT,
+                          tag=tag, seq=key[0], rank=self.rank), cond:
             entry = slot.setdefault(key, {})
             entry[self.rank] = payload
             cond.notify_all()
@@ -373,7 +383,9 @@ class DistributedKVExchange(MetadataExchange):
                     return
                 raise
 
-        self._retry.call(attempt, description=f"kv_set {key}")
+        with tracing.span("exchange/kv_set", cat=tracing.EXCHANGE_IO_CAT,
+                          key=key, rank=self.rank):
+            self._retry.call(attempt, description=f"kv_set {key}")
 
     def _kv_get(self, key: str, tag: str, expected_rank: int) -> str:
         from photon_ml_tpu.resilience.errors import ExchangeTimeout
@@ -395,7 +407,9 @@ class DistributedKVExchange(MetadataExchange):
                     ) from e
                 raise
 
-        return self._retry.call(attempt, description=f"kv_get {key}")
+        with tracing.span("exchange/kv_get", cat=tracing.EXCHANGE_IO_CAT,
+                          key=key, tag=tag, rank=self.rank):
+            return self._retry.call(attempt, description=f"kv_get {key}")
 
     def _wait_barrier(self, barrier_id: str, tag: str) -> None:
         from photon_ml_tpu.resilience.errors import ExchangeTimeout
@@ -415,26 +429,36 @@ class DistributedKVExchange(MetadataExchange):
 
     def allgather(self, tag: str, payload) -> list:
         seq = _kv_seq()
-        self._kv_set(self._key(tag, seq, self.rank), json.dumps(payload))
-        out = []
-        for r in range(self.num_ranks):
-            raw = self._kv_get(self._key(tag, seq, r), tag, r)
-            out.append(json.loads(raw))
-        # every rank has read every key — reclaim our own entry so the
-        # coordinator's KV store does not retain one payload per exchange
-        # for the process lifetime (feature-key lists can be MBs)
-        self._wait_barrier(f"photon/bar/xchg-read/{seq}", tag)
-        try:
-            self._client.key_value_delete(self._key(tag, seq, self.rank))
-        except RuntimeError as e:
-            # reclamation is best-effort; a leaked payload must not fail
-            # an otherwise-complete exchange
-            logger.warning("kv reclaim of %s failed: %s",
-                           self._key(tag, seq, self.rank), e)
-        return out
+        # one wait span per allgather (tag + seq + rank) — the kv_get/
+        # kv_set sub-spans nest inside it; the straggler tables read only
+        # this outer wait. Observes, never gates.
+        with tracing.span("exchange/allgather", cat=tracing.EXCHANGE_CAT,
+                          tag=tag, seq=seq, rank=self.rank):
+            self._kv_set(self._key(tag, seq, self.rank), json.dumps(payload))
+            out = []
+            for r in range(self.num_ranks):
+                raw = self._kv_get(self._key(tag, seq, r), tag, r)
+                out.append(json.loads(raw))
+            # every rank has read every key — reclaim our own entry so the
+            # coordinator's KV store does not retain one payload per
+            # exchange for the process lifetime (feature-key lists can be
+            # MBs)
+            self._wait_barrier(f"photon/bar/xchg-read/{seq}", tag)
+            try:
+                self._client.key_value_delete(
+                    self._key(tag, seq, self.rank)
+                )
+            except RuntimeError as e:
+                # reclamation is best-effort; a leaked payload must not
+                # fail an otherwise-complete exchange
+                logger.warning("kv reclaim of %s failed: %s",
+                               self._key(tag, seq, self.rank), e)
+            return out
 
     def barrier(self, tag: str) -> None:
-        self._wait_barrier(f"photon/bar/{_kv_seq()}/{tag}", tag)
+        with tracing.span("exchange/barrier", cat=tracing.EXCHANGE_CAT,
+                          tag=tag, rank=self.rank):
+            self._wait_barrier(f"photon/bar/{_kv_seq()}/{tag}", tag)
 
 
 def default_exchange() -> MetadataExchange:

@@ -34,6 +34,20 @@ from photon_ml_tpu.telemetry.registry import (
     MetricsRegistry,
     default_registry,
 )
+from photon_ml_tpu.telemetry.tracing import (
+    Tracer,
+    current_tracer,
+    exchange_wait_tables,
+    finalize_trace,
+    flush_trace_best_effort,
+    gather_straggler_report,
+    install_tracer,
+    publish_trace,
+    span,
+    straggler_report,
+    tracing_active,
+    uninstall_tracer,
+)
 # solver_trace pulls jax/flax (via optim.common); load it lazily so that
 # importing the registry/journal/probes side of telemetry — which util.timed
 # does on every import — stays jax-free (the drivers/conftest configure the
@@ -82,4 +96,16 @@ __all__ = [
     "lane_rows",
     "lane_summary",
     "solver_result_row",
+    "Tracer",
+    "current_tracer",
+    "exchange_wait_tables",
+    "finalize_trace",
+    "flush_trace_best_effort",
+    "gather_straggler_report",
+    "install_tracer",
+    "publish_trace",
+    "span",
+    "straggler_report",
+    "tracing_active",
+    "uninstall_tracer",
 ]

@@ -69,8 +69,10 @@ def json_safe(obj):
 class RunJournal:
     """``with RunJournal(out_dir) as j: j.record("phase_timing", ...)``.
 
-    Records are dicts with a ``kind`` plus caller fields; ``seq`` and ``ts``
-    are stamped automatically. Inactive (rank > 0, or ``directory=None``)
+    Records are dicts with a ``kind`` plus caller fields; ``seq``, ``ts``
+    (absolute wall clock) and ``elapsed_ms`` (monotonic since journal
+    open — robust to host clock steps, correlates with trace spans) are
+    stamped automatically. Inactive (rank > 0, or ``directory=None``)
     journals accept every call and write nothing.
     """
 
@@ -87,6 +89,10 @@ class RunJournal:
         self._seq = 0
         self._spool = None
         self._closed = False
+        # monotonic anchor: rows carry elapsed_ms since journal open so
+        # they order correctly across host clock steps and correlate with
+        # trace spans (telemetry/tracing.py durations are perf_counter too)
+        self._t0 = time.perf_counter()
         if self.active:
             self._spool = tempfile.NamedTemporaryFile(
                 mode="w", suffix=".jsonl", prefix="photon-journal-",
@@ -108,7 +114,16 @@ class RunJournal:
     def record(self, kind: str, **fields) -> None:
         if not self.active:
             return
-        row = {"kind": kind, "seq": self._seq, "ts": time.time()}
+        row = {
+            "kind": kind,
+            "seq": self._seq,
+            # ts is the ONE sanctioned absolute wall-clock stamp (lint
+            # check 11 allowlist); durations/ordering ride elapsed_ms
+            "ts": time.time(),
+            "elapsed_ms": round(
+                (time.perf_counter() - self._t0) * 1e3, 3
+            ),
+        }
         row.update(json_safe(fields))
         self._seq += 1
         self._spool.write(json.dumps(row, allow_nan=False) + "\n")

@@ -6,7 +6,10 @@ coordinate-descent loop. Here a context manager / decorator; durations feed
 the process-wide metrics registry (telemetry/registry.py histograms under
 ``timing/<name>``) so drivers can print a phase summary with distribution
 stats, and each block emits a jax.profiler StepTraceAnnotation so phases
-line up with device traces in TensorBoard.
+line up with device traces in TensorBoard. Each block also records a
+``phase/<name>`` span into the run tracer when one is installed
+(telemetry/tracing.py — inert by default), so driver phases frame the
+finer seam spans in the exported timeline.
 """
 
 from __future__ import annotations
@@ -16,6 +19,7 @@ import logging
 import time
 from functools import wraps
 
+from photon_ml_tpu.telemetry import tracing
 from photon_ml_tpu.telemetry.registry import default_registry
 
 logger = logging.getLogger("photon_ml_tpu.timing")
@@ -41,11 +45,14 @@ class Timed(contextlib.AbstractContextManager):
             self._annotation.__enter__()
         except Exception:  # profiler unavailable: timing still works
             self._annotation = None
+        self._span = tracing.span("phase/" + self.name, cat="phase")
+        self._span.__enter__()
         self._start = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         self.duration = time.perf_counter() - self._start
+        self._span.__exit__(exc_type, exc, tb)
         if self._annotation is not None:
             self._annotation.__exit__(exc_type, exc, tb)
         default_registry().histogram(_TIMING_PREFIX + self.name).observe(

@@ -411,3 +411,63 @@ def test_lint_catches_ungated_checkpoint_saves(tmp_path):
     assert any("cd.py:3" in p for p in problems)
     assert not any("trainer.py:9" in p for p in problems)  # imap.save
     assert not any("checkpoint.py" in p for p in problems)  # io/ helper
+
+
+def test_lint_catches_time_time_durations(tmp_path):
+    """Check 11 fires: time.time() (module attribute or from-import alias)
+    anywhere in photon_ml_tpu/ outside the reviewed absolute-timestamp
+    allowlist is reported; the allowlisted class-QUALIFIED journal
+    ``RunJournal.record`` ts site passes, perf_counter is never the
+    lint's business, and neither a same-named function in another file
+    nor another method of the same name in the allowlisted file inherits
+    the exemption."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    pkg = tmp_path / "photon_ml_tpu" / "util"
+    pkg.mkdir(parents=True)
+    (pkg / "durations.py").write_text(
+        '"""No reference analogue."""\n'
+        "import time\n"
+        "from time import time as now\n"
+        "import time as clock\n"
+        "def f():\n"
+        "    t0 = time.time()\n"
+        "    return time.time() - t0  # a duration from wall clock\n"
+        "def g():\n"
+        "    return now()\n"
+        "def ok():\n"
+        "    return time.perf_counter()\n"
+        "def h():\n"
+        "    return clock.time()  # module-aliased: still wall clock\n"
+        "class RunJournal:\n"
+        "    def record(self):\n"
+        "        # allowlisted QUALIFIED name but wrong FILE: still banned\n"
+        "        return time.time()\n"
+    )
+    tel = tmp_path / "photon_ml_tpu" / "telemetry"
+    tel.mkdir(parents=True)
+    (tel / "journal.py").write_text(
+        '"""No reference analogue."""\n'
+        "import time\n"
+        "class RunJournal:\n"
+        "    def record(self):\n"
+        "        return {'ts': time.time()}  # the reviewed absolute stamp\n"
+        "class Spool:\n"
+        "    def record(self):\n"
+        "        # allowlisted file + bare method name, WRONG class\n"
+        "        return time.time()\n"
+    )
+    problems = lint_parity.run_lints(tmp_path)
+    assert any("durations.py:6" in p and "time.time()" in p
+               for p in problems), problems
+    assert any("durations.py:7" in p for p in problems)
+    assert any("durations.py:9" in p for p in problems)  # from-import alias
+    assert not any("durations.py:11" in p for p in problems)  # perf_counter
+    assert any("durations.py:13" in p for p in problems)  # module alias
+    assert any("durations.py:17" in p for p in problems)  # wrong file
+    assert not any("journal.py:5" in p for p in problems)  # allowlisted
+    assert any("journal.py:9" in p for p in problems)  # wrong class

@@ -434,6 +434,96 @@ class TestExchangeDeadlines:
 
 
 # ---------------------------------------------------------------------------
+# run tracing under faults (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+class TestTracingChaos:
+    def test_wedged_rank_named_in_straggler_report_and_traces_publish(
+        self, tmp_path
+    ):
+        """A WithholdingExchange-wedged rank shows up in the straggler
+        report as the named slowest rank on the withheld tag: the healthy
+        ranks' wait spans are recorded as the bounded ExchangeTimeout
+        surfaces (the span closes on the exception), so the report comes
+        from local tables alone — no further collectives on the failure
+        path — and the trace files still publish. Hang-free via the
+        sub-second exchange deadline."""
+        from photon_ml_tpu.parallel.multihost import InProcessExchange
+        from photon_ml_tpu.telemetry.tracing import (
+            Tracer,
+            exchange_wait_tables,
+            install_tracer,
+            publish_trace,
+            straggler_report,
+            uninstall_tracer,
+        )
+
+        tracer = install_tracer(Tracer(rank=0))
+        try:
+            group = InProcessExchange.create_group(3, timeout=0.4)
+            exchanges = [
+                group[0],
+                faultinject.WithholdingExchange(group[1], ("hybrid_hot",)),
+                group[2],
+            ]
+            boxes = [{} for _ in range(3)]
+
+            def run(r):
+                try:
+                    exchanges[r].allgather("hybrid_hot/game/f", {"r": r})
+                    boxes[r]["error"] = None
+                except BaseException as e:  # asserted on below
+                    boxes[r]["error"] = e
+
+            threads = [threading.Thread(target=run, args=(r,), daemon=True)
+                       for r in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10.0)
+                assert not t.is_alive(), "withheld allgather hung"
+            assert isinstance(boxes[1]["error"], faultinject.InjectedCrash)
+            for r in (0, 2):
+                assert isinstance(boxes[r]["error"], ExchangeTimeout)
+
+            # straggler attribution BEFORE any run-end merge collective:
+            # the wedged rank never recorded a wait on the tag, the
+            # healthy ranks each recorded ~the deadline with the timeout
+            # error attached
+            tables = exchange_wait_tables(tracer)
+            assert "hybrid_hot/game/f" not in tables.get(1, {})
+            report = straggler_report(tables, num_ranks=3)
+            row = next(
+                t for t in report["tags"] if t["tag"] == "hybrid_hot/game/f"
+            )
+            assert row["straggler_rank"] == 1
+            assert row["reason"] == "never_arrived"
+            assert row["missing_ranks"] == [1]
+            for r in (0, 2):
+                assert 0.3 <= row["wait_s"][r] < 5.0  # bounded, not a hang
+
+            # failure-path publication: the timeline still lands, valid
+            # Chrome-trace JSON with the recorded exchange waits
+            path = publish_trace(tracer, tmp_path / "traces")
+            assert os.path.basename(path) == "trace-00000.json"
+            with open(path) as f:
+                doc = json.load(f)
+            xevents = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+            waits = [e for e in xevents
+                     if e["name"] == "exchange/allgather"
+                     and e["args"].get("tag") == "hybrid_hot/game/f"]
+            assert len(waits) == 2  # the two healthy ranks
+            assert {e["args"]["error"] for e in waits} == {"ExchangeTimeout"}
+            assert not [
+                e for e in os.listdir(tmp_path / "traces")
+                if e.endswith(".tmp")
+            ]
+        finally:
+            uninstall_tracer()
+
+
+# ---------------------------------------------------------------------------
 # checkpoint atomicity + intact-step fallback
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,671 @@
+"""Run-trace subsystem (ISSUE 9): span API, Chrome-trace export, straggler
+attribution, seam instrumentation, driver wiring.
+
+Contracts pinned here:
+
+- tracing OFF (the default) is inert: ``span()`` returns a shared null
+  object and instrumented paths are BITWISE identical with a tracer
+  installed vs not (spans observe, never gate);
+- exported files parse as valid Chrome-trace JSON (complete "X" events,
+  pid = rank, tid = thread), published atomically as trace-{rank:05d}.json;
+- a virtual-rank composed run (partitioned x hybrid x scheduler) produces
+  a merged straggler report naming the injected slow rank;
+- the prefetcher's decode/wait spans reproduce the stream/overlap_fraction
+  gauge to tolerance;
+- dev/trace_summary.py merges per-rank files into the self-time + per-rank
+  exchange-wait report.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.telemetry.tracing import (
+    Tracer,
+    current_tracer,
+    exchange_wait_tables,
+    gather_straggler_report,
+    install_tracer,
+    normalize_tag,
+    publish_trace,
+    span,
+    straggler_report,
+    tracing_active,
+    uninstall_tracer,
+)
+
+
+@pytest.fixture
+def tracer():
+    t = install_tracer(Tracer(rank=0, capacity=8192))
+    try:
+        yield t
+    finally:
+        uninstall_tracer()
+
+
+# ---------------------------------------------------------------------------
+# span API
+# ---------------------------------------------------------------------------
+
+
+class TestSpanAPI:
+    def test_off_by_default_returns_shared_null(self):
+        assert not tracing_active()
+        assert current_tracer() is None
+        s1 = span("a", x=1)
+        s2 = span("b")
+        assert s1 is s2  # one shared null object, nothing allocated
+        with s1:
+            pass  # inert
+
+    def test_span_records_duration_and_attrs(self, tracer):
+        with span("unit/work", cat="test", k=7):
+            time.sleep(0.01)
+        events = list(tracer.events())
+        assert len(events) == 1
+        ev = events[0]
+        assert ev.name == "unit/work"
+        assert ev.cat == "test"
+        assert ev.attrs == {"k": 7}
+        assert ev.dur >= 0.009
+        assert ev.start >= 0.0
+
+    def test_span_records_on_exception_with_error_attr(self, tracer):
+        with pytest.raises(ValueError):
+            with span("unit/boom", cat="test"):
+                raise ValueError("x")
+        (ev,) = tracer.events()
+        assert ev.attrs["error"] == "ValueError"
+
+    def test_per_thread_buffers_no_interleaving(self, tracer):
+        def work(i):
+            for _ in range(5):
+                with span(f"t{i}", cat="test"):
+                    pass
+
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        events = list(tracer.events())
+        assert len(events) == 15
+        by_thread = {}
+        for ev in events:
+            by_thread.setdefault(ev.thread_id, set()).add(ev.name)
+        # each producing thread's buffer holds only its own spans
+        assert all(len(names) == 1 for names in by_thread.values())
+
+    def test_ring_overwrites_oldest_and_counts_drops(self):
+        t = install_tracer(Tracer(rank=0, capacity=16))
+        try:
+            for i in range(20):
+                with span(f"e{i}", cat="test"):
+                    pass
+            events = list(t.events())
+            assert len(events) == 16
+            assert events[0].name == "e4"  # oldest 4 overwritten
+            assert events[-1].name == "e19"
+            assert t.dropped_events() == 4
+        finally:
+            uninstall_tracer()
+
+    def test_normalize_tag_pools_numbered_tags(self):
+        assert normalize_tag("checkpoint_commit/7/ready") == \
+            "checkpoint_commit/*/ready"
+        assert normalize_tag("hybrid_hot/game/f") == "hybrid_hot/game/f"
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export
+# ---------------------------------------------------------------------------
+
+
+class TestChromeExport:
+    def test_export_is_valid_catapult_json(self, tracer, tmp_path):
+        with span("outer", cat="test", rank=0):
+            with span("inner", cat="test"):
+                pass
+        path = publish_trace(tracer, tmp_path / "traces")
+        assert os.path.basename(path) == "trace-00000.json"
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["displayTimeUnit"] == "ms"
+        assert doc["otherData"]["rank"] == 0
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"outer", "inner"}
+        for e in xs:
+            assert set(e) >= {"name", "cat", "ph", "ts", "dur", "pid", "tid"}
+            assert e["pid"] == 0
+            assert e["ts"] >= 0 and e["dur"] >= 0
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert any(e["name"] == "process_name" for e in metas)
+        assert any(e["name"] == "thread_name" for e in metas)
+        # atomic publish: no staging litter
+        assert not [
+            e for e in os.listdir(tmp_path / "traces") if e.endswith(".tmp")
+        ]
+
+    def test_rank_attr_becomes_pid(self, tracer, tmp_path):
+        with span("exchange/allgather", cat="exchange", tag="t", rank=3):
+            pass
+        doc = json.loads(open(publish_trace(tracer, tmp_path)).read())
+        ev = next(e for e in doc["traceEvents"]
+                  if e["ph"] == "X" and e["name"] == "exchange/allgather")
+        assert ev["pid"] == 3
+        assert any(e["ph"] == "M" and e["name"] == "process_name"
+                   and e["pid"] == 3 for e in doc["traceEvents"])
+
+    def test_publish_overwrites_previous_trace(self, tracer, tmp_path):
+        with span("a", cat="test"):
+            pass
+        publish_trace(tracer, tmp_path)
+        path = publish_trace(tracer, tmp_path)
+        json.load(open(path))  # still valid after the overwrite
+
+
+# ---------------------------------------------------------------------------
+# exchange wait tables + straggler attribution
+# ---------------------------------------------------------------------------
+
+
+def _run_ranks(fn, num_ranks):
+    errors = []
+
+    def call(r):
+        try:
+            fn(r)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append((r, e))
+
+    threads = [threading.Thread(target=call, args=(r,))
+               for r in range(num_ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+        assert not t.is_alive(), "virtual rank hung"
+    assert not errors, errors
+
+
+class TestStragglerAttribution:
+    def test_slow_rank_named_by_least_wait(self, tracer):
+        from photon_ml_tpu.parallel.multihost import InProcessExchange
+
+        group = InProcessExchange.create_group(3)
+
+        def run(r):
+            if r == 2:
+                time.sleep(0.15)  # the injected straggler
+            group[r].allgather("sweep/hot", {"r": r})
+
+        _run_ranks(run, 3)
+        tables = exchange_wait_tables(tracer)
+        assert set(tables) == {0, 1, 2}
+        report = straggler_report(tables, num_ranks=3)
+        row = next(t for t in report["tags"] if t["tag"] == "sweep/hot")
+        assert row["straggler_rank"] == 2
+        assert row["reason"] == "least_wait"
+        # the early ranks each waited ~the injected delay
+        assert row["wait_s"][0] > 0.1 and row["wait_s"][1] > 0.1
+        assert row["wait_s"][2] < row["wait_s"][0]
+
+    def test_gather_straggler_report_merges_over_the_exchange(self, tracer):
+        from photon_ml_tpu.parallel.multihost import InProcessExchange
+
+        group = InProcessExchange.create_group(2)
+        reports = [None, None]
+
+        def run(r):
+            if r == 1:
+                time.sleep(0.1)
+            group[r].allgather("sweep/hot", {"r": r})
+            reports[r] = gather_straggler_report(tracer, group[r])
+
+        _run_ranks(run, 2)
+        for report in reports:
+            assert report["num_ranks"] == 2
+            assert report["dropped_events"] == [0, 0]
+            row = next(t for t in report["tags"] if t["tag"] == "sweep/hot")
+            assert row["straggler_rank"] == 1
+            assert row["reason"] == "least_wait"
+
+    def test_merge_timeout_falls_back_to_partial_local_report(
+        self, tracer, tmp_path
+    ):
+        """A mixed-outcome run (this rank fine, a peer died before its
+        run-end collectives): the straggler-merge timeout degrades to a
+        PARTIAL report over the ranks this tracer observed — unobserved
+        peers are never blamed as 'never_arrived', and the partial flag
+        tells the reader to merge the trace FILES offline instead."""
+        from photon_ml_tpu.resilience.errors import ExchangeTimeout
+        from photon_ml_tpu.telemetry.tracing import finalize_trace
+
+        class DeadPeerExchange:
+            rank = 0
+            num_ranks = 4
+
+            def allgather(self, tag, payload):
+                raise ExchangeTimeout(tag, rank=0, timeout=0.1)
+
+            def barrier(self, tag):
+                raise ExchangeTimeout(tag, rank=0, timeout=0.1)
+
+        with span("exchange/allgather", cat="exchange", tag="sweep/hot",
+                  rank=0):
+            pass
+        report = finalize_trace(
+            tracer, tmp_path / "traces", exchange=DeadPeerExchange(),
+            gather=True,
+        )
+        assert report["partial"] is True
+        assert report["observed_ranks"] == [0]
+        assert report["expected_num_ranks"] == 4
+        assert report["num_ranks"] == 1  # the universe wait_s indexes
+        for row in report["tags"]:
+            assert row["reason"] == "single_rank"  # no false blame
+        # the trace file still published despite both dead collectives
+        assert os.path.exists(tmp_path / "traces" / "trace-00000.json")
+
+    def test_never_arrived_rank_outranks_wait_comparison(self):
+        tables = {
+            0: {"sweep/hot": {"count": 1, "wait_s": 0.4, "max_s": 0.4}},
+            2: {"sweep/hot": {"count": 1, "wait_s": 0.39, "max_s": 0.39}},
+        }
+        report = straggler_report(tables, num_ranks=3)
+        row = report["tags"][0]
+        assert row["straggler_rank"] == 1
+        assert row["reason"] == "never_arrived"
+        assert row["missing_ranks"] == [1]
+        assert row["wait_s"][1] is None
+
+    def test_single_process_exchange_records_zero_wait_spans(self, tracer):
+        from photon_ml_tpu.parallel.multihost import SingleProcessExchange
+
+        ex = SingleProcessExchange()
+        ex.allgather("meta", {"x": 1})
+        ex.barrier("done")
+        tables = exchange_wait_tables(tracer)
+        assert set(tables[0]) == {"meta", "done"}
+
+
+# ---------------------------------------------------------------------------
+# seam instrumentation
+# ---------------------------------------------------------------------------
+
+
+class TestSeamSpans:
+    def test_run_while_host_mode_iteration_spans(self, tracer):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.optim.common import run_while
+
+        out = run_while(
+            lambda s: s < 5,
+            lambda s: s + 1,
+            jnp.asarray(0),
+            host=True,
+        )
+        assert int(out) == 5
+        iters = [e for e in tracer.events() if e.name == "solver/iteration"]
+        assert len(iters) == 5
+        assert [e.attrs["i"] for e in iters] == list(range(5))
+
+    def test_commit_checkpoint_spans_and_barrier_tags(self, tracer, tmp_path):
+        from photon_ml_tpu.io.checkpoint import (
+            TrainingCheckpointer,
+            commit_checkpoint,
+        )
+        from photon_ml_tpu.parallel.multihost import SingleProcessExchange
+
+        ck = TrainingCheckpointer(tmp_path / "ck")
+        commit_checkpoint(ck, 7, {"w": np.arange(3.0)}, {},
+                          exchange=SingleProcessExchange())
+        names = [e.name for e in tracer.events()]
+        assert "checkpoint/commit" in names
+        assert "checkpoint/write" in names
+        waits = exchange_wait_tables(tracer)[0]
+        assert "checkpoint_commit/*/ready" in waits
+        assert "checkpoint_commit/*/published" in waits
+
+    def test_prefetcher_spans_reproduce_overlap_fraction(self, tracer):
+        from photon_ml_tpu.io.stream_reader import (
+            ArrayChunkSource,
+            ChunkPrefetcher,
+        )
+        from photon_ml_tpu.telemetry import stream_counters
+
+        stream_counters.reset_stream_metrics()
+        rng = np.random.default_rng(0)
+        n, d, rows = 64, 4, 8
+        source = ArrayChunkSource(
+            rng.normal(size=(n, d)).astype(np.float32),
+            rng.normal(size=n).astype(np.float32),
+            chunk_rows=rows,
+            decode_hook=lambda: time.sleep(0.02),
+        )
+        with ChunkPrefetcher(source, prefetch=True) as chunks:
+            for _ in chunks:
+                time.sleep(0.03)  # consumer work decode can hide behind
+        gauge = stream_counters.overlap_fraction()
+        assert gauge > 0.3  # decode really hid behind the consumer
+
+        decode = sum(e.dur for e in tracer.events()
+                     if e.name == "io/decode_chunk")
+        wait = sum(e.dur for e in tracer.events()
+                   if e.name == "io/chunk_wait")
+        assert decode > 0.0
+        span_overlap = max(0.0, decode - wait) / decode
+        assert abs(span_overlap - gauge) < 0.15
+
+    def test_streaming_epoch_spans(self, tracer):
+        from photon_ml_tpu.algorithm.streaming import StreamingGLMObjective
+        from photon_ml_tpu.io.stream_reader import ArrayChunkSource
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.types import TaskType
+
+        rng = np.random.default_rng(1)
+        n, d = 32, 3
+        source = ArrayChunkSource(
+            rng.normal(size=(n, d)).astype(np.float64),
+            rng.normal(size=n).astype(np.float64),
+            chunk_rows=8,
+        )
+        obj = StreamingGLMObjective(
+            source, loss_for_task(TaskType.LINEAR_REGRESSION), l2_weight=0.1
+        )
+        obj.value_and_grad(np.zeros(d))
+        names = [e.name for e in tracer.events()]
+        assert names.count("stream/epoch") == 1
+        assert names.count("stream/accumulate") == source.num_chunks
+
+
+# ---------------------------------------------------------------------------
+# tracing off is bitwise-identical (spans observe, never gate)
+# ---------------------------------------------------------------------------
+
+
+class TestOffBitwise:
+    def test_streaming_solve_identical_with_and_without_tracer(self):
+        """The instrumented path (host-loop solver + prefetcher + epoch
+        accumulation spans) trains BITWISE identically with a tracer
+        installed vs not — spans observe wall-clock only, never gate."""
+        from photon_ml_tpu.estimators import train_glm_streaming
+        from photon_ml_tpu.io.stream_reader import ArrayChunkSource
+        from photon_ml_tpu.optim.optimizer import (
+            OptimizerConfig,
+            OptimizerType,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        rng = np.random.default_rng(7)
+        n, d = 48, 5
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (x @ rng.normal(size=d) + 0.1 * rng.normal(size=n)).astype(
+            np.float32
+        )
+        opt = OptimizerConfig(
+            optimizer_type=OptimizerType.LBFGS, max_iterations=6
+        )
+
+        def fit():
+            models = train_glm_streaming(
+                ArrayChunkSource(x, y, chunk_rows=16),
+                TaskType.LINEAR_REGRESSION, optimizer=opt,
+                regularization_weights=(0.5,),
+            )
+            return np.asarray(models[0.5].coefficients.means)
+
+        baseline = fit()
+        t = install_tracer(Tracer(rank=0))
+        try:
+            traced = fit()
+        finally:
+            uninstall_tracer()
+        names = {e.name for e in t.events()}
+        # the traced run really crossed the instrumented seams
+        assert {"solver/iteration", "stream/epoch",
+                "io/decode_chunk"} <= names
+        np.testing.assert_array_equal(baseline, traced)
+
+
+# ---------------------------------------------------------------------------
+# composed virtual-rank run: merged timeline + straggler naming
+# ---------------------------------------------------------------------------
+
+
+class _SlowOnTag:
+    """Exchange wrapper: THIS rank arrives late (sleeps) at every exchange
+    whose tag matches — the injected straggler. It still makes every call
+    (unlike WithholdingExchange)."""
+
+    def __init__(self, inner, needle, delay):
+        self._inner = inner
+        self._needle = needle
+        self._delay = delay
+        self.rank = inner.rank
+        self.num_ranks = inner.num_ranks
+
+    def allgather(self, tag, payload):
+        if self._needle in tag:
+            time.sleep(self._delay)
+        return self._inner.allgather(tag, payload)
+
+    def barrier(self, tag):
+        return self._inner.barrier(tag)
+
+
+class TestComposedTimeline:
+    def test_composed_run_timeline_names_injected_slow_rank(
+        self, tracer, tmp_path
+    ):
+        """The acceptance run: partitioned ingestion x global hybrid head x
+        scheduled RE solves under one tracer, rank 1 injected slow at the
+        hybrid_hot layout allgather — the merged timeline's straggler
+        report names rank 1, and the exported trace holds spans from every
+        seam category."""
+        from test_composed_path import (
+            _build_re_ranks,
+            _read_ranks,
+            _shard_configs,
+            _train_composed_with,
+            _write_input,
+        )
+
+        from photon_ml_tpu.parallel.multihost import make_hybrid_mesh
+
+        path = _write_input(tmp_path, tail="uniform")
+        configs = _shard_configs()
+        mesh = make_hybrid_mesh(data=4, model=2)
+
+        def wrap(exchange):
+            if exchange.rank == 1:
+                return _SlowOnTag(exchange, "hybrid_hot", 0.2)
+            return exchange
+
+        parts, exchanges, errors = _read_ranks(path, configs, wrap=wrap)
+        assert not errors, errors
+        re_parts = _build_re_ranks(parts, exchanges)
+        _train_composed_with(parts, re_parts, mesh)
+
+        # merged straggler report: rank 1 arrived last at hybrid_hot
+        report = straggler_report(exchange_wait_tables(tracer))
+        row = next(t for t in report["tags"] if "hybrid_hot" in t["tag"])
+        assert row["straggler_rank"] == 1
+        assert row["reason"] == "least_wait"
+        assert row["wait_s"][0] > row["wait_s"][1]
+
+        # the exported timeline parses and carries every seam category
+        doc = json.loads(
+            open(publish_trace(tracer, tmp_path / "traces")).read()
+        )
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        names = {e["name"] for e in xs}
+        assert "partitioned/hybrid_hot_exchange" in names
+        assert "partitioned/metadata_exchange" in names
+        assert "partitioned/ell_width_exchange" in names
+        assert "scheduler/probe" in names
+        assert "exchange/allgather" in names
+        # exchange spans separate virtual ranks by pid
+        pids = {e["pid"] for e in xs if e["name"] == "exchange/allgather"}
+        assert pids == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# dev/trace_summary.py (offline merge CLI)
+# ---------------------------------------------------------------------------
+
+
+class TestTraceSummary:
+    def _fixture_dir(self, tmp_path):
+        """Two per-rank trace files from a virtual 2-rank run with rank 1
+        injected slow."""
+        from photon_ml_tpu.parallel.multihost import InProcessExchange
+
+        group = InProcessExchange.create_group(2)
+        tracers = [Tracer(rank=r, capacity=1024) for r in range(2)]
+
+        def run(r):
+            # simulate each rank's process: its tracer records its spans
+            if r == 1:
+                time.sleep(0.12)
+            t0 = time.perf_counter()
+            group[r].allgather("sweep/hot", {"r": r})
+            dur = time.perf_counter() - t0
+            tracers[r].record(
+                "exchange/allgather", "exchange", t0, dur,
+                {"tag": "sweep/hot", "rank": r},
+            )
+            with_span_t0 = time.perf_counter()
+            tracers[r].record("io/decode_chunk", "stream", with_span_t0,
+                              0.05, {"chunk": 0})
+
+        _run_ranks(run, 2)
+        out = tmp_path / "traces"
+        for t in tracers:
+            publish_trace(t, out)
+        return out
+
+    def test_merge_and_report(self, tmp_path):
+        from dev import trace_summary
+
+        out = self._fixture_dir(tmp_path)
+        files = trace_summary.find_trace_files([str(out)])
+        assert [os.path.basename(f) for f in files] == [
+            "trace-00000.json", "trace-00001.json"
+        ]
+        events = []
+        for f in files:
+            events.extend(trace_summary.load_trace_events(f))
+        report = trace_summary.format_report(events, top=5)
+        assert "sweep/hot" in report
+        assert "rank 1 (least_wait)" in report
+        assert "io/decode_chunk" in report
+        assert "self-time" in report
+
+    def test_self_time_excludes_nested_children(self):
+        from dev import trace_summary
+
+        events = [
+            {"name": "outer", "cat": "t", "ph": "X", "ts": 0.0,
+             "dur": 100.0, "end": 100.0, "pid": 0, "tid": 0, "args": {}},
+            {"name": "inner", "cat": "t", "ph": "X", "ts": 10.0,
+             "dur": 80.0, "end": 90.0, "pid": 0, "tid": 0, "args": {}},
+        ]
+        stats = trace_summary.self_times(events)
+        assert stats["outer"]["total_s"] == pytest.approx(1e-4)
+        assert stats["outer"]["self_s"] == pytest.approx(2e-5)
+        assert stats["inner"]["self_s"] == pytest.approx(8e-5)
+
+    def test_main_prints_report(self, tmp_path, capsys):
+        from dev import trace_summary
+
+        out = self._fixture_dir(tmp_path)
+        assert trace_summary.main([str(out)]) == 0
+        printed = capsys.readouterr().out
+        assert "straggler" in printed
+        assert "sweep/hot" in printed
+
+
+# ---------------------------------------------------------------------------
+# driver wiring: --trace-dir on success AND failure paths
+# ---------------------------------------------------------------------------
+
+
+class TestDriverTraceDir:
+    def _libsvm(self, tmp_path, n=60, d=4, seed=0):
+        rng = np.random.default_rng(seed)
+        w = rng.normal(size=d)
+        lines = []
+        for _ in range(n):
+            x = rng.normal(size=d)
+            y = f"{float(x @ w) + 0.1 * rng.normal():.5f}"
+            lines.append(
+                y + " " + " ".join(f"{j+1}:{x[j]:.5f}" for j in range(d))
+            )
+        p = tmp_path / "d.libsvm"
+        p.write_text("\n".join(lines))
+        return p
+
+    def test_glm_driver_success_publishes_trace_and_journals_report(
+        self, tmp_path
+    ):
+        from photon_ml_tpu.cli import glm_driver
+        from photon_ml_tpu.telemetry import RunJournal
+
+        data = self._libsvm(tmp_path)
+        glm_driver.main([
+            "--input-data-path", str(data),
+            "--output-dir", str(tmp_path / "out"),
+            "--task-type", "LINEAR_REGRESSION",
+            "--regularization-weights", "0.1",
+            "--input-format", "libsvm",
+            "--max-iterations", "5",
+            "--telemetry-dir", str(tmp_path / "tele"),
+            "--trace-dir", str(tmp_path / "traces"),
+        ])
+        assert current_tracer() is None  # uninstalled after the run
+        doc = json.load(open(tmp_path / "traces" / "trace-00000.json"))
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+        rows = RunJournal.read(tmp_path / "tele" / "run-journal.jsonl")
+        straggler = [r for r in rows if r["kind"] == "straggler_report"]
+        assert len(straggler) == 1
+        # every journal row carries the monotonic elapsed_ms (ISSUE 9
+        # satellite) and it is nondecreasing
+        elapsed = [r["elapsed_ms"] for r in rows]
+        assert all(isinstance(e, (int, float)) for e in elapsed)
+        assert elapsed == sorted(elapsed)
+
+    def test_glm_driver_failure_still_publishes_trace(self, tmp_path):
+        from photon_ml_tpu.cli import glm_driver
+
+        with pytest.raises(Exception):
+            glm_driver.main([
+                "--input-data-path", str(tmp_path / "nope"),
+                "--output-dir", str(tmp_path / "out"),
+                "--task-type", "LINEAR_REGRESSION",
+                "--input-format", "libsvm",
+                "--trace-dir", str(tmp_path / "traces"),
+            ])
+        assert current_tracer() is None
+        doc = json.load(open(tmp_path / "traces" / "trace-00000.json"))
+        assert "traceEvents" in doc
+
+    def test_scoring_driver_failure_still_publishes_trace(self, tmp_path):
+        from photon_ml_tpu.cli import game_scoring_driver
+
+        with pytest.raises(Exception):
+            game_scoring_driver.run(
+                input_data_path=str(tmp_path / "nope"),
+                model_input_dir=str(tmp_path / "nomodel"),
+                output_dir=str(tmp_path / "out"),
+                trace_dir=str(tmp_path / "traces"),
+            )
+        assert current_tracer() is None
+        doc = json.load(open(tmp_path / "traces" / "trace-00000.json"))
+        assert "traceEvents" in doc
