@@ -71,12 +71,17 @@ Eleven checks, all pure-AST (no jax import; runs in milliseconds):
    rejections that remain must never strand an operator without naming
    the composing alternative or the flag to change.
 
-9. **Nested jit in streaming modules** — every chunk-consuming jit in
-   io/stream_reader.py + algorithm/streaming.py must live at module scope
-   with the chunk batch in its ARGUMENT list: a jit built inside a
+9. **Nested jit in streaming/serving modules** — every chunk-consuming jit
+   in io/stream_reader.py + algorithm/streaming.py must live at module
+   scope with the chunk batch in its ARGUMENT list: a jit built inside a
    function can close over chunk-sized arrays, which serialize as
    CONSTANTS into the remote-compile request and blow the tunnel's HTTP
-   limit at ~250 MB (the measured 413 landmine).
+   limit at ~250 MB (the measured 413 landmine). The serving package
+   (``photon_ml_tpu/serving/``) is under the same ban: closing a jit over
+   the resident model's device arrays is exactly the same landmine —
+   params must enter the program as ARGUMENTS (pre-placed, donated
+   buffers), and the one construction site that does so is reviewed
+   explicitly (JIT_CLOSURE_ALLOWED).
 
 10. **Ungated checkpoint writes in training loops** — every
    ``TrainingCheckpointer``/``SolverCheckpointer`` write site in
@@ -321,6 +326,13 @@ BROAD_EXCEPT_ALLOWED = {
     (f"{PACKAGE}/util/timed.py", "__enter__"),
     (f"{PACKAGE}/util/events.py", "send"),
     (f"{PACKAGE}/cli/game_training_driver.py", "validate"),
+    # the serving micro-batch loop: a batch-level scoring failure routes
+    # through classify_exception and falls back to per-request isolation
+    # (_isolate), where each request's own failure is classified and
+    # forwarded TYPED to that request's future — one poisoned request
+    # fails attributed, the loop keeps serving (the chaos-suite contract)
+    (f"{PACKAGE}/serving/batching.py", "_flush"),
+    (f"{PACKAGE}/serving/batching.py", "_isolate"),
 }
 
 _BROAD_NAMES = {"Exception", "BaseException"}
@@ -536,6 +548,20 @@ STREAMING_MODULES = (
     f"{PACKAGE}/algorithm/streaming.py",
 )
 
+#: serving modules join the ban (whole package): the operand at risk is
+#: the resident MODEL's device arrays instead of a chunk, same 413 physics
+SERVING_MODULE_PREFIX = f"{PACKAGE}/serving/"
+
+#: (file, dotted class-qualified scope) pairs whose jit CONSTRUCTION is
+#: reviewed: the resident scorer builds its donated-buffer program once at
+#: startup, and BOTH operands — micro-batch data and pre-placed model
+#: params — enter it as ARGUMENTS (nothing request- or model-sized is
+#: closed over; see the site's comment). Class-qualified so another jit in
+#: the same file stays banned.
+JIT_CLOSURE_ALLOWED = {
+    (f"{PACKAGE}/serving/resident.py", "ResidentScorer.__init__"),
+}
+
 
 def _jit_references(node: ast.AST):
     for n in ast.walk(node):
@@ -547,10 +573,14 @@ def _jit_references(node: ast.AST):
 
 def check_streaming_jit_closures(root: pathlib.Path) -> list[str]:
     problems = []
-    for rel in STREAMING_MODULES:
-        path = root / rel
+    paths = [root / rel for rel in STREAMING_MODULES]
+    serving_dir = root / SERVING_MODULE_PREFIX
+    if serving_dir.is_dir():
+        paths.extend(sorted(serving_dir.rglob("*.py")))
+    for path in paths:
         if not path.exists():
             continue
+        rel = path.relative_to(root).as_posix()
         tree = ast.parse(path.read_text())
         for stmt in tree.body:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -575,22 +605,52 @@ def check_streaming_jit_closures(root: pathlib.Path) -> list[str]:
                         "chunk must ride the jit's argument list, never a "
                         "closure (the HTTP-413 landmine; lint check 9)"
                     )
-                scopes = stmt.body
-            elif isinstance(stmt, ast.ClassDef):
-                scopes = [stmt]
-            else:
-                scopes = [stmt]
-            for scope in scopes:
-                for n in _jit_references(scope):
-                    problems.append(
-                        f"{rel}:{n.lineno}: jit nested inside a "
-                        "function/class in a streaming module — a jit "
-                        "built per call can close over chunk-sized arrays, "
-                        "which serialize as constants into the "
-                        "remote-compile request (HTTP 413 past ~250 MB); "
-                        "define the jitted step at module scope and pass "
-                        "the chunk as an argument (lint check 9)"
-                    )
+        problems.extend(_nested_jit_hits(rel, tree))
+    return problems
+
+
+def _nested_jit_hits(rel: str, tree: ast.AST) -> list[str]:
+    """jit references outside the sanctioned module-scope-decorator form,
+    minus the reviewed JIT_CLOSURE_ALLOWED construction sites (tracked by
+    dotted class-qualified scope name)."""
+    problems: list[str] = []
+
+    def flag(node) -> None:
+        problems.append(
+            f"{rel}:{node.lineno}: jit nested inside a function/class in "
+            "a streaming/serving module — a jit built per call can close "
+            "over chunk- or model-sized arrays, which serialize as "
+            "constants into the remote-compile request (HTTP 413 past "
+            "~250 MB); define the jitted step at module scope (or a "
+            "reviewed JIT_CLOSURE_ALLOWED site) and pass the operands as "
+            "arguments (lint check 9)"
+        )
+
+    def scan(node, stack: "tuple[str, ...]") -> None:
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            inner = stack + (node.name,)
+            for child in ast.iter_child_nodes(node):
+                scan(child, inner)
+            return
+        is_jit = (
+            isinstance(node, ast.Attribute) and node.attr == "jit"
+        ) or (isinstance(node, ast.Name) and node.id == "jit")
+        if is_jit and not (
+            stack and (rel, ".".join(stack)) in JIT_CLOSURE_ALLOWED
+        ):
+            flag(node)
+        for child in ast.iter_child_nodes(node):
+            scan(child, stack)
+
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # decorators are judged by the module-scope 'batch' rule above
+            for child in stmt.body:
+                scan(child, (stmt.name,))
+        else:
+            scan(stmt, ())
     return problems
 
 

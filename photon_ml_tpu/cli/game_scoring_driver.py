@@ -34,7 +34,7 @@ logger = logging.getLogger(__name__)
 
 def run(
     *,
-    input_data_path: str,
+    input_data_path: "str | Sequence[str]",
     model_input_dir: str,
     output_dir: str,
     feature_shards: dict | None = None,
@@ -52,6 +52,13 @@ def run(
     trace_dir: str | None = None,
 ) -> dict:
     """Score ``input_data_path`` with the model at ``model_input_dir``.
+
+    input_data_path: one dataset path, or a sequence of paths scored in
+    one run — the model Avro is parsed and its device placement built
+    ONCE (the separable-placement API: ``DistributedScorer.
+    params_for_layouts`` caches the placed model across datasets), each
+    dataset writing under ``output_dir/dataset-NNNN``. A single path keeps
+    the historical single-dataset output layout exactly.
 
     on_corrupt: "raise" (strict, default) or "quarantine" — skip-and-count
     corrupt Avro container blocks during ingestion (io/avro.py); spans and
@@ -177,36 +184,18 @@ def run(
             journal.close()
 
 
-def _run_inner(
+def _load_scoring_model(
     *,
-    input_data_path: str,
     model_input_dir: str,
-    output_dir: str,
-    feature_shards: dict | None,
     index_maps_dir: str | None,
-    evaluators: Sequence[str],
-    model_id: str,
-    input_format: str,
+    feature_shards: dict | None,
     compact_random_effect_threshold: int,
-    distributed: bool,
-    mesh_shape: dict | None,
-    fe_feature_sharded: bool,
-    partitioned: bool,
-    on_corrupt: str,
-) -> dict:
-    import jax
-    if partitioned and evaluators:
-        raise ValueError(
-            "--partitioned-io does not support --evaluators yet; evaluate "
-            "through the non-partitioned scoring path"
-        )
-    from photon_ml_tpu.parallel.multihost import default_exchange
-
-    exchange = default_exchange() if partitioned else None
-    if not partitioned or jax.process_index() == 0:
-        os.makedirs(output_dir, exist_ok=True)
-    if exchange is not None:
-        exchange.barrier("scoring/output_dir")
+):
+    """Parse the model Avro + index maps ONCE: (model, index_maps,
+    feature_shards, entity_vocabs, re_columns). Hoisted out of the
+    per-dataset scoring loop (and reused by cli/serve_driver.py) so a run
+    that scores several datasets — or serves requests — never re-parses
+    the model."""
     if index_maps_dir is None:
         candidate = os.path.join(os.path.dirname(model_input_dir.rstrip("/")), "index-maps")
         index_maps_dir = candidate if os.path.isdir(candidate) else None
@@ -268,6 +257,53 @@ def _run_inner(
             set_vocab(m.row_effect_type, m.row_keys)
             set_vocab(m.col_effect_type, m.col_keys)
     re_columns = tuple(sorted(entity_vocabs))
+    return model, index_maps, feature_shards, entity_vocabs, re_columns
+
+
+def _run_inner(
+    *,
+    input_data_path: "str | Sequence[str]",
+    model_input_dir: str,
+    output_dir: str,
+    feature_shards: dict | None,
+    index_maps_dir: str | None,
+    evaluators: Sequence[str],
+    model_id: str,
+    input_format: str,
+    compact_random_effect_threshold: int,
+    distributed: bool,
+    mesh_shape: dict | None,
+    fe_feature_sharded: bool,
+    partitioned: bool,
+    on_corrupt: str,
+) -> dict:
+    import jax
+    if partitioned and evaluators:
+        raise ValueError(
+            "--partitioned-io does not support --evaluators yet; evaluate "
+            "through the non-partitioned scoring path"
+        )
+    from photon_ml_tpu.parallel.multihost import default_exchange
+
+    paths = (
+        [input_data_path] if isinstance(input_data_path, (str, os.PathLike))
+        else list(input_data_path)
+    )
+    if not paths:
+        raise ValueError("input_data_path names no datasets")
+    exchange = default_exchange() if partitioned else None
+    if not partitioned or jax.process_index() == 0:
+        os.makedirs(output_dir, exist_ok=True)
+    if exchange is not None:
+        exchange.barrier("scoring/output_dir")
+    model, index_maps, feature_shards, entity_vocabs, re_columns = (
+        _load_scoring_model(
+            model_input_dir=model_input_dir,
+            index_maps_dir=index_maps_dir,
+            feature_shards=feature_shards,
+            compact_random_effect_threshold=compact_random_effect_threshold,
+        )
+    )
 
     mesh = None
     if distributed or mesh_shape:
@@ -292,118 +328,166 @@ def _run_inner(
             )
         pad_multiple = data_axis // exchange.num_ranks
 
-    with Timed("read scoring data"):
-        from photon_ml_tpu.resilience import default_io_policy
-
-        def _read():
-            return read_partitioned(
-                input_data_path,
-                feature_shards,
-                exchange=exchange,
-                index_maps=index_maps or None,
-                random_effect_id_columns=re_columns,
-                evaluation_id_columns=evaluation_id_columns(evaluators),
-                entity_vocabs=entity_vocabs,
-                fmt=input_format,
-                pad_multiple=pad_multiple,
-                on_corrupt=on_corrupt,
-            )
-
-        # transient-I/O retry only on the non-collective path: retrying one
-        # rank of an exchange-coordinated read would desynchronize the SPMD
-        # exchange sequence (the collective path has deadlines instead)
-        part = (
-            _read() if exchange is not None
-            else default_io_policy().call(_read, description="read scoring data")
+    # the model half of the scoring work is built ONCE and reused across
+    # the per-dataset loop: the transformer keeps its DistributedScorer,
+    # whose placed params are cached per layout (params_for_layouts) — a
+    # multi-dataset run pays model parse + device placement exactly once
+    transformer = GameTransformer(
+        model=model, evaluator_specs=tuple(evaluators),
+        mesh=mesh, fe_feature_sharded=fe_feature_sharded,
+    )
+    part_scorer = None
+    summaries: list[dict] = []
+    for di, path in enumerate(paths):
+        ds_output = (
+            output_dir if len(paths) == 1
+            else os.path.join(output_dir, f"dataset-{di:04d}")
         )
-        data = part.result
-    partition = part.partition
+        if ds_output != output_dir:
+            if not partitioned or jax.process_index() == 0:
+                os.makedirs(ds_output, exist_ok=True)
+            if exchange is not None:
+                exchange.barrier(f"scoring/output_dir/{di}")
 
-    if partition.num_ranks > 1:
-        # partitioned scoring: the [n] score vector stays mesh-sharded end
-        # to end; each rank device-gets only its rows and writes its own
-        # part file — no process_allgather funnel, no rank-0 encode of the
-        # full output (ScoreProcessingUtils.scala per-partition layout)
-        from photon_ml_tpu.io.score_writer import ShardedScoreWriter
-        from photon_ml_tpu.parallel.scoring import DistributedScorer
+        with Timed("read scoring data"):
+            from photon_ml_tpu.resilience import default_io_policy
 
-        with Timed("score"):
-            scorer = DistributedScorer(
-                model, mesh, fe_feature_sharded=fe_feature_sharded
+            def _read(_path=path):
+                return read_partitioned(
+                    _path,
+                    feature_shards,
+                    exchange=exchange,
+                    index_maps=index_maps or None,
+                    random_effect_id_columns=re_columns,
+                    evaluation_id_columns=evaluation_id_columns(evaluators),
+                    entity_vocabs=entity_vocabs,
+                    fmt=input_format,
+                    pad_multiple=pad_multiple,
+                    on_corrupt=on_corrupt,
+                )
+
+            # transient-I/O retry only on the non-collective path: retrying
+            # one rank of an exchange-coordinated read would desynchronize
+            # the SPMD exchange sequence (the collective path has deadlines
+            # instead)
+            part = (
+                _read() if exchange is not None
+                else default_io_policy().call(
+                    _read, description="read scoring data"
+                )
             )
-            local_scores = scorer.score_partitioned(
-                {partition.rank: data.dataset}, partition,
-                exchange=exchange,
-            )[partition.rank]
-        n_local = partition.local_n
-        with Timed("save scores"):
-            ShardedScoreWriter(
-                os.path.join(output_dir, "scores"), exchange=exchange
-            ).write(
-                local_scores,
-                model_id=model_id,
-                uids=np.asarray(data.dataset.unique_ids)[:n_local],
-                labels=np.asarray(data.dataset.host_array("labels"))[:n_local],
-                weights=np.asarray(data.dataset.host_array("weights"))[:n_local],
-            )
-        summary = {
-            "num_scored": partition.total_true_rows,
-            "num_scored_local": n_local,
-            "bytes_decoded_local": part.bytes_decoded,
-            "input_bytes_total": part.input_bytes_total,
-            "evaluations": {},
-        }
+            data = part.result
+        partition = part.partition
+
+        if partition.num_ranks > 1:
+            # partitioned scoring: the [n] score vector stays mesh-sharded
+            # end to end; each rank device-gets only its rows and writes
+            # its own part file — no process_allgather funnel, no rank-0
+            # encode of the full output (ScoreProcessingUtils.scala
+            # per-partition layout)
+            from photon_ml_tpu.io.score_writer import ShardedScoreWriter
+            from photon_ml_tpu.parallel.scoring import DistributedScorer
+
+            with Timed("score"):
+                if part_scorer is None:
+                    part_scorer = DistributedScorer(
+                        model, mesh, fe_feature_sharded=fe_feature_sharded
+                    )
+                local_scores = part_scorer.score_partitioned(
+                    {partition.rank: data.dataset}, partition,
+                    exchange=exchange,
+                )[partition.rank]
+            n_local = partition.local_n
+            with Timed("save scores"):
+                ShardedScoreWriter(
+                    os.path.join(ds_output, "scores"), exchange=exchange
+                ).write(
+                    local_scores,
+                    model_id=model_id,
+                    uids=np.asarray(data.dataset.unique_ids)[:n_local],
+                    labels=np.asarray(
+                        data.dataset.host_array("labels")
+                    )[:n_local],
+                    weights=np.asarray(
+                        data.dataset.host_array("weights")
+                    )[:n_local],
+                )
+            summary = {
+                "num_scored": partition.total_true_rows,
+                "num_scored_local": n_local,
+                "bytes_decoded_local": part.bytes_decoded,
+                "input_bytes_total": part.input_bytes_total,
+                "evaluations": {},
+            }
+        else:
+            with Timed("score"):
+                from photon_ml_tpu.resilience import default_dispatch_policy
+
+                # the remote-compile/dispatch boundary: retry classified-
+                # transient tunnel failures, single-process only (a multi-
+                # process transform joins cross-process collectives — one
+                # rank retrying desyncs them)
+                if jax.process_count() == 1:
+                    scored = default_dispatch_policy().call(
+                        transformer.transform, data.dataset,
+                        description="score",
+                    )
+                else:
+                    scored = transformer.transform(data.dataset)
+
+            summary = {
+                "num_scored": int(len(scored.scores)),
+                "evaluations": scored.evaluations,
+            }
+            # multi-process rule: every rank participated in the scoring
+            # collectives above (DistributedScorer gathers across
+            # processes); only rank 0 touches the shared output directory
+            if jax.process_index() == 0:
+                with Timed("save scores"):
+                    write_scores(
+                        os.path.join(ds_output, "scores"),
+                        scored.scores,
+                        records_per_file=1 << 20,
+                        model_id=model_id,
+                        uids=scored.unique_ids,
+                        labels=np.asarray(data.dataset.host_array("labels")),
+                        weights=np.asarray(
+                            data.dataset.host_array("weights")
+                        ),
+                    )
+        if len(paths) > 1:
+            summary = dict(summary, input_data_path=str(path))
         if jax.process_index() == 0:
             with open(
-                os.path.join(output_dir, "scoring-summary.json"), "w"
+                os.path.join(ds_output, "scoring-summary.json"), "w"
             ) as f:
                 from photon_ml_tpu.cli.game_training_driver import _json_safe
 
                 json.dump(_json_safe(summary), f, indent=2, default=float)
-        return summary
+        summaries.append(summary)
 
-    with Timed("score"):
-        from photon_ml_tpu.resilience import default_dispatch_policy
-
-        transformer = GameTransformer(
-            model=model, evaluator_specs=tuple(evaluators),
-            mesh=mesh, fe_feature_sharded=fe_feature_sharded,
-        )
-        # the remote-compile/dispatch boundary: retry classified-transient
-        # tunnel failures, single-process only (a multi-process transform
-        # joins cross-process collectives — one rank retrying desyncs them)
-        if jax.process_count() == 1:
-            scored = default_dispatch_policy().call(
-                transformer.transform, data.dataset, description="score"
-            )
-        else:
-            scored = transformer.transform(data.dataset)
-
-    summary = {"num_scored": int(len(scored.scores)), "evaluations": scored.evaluations}
-    # multi-process rule: every rank participated in the scoring collectives
-    # above (DistributedScorer gathers across processes); only rank 0
-    # touches the shared output directory
+    if len(paths) == 1:
+        return summaries[0]
+    combined = {
+        "num_scored": int(sum(s["num_scored"] for s in summaries)),
+        "num_datasets": len(summaries),
+        "datasets": summaries,
+    }
     if jax.process_index() == 0:
-        with Timed("save scores"):
-            write_scores(
-                os.path.join(output_dir, "scores"),
-                scored.scores,
-                records_per_file=1 << 20,
-                model_id=model_id,
-                uids=scored.unique_ids,
-                labels=np.asarray(data.dataset.host_array("labels")),
-                weights=np.asarray(data.dataset.host_array("weights")),
-            )
         with open(os.path.join(output_dir, "scoring-summary.json"), "w") as f:
             from photon_ml_tpu.cli.game_training_driver import _json_safe
 
-            json.dump(_json_safe(summary), f, indent=2, default=float)
-    return summary
+            json.dump(_json_safe(combined), f, indent=2, default=float)
+    return combined
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="game_scoring_driver")
-    p.add_argument("--input-data-path", required=True)
+    p.add_argument("--input-data-path", required=True, action="append",
+                   help="dataset to score; repeat to score several datasets "
+                        "in one run (the model is parsed and placed ONCE; "
+                        "each dataset writes under "
+                        "<output-dir>/dataset-NNNN)")
     p.add_argument("--model-input-dir", required=True)
     p.add_argument("--output-dir", required=True)
     p.add_argument("--feature-shard-configurations", action="append", default=None)
@@ -453,8 +537,9 @@ def main(argv: Sequence[str] | None = None) -> dict:
         shards = dict(
             parse_feature_shard_config(s) for s in args.feature_shard_configurations
         )
+    paths = args.input_data_path
     return run(
-        input_data_path=args.input_data_path,
+        input_data_path=paths[0] if len(paths) == 1 else paths,
         model_input_dir=args.model_input_dir,
         output_dir=args.output_dir,
         feature_shards=shards,

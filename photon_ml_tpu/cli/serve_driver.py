@@ -1,0 +1,364 @@
+"""Serve driver: offline replay harness for the resident scoring service.
+
+Reference parity: photon-client cli/game/scoring/GameScoringDriver.scala —
+the reference's scoring entry point is a batch job; this driver is the
+ONLINE half the ROADMAP's heavy-traffic north star needs, exercised
+offline: it loads a GAME model ONCE into a resident scorer
+(serving/resident.py), replays an Avro file of scoring records as a stream
+of small requests through the micro-batching loop (serving/batching.py),
+and reports the latency-SLO evidence — scores/sec, p50/p95 request
+latency, pad fraction, compiled-signature count — against an embedded
+SAME-RUN one-request-per-dispatch baseline (the calibration discipline:
+never compare across runs on the chip-lottery pool).
+
+The replay is deliberately closed-loop (submit as fast as the bounded
+queue admits): it measures the service's steady-state ceiling, not an
+arrival process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+from typing import Sequence
+
+from photon_ml_tpu.cli.configs import parse_feature_shard_config
+from photon_ml_tpu.io.model_io import DEFAULT_COMPACT_RE_THRESHOLD
+from photon_ml_tpu.io.partitioned_reader import read_partitioned
+from photon_ml_tpu.util import Timed
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_SHAPES = "64,256,1024"
+
+
+def _parse_shapes(spec: str) -> tuple[int, ...]:
+    try:
+        shapes = tuple(int(s) for s in spec.split(",") if s.strip())
+    except ValueError:
+        raise ValueError(f"bad --microbatch-shapes {spec!r}") from None
+    if not shapes:
+        raise ValueError("--microbatch-shapes names no shapes")
+    return shapes
+
+
+def run(
+    *,
+    requests_avro: str,
+    model_input_dir: str,
+    output_dir: str,
+    feature_shards: dict | None = None,
+    index_maps_dir: str | None = None,
+    input_format: str = "avro",
+    compact_random_effect_threshold: int = DEFAULT_COMPACT_RE_THRESHOLD,
+    microbatch_shapes: "tuple[int, ...] | str" = DEFAULT_SHAPES,
+    max_wait_ms: float = 2.0,
+    queue_depth: int = 1024,
+    request_rows: int = 1,
+    num_requests: int | None = None,
+    bf16: bool = False,
+    skip_unbatched_baseline: bool = False,
+    telemetry_dir: str | None = None,
+    trace_dir: str | None = None,
+) -> dict:
+    """Replay ``requests_avro`` as ``request_rows``-row requests through
+    the resident micro-batch scorer; writes ``serving-summary.json`` under
+    ``output_dir``.
+
+    microbatch_shapes: the bucket set (power-of-two row counts) — the
+    bound on compiled program signatures. max_wait_ms/queue_depth: the SLO
+    knobs of the micro-batching loop. bf16: opt-in whole-path bf16
+    features (not bitwise). skip_unbatched_baseline: drop the embedded
+    one-request-per-dispatch comparison (it costs one dispatch per
+    request — slow over a ~100 ms tunnel when the replay is long).
+
+    telemetry_dir: rank-0 JSONL run journal (serve/* counters + latency
+    histogram + phase timings) — written on the FAILURE path too.
+    trace_dir: per-rank Chrome-trace span timelines; ``serve/`` spans
+    observe the batching loop and dispatches, never gate them.
+    """
+    from photon_ml_tpu.telemetry import RunJournal
+    from photon_ml_tpu.telemetry.resilience_counters import (
+        reset_resilience_metrics,
+    )
+    from photon_ml_tpu.telemetry.serving_counters import reset_serving_metrics
+    from photon_ml_tpu.util.timed import reset_timings, timing_summary
+
+    reset_timings()
+    reset_resilience_metrics()
+    reset_serving_metrics()
+    journal = RunJournal(telemetry_dir) if telemetry_dir else None
+    tracer = None
+    if trace_dir:
+        from photon_ml_tpu.telemetry.tracing import Tracer, install_tracer
+
+        tracer = install_tracer(Tracer())
+    succeeded = False
+    try:
+        summary = _run_inner(
+            requests_avro=requests_avro,
+            model_input_dir=model_input_dir,
+            output_dir=output_dir,
+            feature_shards=feature_shards,
+            index_maps_dir=index_maps_dir,
+            input_format=input_format,
+            compact_random_effect_threshold=compact_random_effect_threshold,
+            microbatch_shapes=microbatch_shapes,
+            max_wait_ms=max_wait_ms,
+            queue_depth=queue_depth,
+            request_rows=request_rows,
+            num_requests=num_requests,
+            bf16=bf16,
+            skip_unbatched_baseline=skip_unbatched_baseline,
+        )
+        succeeded = True
+        if journal is not None:
+            journal.record("serving_summary", **summary)
+        return summary
+    finally:
+        if tracer is not None:
+            from photon_ml_tpu.telemetry.tracing import (
+                flush_trace_best_effort,
+                uninstall_tracer,
+            )
+
+            try:
+                # best-effort: a publication error never masks the run's
+                # own outcome or skips the journal rows below; the serve
+                # driver is single-process, so no straggler merge
+                flush_trace_best_effort(
+                    tracer, trace_dir, exchange=None, gather=False,
+                    journal=journal,
+                )
+            finally:
+                uninstall_tracer()
+        # failure-path journaling: the serve/* counters and the latency
+        # histogram up to the failure are the post-mortem evidence
+        if journal is not None:
+            from photon_ml_tpu.telemetry import default_registry
+
+            journal.record_timings(timing_summary())
+            journal.record_metrics(default_registry().snapshot())
+            journal.close()
+
+
+def _run_inner(
+    *,
+    requests_avro: str,
+    model_input_dir: str,
+    output_dir: str,
+    feature_shards: dict | None,
+    index_maps_dir: str | None,
+    input_format: str,
+    compact_random_effect_threshold: int,
+    microbatch_shapes,
+    max_wait_ms: float,
+    queue_depth: int,
+    request_rows: int,
+    num_requests: int | None,
+    bf16: bool,
+    skip_unbatched_baseline: bool,
+) -> dict:
+    import jax
+
+    from photon_ml_tpu.cli.game_scoring_driver import _load_scoring_model
+    from photon_ml_tpu.data.game_data import slice_game_dataset
+    from photon_ml_tpu.serving import MicroBatchServer, ResidentScorer
+    from photon_ml_tpu.telemetry import serving_counters
+    from photon_ml_tpu.telemetry.probes import CompileMonitor
+
+    if jax.process_count() > 1:
+        raise ValueError(
+            "serve_driver is single-process (one resident service per "
+            "host); use game_scoring_driver --partitioned-io for "
+            "multi-process batch scoring"
+        )
+    if request_rows <= 0:
+        raise ValueError(f"request_rows must be positive, got {request_rows}")
+    shapes = (
+        _parse_shapes(microbatch_shapes)
+        if isinstance(microbatch_shapes, str) else tuple(microbatch_shapes)
+    )
+    os.makedirs(output_dir, exist_ok=True)
+
+    with Timed("load model"):
+        model, index_maps, feature_shards, entity_vocabs, re_columns = (
+            _load_scoring_model(
+                model_input_dir=model_input_dir,
+                index_maps_dir=index_maps_dir,
+                feature_shards=feature_shards,
+                compact_random_effect_threshold=(
+                    compact_random_effect_threshold
+                ),
+            )
+        )
+
+    with Timed("read replay data"):
+        from photon_ml_tpu.resilience import default_io_policy
+
+        part = default_io_policy().call(
+            lambda: read_partitioned(
+                requests_avro,
+                feature_shards,
+                index_maps=index_maps or None,
+                random_effect_id_columns=re_columns,
+                entity_vocabs=entity_vocabs,
+                fmt=input_format,
+            ),
+            description="read replay data",
+        )
+        dataset = part.result.dataset
+
+    n = dataset.num_samples
+    with Timed("slice requests"):
+        requests = [
+            slice_game_dataset(dataset, lo, min(lo + request_rows, n))
+            for lo in range(0, n, request_rows)
+        ]
+        if num_requests is not None:
+            requests = requests[:num_requests]
+    total_rows = sum(r.num_samples for r in requests)
+    logger.info(
+        "replaying %d requests (%d rows) through shapes %s",
+        len(requests), total_rows, shapes,
+    )
+
+    scorer = ResidentScorer(model, shapes=shapes, bf16=bf16)
+    with Timed("warm compile"), CompileMonitor() as warm_compiles:
+        scorer.warm(requests[0])
+
+    unbatched_rate = None
+    if not skip_unbatched_baseline:
+        with Timed("unbatched baseline"):
+            # the same-run baseline: one request per dispatch, no queue —
+            # what a naive online scorer would do; its rate rides the
+            # summary so the batched number is judged against THIS run's
+            # chip and tunnel only
+            t0 = time.perf_counter()
+            for r in requests:
+                scorer.score(r)
+            unbatched_rate = total_rows / max(
+                time.perf_counter() - t0, 1e-9
+            )
+        # the baseline's latencies/counters are not the service's: reset
+        # so the journaled histogram is the batched replay's alone
+        from photon_ml_tpu.telemetry.serving_counters import (
+            reset_serving_metrics,
+        )
+
+        reset_serving_metrics()
+
+    with Timed("batched replay"), CompileMonitor() as replay_compiles:
+        server = MicroBatchServer(
+            scorer,
+            max_wait_ms=max_wait_ms,
+            queue_depth=queue_depth,
+        )
+        t0 = time.perf_counter()
+        with server:
+            futures = [server.submit(r) for r in requests]
+            for f in futures:
+                f.result()
+        batched_sec = time.perf_counter() - t0
+    batched_rate = total_rows / max(batched_sec, 1e-9)
+
+    latency = serving_counters.latency_summary()
+    summary = {
+        "num_requests": len(requests),
+        "num_rows": total_rows,
+        "request_rows": request_rows,
+        "microbatch_shapes": list(shapes),
+        "max_wait_ms": max_wait_ms,
+        "bf16": bf16,
+        "scores_per_sec": batched_rate,
+        "scores_per_sec_unbatched": unbatched_rate,
+        "latency_ms_p50": latency["p50"],
+        "latency_ms_p95": latency["p95"],
+        "pad_fraction": serving_counters.pad_fraction(),
+        "compiled_signatures": len(scorer.signatures),
+        "warm_compiles": warm_compiles.count,
+        "replay_compiles": replay_compiles.count,
+    }
+    with open(os.path.join(output_dir, "serving-summary.json"), "w") as f:
+        from photon_ml_tpu.cli.game_training_driver import _json_safe
+
+        json.dump(_json_safe(summary), f, indent=2, default=float)
+    return summary
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="serve_driver")
+    p.add_argument("--requests-avro", required=True,
+                   help="Avro scoring records replayed as requests")
+    p.add_argument("--model-input-dir", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--feature-shard-configurations", action="append",
+                   default=None)
+    p.add_argument("--index-maps-dir")
+    p.add_argument("--input-format", default="avro",
+                   choices=["avro", "libsvm"])
+    p.add_argument("--compact-random-effect-threshold", type=int,
+                   default=DEFAULT_COMPACT_RE_THRESHOLD)
+    p.add_argument("--microbatch-shapes", default=DEFAULT_SHAPES,
+                   help="comma-separated power-of-two micro-batch row "
+                        "buckets — the bound on compiled score-program "
+                        "signatures")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="flush deadline: a request waits at most this long "
+                        "for batch company before dispatch")
+    p.add_argument("--queue-depth", type=int, default=1024,
+                   help="bounded request-queue depth (backpressure "
+                        "surfaces as a typed submit timeout)")
+    p.add_argument("--request-rows", type=int, default=1,
+                   help="rows per replayed request")
+    p.add_argument("--num-requests", type=int, default=None,
+                   help="cap the replay length (default: the whole file)")
+    p.add_argument("--bf16", action="store_true",
+                   help="whole-path bf16 features+params (not bitwise)")
+    p.add_argument("--skip-unbatched-baseline", action="store_true",
+                   help="skip the embedded one-request-per-dispatch "
+                        "baseline pass")
+    p.add_argument("--telemetry-dir",
+                   help="write a rank-0 JSONL run journal (serve/* "
+                        "counters, latency histogram, phase timings) here "
+                        "— on the failure path too")
+    p.add_argument("--trace-dir",
+                   help="write Chrome-trace span timelines here (serve/ "
+                        "spans observe the loop; open in Perfetto)")
+    return p
+
+
+def main(argv: Sequence[str] | None = None) -> dict:
+    logging.basicConfig(level=logging.INFO)
+    args = build_arg_parser().parse_args(argv)
+    shards = None
+    if args.feature_shard_configurations:
+        shards = dict(
+            parse_feature_shard_config(s)
+            for s in args.feature_shard_configurations
+        )
+    return run(
+        requests_avro=args.requests_avro,
+        model_input_dir=args.model_input_dir,
+        output_dir=args.output_dir,
+        feature_shards=shards,
+        index_maps_dir=args.index_maps_dir,
+        input_format=args.input_format,
+        compact_random_effect_threshold=args.compact_random_effect_threshold,
+        microbatch_shapes=args.microbatch_shapes,
+        max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth,
+        request_rows=args.request_rows,
+        num_requests=args.num_requests,
+        bf16=args.bf16,
+        skip_unbatched_baseline=args.skip_unbatched_baseline,
+        telemetry_dir=args.telemetry_dir,
+        trace_dir=args.trace_dir,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -215,6 +215,159 @@ def _pad_game_dataset_rows(dataset: GameDataset, pad: int) -> GameDataset:
     )
 
 
+def slice_game_dataset(dataset: GameDataset, lo: int, hi: int) -> GameDataset:
+    """Row-range view [lo, hi) of a GameDataset as a NEW dataset (host-side
+    vectorized; entity vocabs are shared, not copied). Sparse shards slice
+    their coalesced triples by a searchsorted range (they are row-major
+    sorted) with rows shifted to the slice origin. The serving layer uses
+    this to split replay data into requests and to split an over-sized
+    request across micro-batches."""
+    n = dataset.num_samples
+    if not (0 <= lo < hi <= n):
+        raise ValueError(f"slice [{lo}, {hi}) out of range for {n} samples")
+
+    def vec(name: str) -> np.ndarray:
+        return dataset.host_array(name)[lo:hi]
+
+    labels_h, offsets_h, weights_h = vec("labels"), vec("offsets"), vec("weights")
+    host_cache = {"labels": labels_h, "offsets": offsets_h,
+                  "weights": weights_h}
+    shards: dict[str, object] = {}
+    for k, v in dataset.feature_shards.items():
+        if isinstance(v, SparseShard):
+            rows, cols, vals = v.coalesced()
+            a, b = np.searchsorted(rows, [lo, hi])
+            shards[k] = dataclasses.replace(
+                v,
+                rows=(rows[a:b] - lo).astype(rows.dtype),
+                cols=np.array(cols[a:b]),
+                vals=np.array(vals[a:b]),
+                num_samples=hi - lo,
+                _device=None, _coalesced=None, _hybrid_cache=None,
+            )
+        else:
+            arr = dataset.host_array(f"shard/{k}")[lo:hi]
+            shards[k] = jnp.asarray(arr)
+            host_cache[f"shard/{k}"] = arr
+    entity_idx: dict[str, Array] = {}
+    for t in dataset.entity_idx:
+        arr = dataset.host_array(f"entity_idx/{t}")[lo:hi]
+        entity_idx[t] = jnp.asarray(arr)
+        host_cache[f"entity_idx/{t}"] = arr
+    return GameDataset(
+        unique_ids=np.asarray(dataset.unique_ids)[lo:hi],
+        labels=jnp.asarray(labels_h),
+        offsets=jnp.asarray(offsets_h),
+        weights=jnp.asarray(weights_h),
+        feature_shards=shards,
+        entity_idx=entity_idx,
+        entity_vocabs=dataset.entity_vocabs,
+        ids={k: np.asarray(v)[lo:hi] for k, v in dataset.ids.items()},
+        host_cache=host_cache,
+    )
+
+
+def concat_game_datasets(datasets: "Sequence[GameDataset]") -> GameDataset:
+    """Row-wise concatenation of GameDatasets built against the SAME
+    schema: shard ids/widths, entity types AND vocabs, and id columns must
+    agree (a vocab mismatch would silently misalign one part's entity rows,
+    so it is validated, not assumed). Sparse shards concatenate coalesced
+    triples with rows shifted into the merged sample axis — parts are
+    row-sorted and appended in order, so the result keeps the row-major
+    promise the scoring segment-sum relies on. The serving micro-batcher
+    uses this to coalesce queued requests into one device dispatch."""
+    datasets = list(datasets)
+    if not datasets:
+        raise ValueError("concat_game_datasets needs at least one dataset")
+    if len(datasets) == 1:
+        return datasets[0]
+    base = datasets[0]
+    for d in datasets[1:]:
+        for attr in ("feature_shards", "entity_idx", "ids"):
+            if set(getattr(d, attr)) != set(getattr(base, attr)):
+                raise ValueError(
+                    f"datasets disagree on {attr} keys: "
+                    f"{sorted(getattr(base, attr))} vs "
+                    f"{sorted(getattr(d, attr))}"
+                )
+        for t, vocab in base.entity_vocabs.items():
+            other = d.entity_vocabs.get(t)
+            if other is not vocab and not np.array_equal(
+                np.asarray(other), np.asarray(vocab)
+            ):
+                raise ValueError(
+                    f"datasets disagree on the '{t}' entity vocab "
+                    f"({len(np.asarray(vocab))} vs "
+                    f"{0 if other is None else len(np.asarray(other))} keys)"
+                )
+
+    def cat(name: str) -> np.ndarray:
+        return np.concatenate([d.host_array(name) for d in datasets])
+
+    labels_h, offsets_h, weights_h = cat("labels"), cat("offsets"), cat("weights")
+    host_cache = {"labels": labels_h, "offsets": offsets_h,
+                  "weights": weights_h}
+    starts = np.cumsum([0] + [d.num_samples for d in datasets])
+    n_total = int(starts[-1])
+    shards: dict[str, object] = {}
+    for k, v in base.feature_shards.items():
+        if isinstance(v, SparseShard):
+            rows_parts, cols_parts, vals_parts = [], [], []
+            for d, start in zip(datasets, starts):
+                shard = d.feature_shards[k]
+                if not isinstance(shard, SparseShard):
+                    raise ValueError(
+                        f"shard '{k}' is sparse in one dataset and dense "
+                        "in another"
+                    )
+                if shard.feature_dim != v.feature_dim:
+                    raise ValueError(
+                        f"shard '{k}' feature_dim mismatch: "
+                        f"{v.feature_dim} vs {shard.feature_dim}"
+                    )
+                r, c, vv = shard.coalesced()
+                rows_parts.append(np.asarray(r, np.int64) + int(start))
+                cols_parts.append(c)
+                vals_parts.append(vv)
+            shards[k] = dataclasses.replace(
+                v,
+                rows=np.concatenate(rows_parts),
+                cols=np.concatenate(cols_parts),
+                vals=np.concatenate(vals_parts),
+                num_samples=n_total,
+                _device=None, _coalesced=None, _hybrid_cache=None,
+            )
+        else:
+            arr = np.concatenate(
+                [d.host_array(f"shard/{k}") for d in datasets]
+            )
+            shards[k] = jnp.asarray(arr)
+            host_cache[f"shard/{k}"] = arr
+    entity_idx: dict[str, Array] = {}
+    for t in base.entity_idx:
+        arr = np.concatenate(
+            [d.host_array(f"entity_idx/{t}") for d in datasets]
+        )
+        entity_idx[t] = jnp.asarray(arr)
+        host_cache[f"entity_idx/{t}"] = arr
+    return GameDataset(
+        unique_ids=np.concatenate(
+            [np.asarray(d.unique_ids) for d in datasets]
+        ),
+        labels=jnp.asarray(labels_h),
+        offsets=jnp.asarray(offsets_h),
+        weights=jnp.asarray(weights_h),
+        feature_shards=shards,
+        entity_idx=entity_idx,
+        entity_vocabs=base.entity_vocabs,
+        ids={
+            k: np.concatenate([np.asarray(d.ids[k]) for d in datasets])
+            for k in base.ids
+        },
+        host_cache=host_cache,
+    )
+
+
 @dataclasses.dataclass
 class EntityBucket:
     """One size-bucket of random-effect training data.

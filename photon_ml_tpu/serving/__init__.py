@@ -1,0 +1,21 @@
+from photon_ml_tpu.serving.batching import (
+    MicroBatchServer,
+    RequestError,
+    ServeError,
+    ServeFuture,
+    ServeTimeout,
+)
+from photon_ml_tpu.serving.resident import (
+    DEFAULT_MICROBATCH_SHAPES,
+    ResidentScorer,
+)
+
+__all__ = [
+    "DEFAULT_MICROBATCH_SHAPES",
+    "MicroBatchServer",
+    "RequestError",
+    "ResidentScorer",
+    "ServeError",
+    "ServeFuture",
+    "ServeTimeout",
+]

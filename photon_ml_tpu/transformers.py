@@ -42,16 +42,26 @@ class GameTransformer:
     #: shard the (single, or named) FE coordinate's feature axis over the
     #: mesh "model" axis — required to score a column-sharded giant-d model
     fe_feature_sharded: "bool | str" = False
+    #: lazily-built DistributedScorer, REUSED across transform calls: its
+    #: placed model params are cached per layout (params_for_layouts), so a
+    #: multi-dataset scoring run places the model on device once.
+    #: init=False: dataclasses.replace(t, model=...) must REBUILD the cache,
+    #: never inherit a scorer bound to the old model/mesh
+    _scorer: object | None = dataclasses.field(
+        default=None, init=False, repr=False, compare=False
+    )
 
     def transform(self, dataset: GameDataset) -> ScoredDataset:
         evaluations: dict[str, float] = {}
         if self.mesh is not None or self.fe_feature_sharded:
             from photon_ml_tpu.parallel.scoring import DistributedScorer
 
-            scorer = DistributedScorer(
-                self.model, self.mesh,
-                fe_feature_sharded=self.fe_feature_sharded,
-            )
+            if self._scorer is None:
+                self._scorer = DistributedScorer(
+                    self.model, self.mesh,
+                    fe_feature_sharded=self.fe_feature_sharded,
+                )
+            scorer = self._scorer
             # one prepare/score pass; the scores gather regardless (they
             # are the product), so metrics use the exact host evaluators
             # on the gathered vector — gather-free on-mesh evaluation is

@@ -30,12 +30,13 @@ EXPECTED_METRICS = [
     "sparse_giant_fe_composed",
     "sparse_1e8_fe_tron_ms_per_iter",
     "stream_fe_chunked",
+    "serve_microbatch",
 ]
 
 
 def test_sample_report_fits_tail_capture():
     report = bench.sample_report()
-    line = json.dumps(report)
+    line = bench.render_report(report)
     assert len(line.encode()) < bench.MAX_LINE_BYTES, (
         f"{len(line.encode())} bytes; the driver tails "
         f"{bench.MAX_LINE_BYTES} — slim the unit builders in bench.py"

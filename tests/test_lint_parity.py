@@ -363,6 +363,45 @@ def test_lint_catches_streaming_jit_closures(tmp_path):
     assert not any("other.py" in p for p in problems)
 
 
+def test_lint_catches_serving_jit_closures(tmp_path):
+    """Check 9 covers photon_ml_tpu/serving/: a jit built inside a
+    serving-module function (closure risk over the resident model's device
+    arrays — the same HTTP-413 landmine as chunks) is reported; the
+    reviewed JIT_CLOSURE_ALLOWED construction site
+    (ResidentScorer.__init__, params enter as arguments) passes, and a
+    same-named method on another class does NOT inherit the exemption."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    serving = tmp_path / "photon_ml_tpu" / "serving"
+    serving.mkdir(parents=True)
+    (serving / "resident.py").write_text(
+        '"""Cites GameTransformer.scala:156."""\n'
+        "import jax\n"
+        "class ResidentScorer:\n"
+        "    def __init__(self, impl):\n"
+        "        self._program = jax.jit(impl)  # reviewed: args-only\n"
+        "class Rogue:\n"
+        "    def __init__(self, impl, model):\n"
+        "        self._program = jax.jit(lambda d: impl(d, model))\n"
+    )
+    (serving / "batching.py").write_text(
+        '"""Cites GameScoringDriver.scala:133."""\n'
+        "import jax\n"
+        "def serve(scorer, batch):\n"
+        "    return jax.jit(lambda: scorer(batch))()\n"
+    )
+    problems = lint_parity.run_lints(tmp_path)
+    assert any(
+        "resident.py:8" in p and "serving" in p for p in problems
+    ), problems
+    assert any("batching.py:4" in p for p in problems), problems
+    assert not any("resident.py:5" in p for p in problems), problems
+
+
 def test_lint_catches_ungated_checkpoint_saves(tmp_path):
     """Check 10 fires: a direct checkpointer.save()/save_progress() in a
     parallel/ or algorithm/ training-loop module is reported (multi-rank

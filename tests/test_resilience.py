@@ -1377,3 +1377,123 @@ class TestGLMDriverRecovery:
                 task_type=TaskType.LINEAR_REGRESSION,
                 checkpoint_dir=str(tmp_path / "ck"),
             ))
+
+
+class TestServingChaos:
+    """The resident serving loop under injected faults (ISSUE 10): a
+    poisoned request fails TYPED and ATTRIBUTED while the loop keeps
+    serving every healthy request, and a wedged consumer surfaces as the
+    serving layer's own bounded-deadline timeout — hang-free, because no
+    pytest-timeout exists to save these."""
+
+    def _fixture(self, n=24, seed=0, d=6):
+        from photon_ml_tpu.data.game_data import (
+            build_game_dataset,
+            slice_game_dataset,
+        )
+        from photon_ml_tpu.models.coefficients import Coefficients
+        from photon_ml_tpu.models.game import FixedEffectModel, GameModel
+        from photon_ml_tpu.models.glm import GeneralizedLinearModel
+        from photon_ml_tpu.serving import ResidentScorer
+        from photon_ml_tpu.types import TaskType
+        import jax.numpy as jnp
+
+        r = np.random.default_rng(seed)
+        ds = build_game_dataset(
+            labels=r.normal(size=n).astype(np.float32),
+            feature_shards={"g": r.normal(size=(n, d)).astype(np.float32)},
+        )
+        model = GameModel(models={
+            "fe": FixedEffectModel(
+                glm=GeneralizedLinearModel(
+                    Coefficients(
+                        means=jnp.asarray(r.normal(size=d).astype(np.float32))
+                    ),
+                    TaskType.LINEAR_REGRESSION,
+                ),
+                feature_shard_id="g",
+            ),
+        })
+        scorer = ResidentScorer(model, shapes=(16, 64))
+        requests = [slice_game_dataset(ds, lo, lo + 4)
+                    for lo in range(0, n, 4)]
+        return ds, model, scorer, requests
+
+    def test_poisoned_request_fails_attributed_loop_survives(self):
+        from photon_ml_tpu.data.game_data import build_game_dataset
+        from photon_ml_tpu.serving import MicroBatchServer, RequestError
+        from photon_ml_tpu.telemetry import serving_counters
+        from photon_ml_tpu.telemetry.registry import default_registry
+
+        ds, model, scorer, requests = self._fixture()
+        ref = {id(r): scorer.score(r) for r in requests}
+        r = np.random.default_rng(9)
+        # wrong feature width: concat rejects it, then scoring it alone
+        # fails — either way it is THIS request's failure
+        poison = build_game_dataset(
+            labels=r.normal(size=4).astype(np.float32),
+            feature_shards={"g": r.normal(size=(4, 3)).astype(np.float32)},
+        )
+        serving_counters.reset_serving_metrics()
+        with MicroBatchServer(scorer, max_wait_ms=20) as server:
+            futures = [(req, server.submit(req)) for req in requests[:3]]
+            poison_future = server.submit(poison, request_id="poisoned-req")
+            futures += [(req, server.submit(req)) for req in requests[3:]]
+            # every healthy request resolves with correct scores
+            for req, fut in futures:
+                np.testing.assert_array_equal(fut.result(20), ref[id(req)])
+            with pytest.raises(RequestError, match="poisoned-req") as ei:
+                poison_future.result(20)
+            # the loop is still serving AFTER the poison
+            after = server.submit(requests[0])
+            np.testing.assert_array_equal(
+                after.result(20), ref[id(requests[0])]
+            )
+        assert default_registry().counter(
+            serving_counters.REQUEST_FAILURES
+        ).value == 1
+        assert ei.value.__cause__ is not None
+
+    def test_wedged_consumer_times_out_typed_hang_free(self):
+        import threading
+        import time as _time
+
+        from photon_ml_tpu.serving import MicroBatchServer, ServeTimeout
+
+        _, _, scorer, requests = self._fixture()
+        release = threading.Event()
+
+        class WedgedScorer:
+            shapes = scorer.shapes
+
+            def score(self, dataset):
+                # wedge until the test releases it (bounded so a broken
+                # release path still cannot hang the suite)
+                release.wait(timeout=5.0)
+                return scorer.score(dataset)
+
+        server = MicroBatchServer(WedgedScorer(), max_wait_ms=1.0)
+        server.start()
+        try:
+            t0 = _time.perf_counter()
+            fut = server.submit(requests[0])
+            with pytest.raises(ServeTimeout, match="no result within"):
+                fut.result(0.3)
+            elapsed = _time.perf_counter() - t0
+            assert elapsed < 2.0, f"not bounded: {elapsed:.1f}s"
+        finally:
+            release.set()
+            server.stop()
+        # after release the wedged dispatch completed; the future resolved
+        # late rather than never (stop() never left it hanging)
+        assert fut.done()
+
+    def test_stopped_server_fails_stragglers_typed(self):
+        from photon_ml_tpu.serving import MicroBatchServer, ServeError
+
+        _, _, scorer, requests = self._fixture()
+        server = MicroBatchServer(scorer, max_wait_ms=1.0)
+        server.start()
+        server.stop()
+        with pytest.raises(ServeError, match="not running"):
+            server.submit(requests[0])
