@@ -94,7 +94,7 @@ def render_report(report: dict) -> str:
 def _unit_primary(lane_iters: int, grid_sec: float) -> str:
     return (
         f"ex*it/s {GRID}lam n=2^18 d={D} "
-        f"{lane_iters} ln-it {grid_sec:.1f}s/grid"
+        f"{lane_iters} ln-it {grid_sec:.0f}s/grid"
     )
 
 
@@ -102,7 +102,7 @@ def _unit_stream(n: int, d: int) -> str:
     # "sr" = same-run throughout the unit grammar
     return (
         f"sr cal mv/step n=2^{n.bit_length() - 1} "
-        f"d={d} roof {HBM_ROOFLINE_GBPS:.0f}"
+        f"d={d} roof{HBM_ROOFLINE_GBPS:.0f}"
     )
 
 
@@ -117,7 +117,7 @@ def _unit_sweep(newton: bool) -> str:
             "ms/sw REs Newt FE same"
         )
     return (
-        "ms/sw FE d256 2REs 2k/1.5k d16 n=2^17 10it"
+        "ms/sw FE d256 2REs 2k/1.5k d16 10it"
     )
 
 
@@ -154,8 +154,19 @@ def _unit_sparse_hybrid(nnz: int, ell_ms: float, cov: float, k_hot: int) -> str:
 
 def _unit_sparse_1e8(nnz: int, entry_iters_m: float) -> str:
     return (
-        f"ms/TRON-it 2CG d=1e8 hyb zipf hot512 {nnz / 1e6:.0f}M "
+        f"ms/TRON-it 2CG d=1e8 hyb hot512 {nnz / 1e6:.0f}M "
         f"{entry_iters_m:.1f}M eit/s"
+    )
+
+
+def _unit_stream_game(visits_d: int, visits_u: int, sweeps_d: int,
+                      sweeps_u: int, off_ms: float) -> str:
+    # compare DuHL vs uniform from the SAME run only (the calibration
+    # discipline): v = RE chunk visits to tolerance (ordered/uniform),
+    # sw = sweeps to tolerance, OFF = same-run prefetch-OFF ms/sweep
+    return (
+        f"ms/sw v{visits_d}/{visits_u} "
+        f"sw{sweeps_d}/{sweeps_u} OFF{off_ms:.0f}"
     )
 
 
@@ -172,7 +183,7 @@ def _unit_stream_chunked(off_ms: float, overlap: float, chunks: int) -> str:
     # stand-in; ovl = epoch overlap fraction (decode hidden behind compute)
     return (
         f"ms/ep ON {chunks}ch zdec "
-        f"OFFsr {off_ms:.0f} ovl{overlap:.2f}"
+        f"OFF{off_ms:.0f} ovl{overlap:.2f}"
     )
 
 
@@ -181,7 +192,7 @@ HOT_LOOP_NOTES = {
     "autodiff_xla": "2X pass",
     "pallas_kernel": "1 pass dflt",
     "pallas_bf16": "bf16 f32acc",
-    "pallas_shardmap_mesh1": "shmap mesh1",
+    "pallas_shardmap_mesh1": "shmap",
 }
 
 
@@ -190,14 +201,19 @@ def sample_report() -> dict:
     SAME row/unit builders main() uses — what tests/test_bench_line.py
     measures against MAX_LINE_BYTES without touching a TPU.
 
-    Widths are per metric CLASS, each a decade-plus above anything a sane
-    run can produce (r1-r5 actuals: rates ~1e8, GB/s ~750, sweeps ~50 ms;
-    main() still hard-raises if a pathological line exceeds the budget):
-    rate rows 1e9, bandwidth rows 1e4 GB/s (12x the roofline), ms rows
-    1e5 ms (100 s per iteration/sweep/epoch)."""
+    Widths are per metric CLASS, each comfortably above anything a sane
+    run can produce (r1-r5 actuals: λ-grid rate ~6e8, GB/s ~750, sweeps
+    18-50 ms, iters ≤ 750 ms, streamed epochs/sweeps ~1-3 s; main() still
+    hard-raises if a pathological line exceeds the budget): training rate
+    rows 1e9, bandwidth rows 1e4 GB/s (12x the roofline), per-iteration/
+    sweep ms rows 1e4 (10+ s where actuals are sub-second), epoch-scale
+    streaming ms rows 1e4 (10 s/epoch vs ~3 s worst observed), serving
+    rows 1e6 sc/s / 1e4 ms p95 (three decades above the tunnel's
+    dispatch-bound reality)."""
     rate, rate_sp = 999999999.9, [999999999.9, 999999999.9]
     gbps, gbps_sp = 9999.9, [9999.9, 9999.9]
-    ms, ms_sp = 99999.9, [99999.9, 99999.9]
+    ms, ms_sp = 9999.9, [9999.9, 9999.9]
+    sc, sc_sp = 999999.9, [999999.9, 999999.9]
     extra = [
         _row("fe_hot_loop_stream_gbps", gbps, gbps_sp,
              _unit_stream(1 << 17, D))
@@ -215,15 +231,17 @@ def sample_report() -> dict:
         _row("sparse_giant_fe_entry_iters_per_sec", rate, rate_sp,
              _unit_sparse_1e7(25165824, 9999.9)),
         _row("sparse_giant_fe_hybrid", ms, ms_sp,
-             _unit_sparse_hybrid(16777216, 99999.9, 9.99, 256)),
+             _unit_sparse_hybrid(16777216, 9999.4, 9.99, 256)),
         _row("sparse_giant_fe_composed", ms, ms_sp,
-             _unit_sweep_composed(99999.9, 9.99)),
+             _unit_sweep_composed(9999.4, 9.99)),
         _row("sparse_1e8_fe_tron_ms_per_iter", ms, ms_sp,
-             _unit_sparse_1e8(4194304, 99999.9)),
+             _unit_sparse_1e8(4194304, 999.9)),
         _row("stream_fe_chunked", ms, ms_sp,
-             _unit_stream_chunked(99999, 9.99, 99)),
-        _row("serve_microbatch", rate, rate_sp,
-             _unit_serve(99999.9, 999999999.9)),
+             _unit_stream_chunked(9999, 9.99, 99)),
+        _row("stream_game_duhl", ms, ms_sp,
+             _unit_stream_game(9999, 9999, 99, 99, 9999.4)),
+        _row("serve_microbatch", sc, sc_sp,
+             _unit_serve(9999.4, 999999.9)),
     ]
     report = _row(
         "glm_lambda_grid_example_iters_per_sec", rate, rate_sp,
@@ -980,6 +998,111 @@ def bench_stream_fe_chunked() -> dict:
     )
 
 
+def bench_stream_game_duhl() -> dict:
+    """Streamed GAME with the DuHL importance-ordered chunk schedule vs
+    uniform sweeps, back to back in THIS process (ISSUE 11). One
+    gap-skewed synthetic GAME dataset (hot entities coupled to the FE
+    signal, cold entities decoupled — the data shape DuHL exists for)
+    streams as entity-clustered chunks with a real per-load host decode
+    (sleep + zlib inflate, the Avro stand-in); both modes train to the
+    SAME loss-plateau tolerance. Row value is the DuHL prefetch-ON
+    ms/sweep; the unit embeds the acceptance evidence — RE chunk visits
+    to tolerance ordered vs uniform (same run) and the same-run
+    prefetch-OFF ms/sweep. Chunk-visit counts are deterministic; ms/sweep
+    is chip-lottery-sensitive and only comparable within the run."""
+    import time as _time
+    import zlib
+
+    from photon_ml_tpu.algorithm.streaming_game import (
+        DuHLChunkSchedule,
+        DuHLScheduleConfig,
+        StreamingGameProgram,
+    )
+    from photon_ml_tpu.io.stream_reader import GameArrayChunkSource
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig
+    from photon_ml_tpu.parallel.distributed import (
+        FixedEffectStepSpec,
+        RandomEffectStepSpec,
+    )
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(13)
+    d_fe, d_re = 32, 8
+    hot_rows, cold_rows = 512, 1536
+    n = hot_rows + cold_rows
+    ents = np.concatenate([
+        np.repeat(np.arange(4), hot_rows // 4),
+        4 + np.arange(cold_rows) // 16,
+    ]).astype(np.int32)
+    x_fe = rng.normal(size=(n, d_fe)).astype(np.float32)
+    x_fe[hot_rows:] = 0.0
+    x_re = rng.normal(size=(n, d_re)).astype(np.float32)
+    w_fe = rng.normal(size=d_fe).astype(np.float32)
+    w_re = 0.5 * rng.normal(size=(int(ents.max()) + 1, d_re))
+    w_re[:4] *= 6.0
+    y = (
+        x_fe @ w_fe + (x_re * w_re[ents]).sum(1)
+        + 0.05 * rng.normal(size=n)
+    ).astype(np.float32)
+    blob = zlib.compress(x_fe[:128].tobytes(), 1)
+
+    def decode():
+        _time.sleep(0.002)
+        np.frombuffer(zlib.decompress(blob), dtype=np.float32)
+
+    def source(hook=decode):
+        return GameArrayChunkSource(
+            features={"g": x_fe, "p": x_re}, labels=y,
+            entity_idx={"user": ents}, chunk_records=128,
+            cluster_by="user", decode_hook=hook,
+        )
+
+    opt = OptimizerConfig(max_iterations=4)
+
+    def run(schedule_budget, prefetch=True, hook=decode):
+        src = source(hook)
+        schedule = (
+            DuHLChunkSchedule(
+                DuHLScheduleConfig(working_set_chunks=schedule_budget,
+                                   tail_chunks_per_sweep=1),
+                src.num_chunks,
+            )
+            if schedule_budget else None
+        )
+        program = StreamingGameProgram(
+            TaskType.LINEAR_REGRESSION, src,
+            FixedEffectStepSpec("g", opt, l2_weight=0.1),
+            (RandomEffectStepSpec("user", "p", opt, l2_weight=1.0),),
+            schedule=schedule, prefetch=prefetch,
+        )
+        t0 = time.perf_counter()
+        result = program.train(num_sweeps=8, tolerance=1e-4)
+        return result, (time.perf_counter() - t0) * 1e3
+
+    run(4, hook=None)  # warm every jit signature outside the timings
+    uniform, _ = run(None)
+    _, off_total = run(4, prefetch=False)
+    results = []
+
+    def once():
+        result, total_ms = run(4)
+        results.append(result)
+        return total_ms / max(result.sweeps, 1)
+
+    on_ms, on_sp = median_spread(once)
+    duhl = results[-1]
+    off_ms = off_total / max(duhl.sweeps, 1)
+    return _row(
+        "stream_game_duhl",
+        round(on_ms, 1),
+        [round(s, 1) for s in on_sp],
+        _unit_stream_game(
+            duhl.chunk_visits, uniform.chunk_visits,
+            duhl.sweeps, uniform.sweeps, off_ms,
+        ),
+    )
+
+
 def bench_serve_microbatch() -> dict:
     """Resident-scorer serving throughput (ISSUE 10): scores/sec through
     the micro-batching loop at the replay's p95 request latency, with the
@@ -1111,6 +1234,7 @@ def main():
     extra.append(bench_game_sweep_composed())
     extra.append(bench_sparse_fe_1e8())
     extra.append(bench_stream_fe_chunked())
+    extra.append(bench_stream_game_duhl())
     extra.append(bench_serve_microbatch())
     cpu_rate = bench_cpu_scipy(x[:CPU_SUBSAMPLE], y[:CPU_SUBSAMPLE])
 

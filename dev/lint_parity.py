@@ -72,7 +72,8 @@ Eleven checks, all pure-AST (no jax import; runs in milliseconds):
    the composing alternative or the flag to change.
 
 9. **Nested jit in streaming/serving modules** — every chunk-consuming jit
-   in io/stream_reader.py + algorithm/streaming.py must live at module
+   in io/stream_reader.py + algorithm/streaming.py +
+   algorithm/streaming_game.py must live at module
    scope with the chunk batch in its ARGUMENT list: a jit built inside a
    function can close over chunk-sized arrays, which serialize as
    CONSTANTS into the remote-compile request and blow the tunnel's HTTP
@@ -546,6 +547,9 @@ def check_cli_dead_end_rejections(root: pathlib.Path) -> list[str]:
 STREAMING_MODULES = (
     f"{PACKAGE}/io/stream_reader.py",
     f"{PACKAGE}/algorithm/streaming.py",
+    # the streamed-GAME path (ISSUE 11): its chunk-consuming jits carry
+    # the same 413 exposure as the GLM streaming modules
+    f"{PACKAGE}/algorithm/streaming_game.py",
 )
 
 #: serving modules join the ban (whole package): the operand at risk is

@@ -54,7 +54,12 @@ from photon_ml_tpu.ops.variance import (
     validate_variance_mode,
 )
 from photon_ml_tpu.optim.common import LaneTrace, LaneTraces
-from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType, solve
+from photon_ml_tpu.optim.optimizer import (
+    OptimizerConfig,
+    OptimizerType,
+    resolve_auto_optimizer,
+    solve,
+)
 from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.types import TaskType
 
@@ -121,8 +126,20 @@ def _make_objective(task: TaskType, cfg: CoordinateOptimizationConfig,
     )
 
 
-def _solve_config(cfg: CoordinateOptimizationConfig) -> OptimizerConfig:
-    opt = cfg.optimizer
+def _solve_config(
+    cfg: CoordinateOptimizationConfig,
+    *,
+    loss=None,
+    small_dense: bool = False,
+) -> OptimizerConfig:
+    """Concrete solver config for one coordinate solve: resolves AUTO
+    (NEWTON on eligible small-d dense vmapped solves — RE/MF buckets —
+    LBFGS elsewhere; optim/optimizer.resolve_auto_optimizer) and then
+    applies the elastic-net OWLQN flip, which overrides any resolution
+    exactly as it overrides an explicit LBFGS."""
+    opt = resolve_auto_optimizer(
+        cfg.optimizer, loss=loss, small_dense=small_dense
+    )
     if cfg.uses_owlqn:
         opt = dataclasses.replace(
             opt, optimizer_type=OptimizerType.OWLQN, l1_weight=cfg.l1_weight
@@ -202,7 +219,8 @@ class FixedEffectCoordinate(Coordinate):
         norm = objective.normalization
         w0 = norm.from_model_space(model.glm.coefficients.means, self.intercept_index)
         result = _jitted_fe_solve(
-            objective, _solve_config(self.config), batch, w0
+            objective, _solve_config(self.config, loss=objective.loss),
+            batch, w0,
         )
         means = norm.to_model_space(result.coefficients, self.intercept_index)
         variances = None
@@ -336,7 +354,10 @@ class RandomEffectCoordinate(Coordinate):
             else self.normalization
         )
         objective = _make_objective(self.task, self.config, solve_norm)
-        opt = _solve_config(self.config)
+        # AUTO resolves to NEWTON here: the per-entity bucket solve is
+        # exactly the small-d dense vmapped shape the batched-Newton
+        # solver was measured on (BASELINE.md r5)
+        opt = _solve_config(self.config, loss=objective.loss, small_dense=True)
         full_offsets = self.dataset.offsets
         if extra_offsets is not None:
             full_offsets = full_offsets + extra_offsets

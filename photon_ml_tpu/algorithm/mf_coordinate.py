@@ -273,7 +273,9 @@ class MatrixFactorizationCoordinate(Coordinate):
                 "(use l2_weight; the reference's MF design is L2-only)"
             )
         objective = _make_objective(self.task, self.config, None)
-        opt = _solve_config(self.config)
+        # alternating factor solves are small-k dense vmapped problems:
+        # AUTO resolves to the batched-Newton solver (optim/newton.py)
+        opt = _solve_config(self.config, loss=objective.loss, small_dense=True)
         full_offsets = self.dataset.offsets
         if extra_offsets is not None:
             full_offsets = full_offsets + extra_offsets

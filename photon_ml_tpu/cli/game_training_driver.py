@@ -57,6 +57,8 @@ from photon_ml_tpu.io.model_io import (
     write_feature_stats,
 )
 from photon_ml_tpu.ops.normalization import NormalizationType
+from photon_ml_tpu.optim.optimizer import OptimizerType
+from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.telemetry import RunJournal, SolverTelemetry, default_registry
 from photon_ml_tpu.telemetry.layout import reset_layout_metrics
 from photon_ml_tpu.telemetry.probes import CompileMonitor, live_buffer_bytes
@@ -158,6 +160,25 @@ class GameTrainingParams:
     #: this many times before the error propagates
     #: (resilience/recovery.py). 0 disables recovery.
     max_restarts: int = 2
+    #: out-of-core streamed GAME (ISSUE 11): records per chunk (> 0 opts
+    #: in). The input streams as entity-clustered fixed-shape chunks
+    #: through the one-jitted-step accumulators
+    #: (io/stream_reader.GameAvroChunkSource +
+    #: algorithm/streaming_game.StreamingGameProgram) — n bounded by disk,
+    #: not HBM. Requires an entity-sorted Avro input (sorted by the first
+    #: random-effect coordinate's id column). 0 = off (default), the
+    #: unchanged in-core path.
+    streaming_chunks: int = 0
+    #: double-buffered chunk decode; --no-streaming-prefetch is the
+    #: same-run OFF baseline for overlap measurements
+    streaming_prefetch: bool = True
+    #: DuHL importance-ordered chunk schedule (arXiv:1702.07005): > 0 pins
+    #: this many gap-hottest chunks resident and streams the cold tail
+    #: round-robin. 0 (default) = uniform order, bitwise-identical to the
+    #: unscheduled streamed sweep.
+    duhl_working_set: int = 0
+    #: cold-tail chunks revisited per sweep under the DuHL schedule
+    duhl_tail_chunks: int = 1
 
     def validate(self) -> None:
         """Cross-parameter checks (reference validateParams:196-298)."""
@@ -231,8 +252,133 @@ class GameTrainingParams:
             and not self.evaluators
         ):
             problems.append("hyperparameter tuning requires --evaluators")
+        if self.streaming_chunks > 0:
+            self._validate_streaming(problems)
+        elif self.duhl_working_set > 0:
+            problems.append(
+                "--duhl-working-set schedules streamed chunks; pass "
+                "--streaming-chunks N to opt into the streamed GAME path"
+            )
         if problems:
             raise ValueError("invalid driver parameters: " + "; ".join(problems))
+
+    def _validate_streaming(self, problems: list) -> None:
+        """The streamed-GAME surface (ISSUE 11): one dense primary FE +
+        IDENTITY random effects over an entity-sorted Avro input,
+        single-process. Everything outside it fails fast here with the
+        composing alternative named (lint check 8)."""
+        if self.input_format != "avro":
+            problems.append(
+                "--streaming-chunks streams Avro container blocks; for "
+                "libsvm inputs drop --streaming-chunks (or convert with "
+                "cli.libsvm_to_avro)"
+            )
+        if self.input_date_range:
+            problems.append(
+                "--streaming-chunks streams one input directory; drop "
+                "--input-date-range (pass the resolved daily dir directly)"
+            )
+        if self.distributed or self.mesh_shape or self.partitioned_io:
+            problems.append(
+                "--streaming-chunks is the single-process out-of-core GAME "
+                "path; drop --distributed/--mesh/--partitioned-io (the "
+                "multi-process streamed GAME is a later issue)"
+            )
+        if self.normalization != NormalizationType.NONE:
+            problems.append(
+                "--streaming-chunks trains un-normalized; use "
+                "--normalization NONE or run in-core"
+            )
+        if self.validation_data_path or self.evaluators:
+            problems.append(
+                "--streaming-chunks has no validation pass yet; drop "
+                "--validation-data-path/--evaluators and score with the "
+                "scoring driver"
+            )
+        if self.hyperparameter_tuning != HyperparameterTuningMode.NONE:
+            problems.append(
+                "--streaming-chunks trains one configuration; drop "
+                "--hyperparameter-tuning"
+            )
+        if self.data_validation != DataValidationType.VALIDATE_DISABLED:
+            problems.append(
+                "--streaming-chunks has no chunked validation pass yet; "
+                "use --data-validation VALIDATE_DISABLED or run in-core"
+            )
+        if self.model_input_dir or self.partial_retrain_locked_coordinates:
+            problems.append(
+                "--streaming-chunks does not warm-start from "
+                "--model-input-dir yet; drop it or train in-core"
+            )
+        if self.duhl_working_set < 0 or self.duhl_tail_chunks < 1:
+            problems.append(
+                "--duhl-working-set must be >= 0 and --duhl-tail-chunks "
+                ">= 1"
+            )
+        fe_coords = [
+            n for n, c in self.coordinates.items()
+            if not c.is_random_effect and not c.is_matrix_factorization
+        ]
+        if len(fe_coords) != 1:
+            problems.append(
+                "--streaming-chunks needs exactly one fixed-effect "
+                f"coordinate (got {fe_coords}); train other layouts in-core"
+            )
+        sequence = self.update_sequence or tuple(self.coordinates.keys())
+        if fe_coords and sequence and sequence[0] != fe_coords[0]:
+            problems.append(
+                "--streaming-chunks trains the fixed effect first; put "
+                f"'{fe_coords[0]}' first in --update-sequence"
+            )
+        for name, c in self.coordinates.items():
+            if c.is_matrix_factorization:
+                problems.append(
+                    f"coordinate '{name}': matrix factorization does not "
+                    "stream; drop --streaming-chunks or the MF coordinate"
+                )
+            if (
+                not c.is_random_effect
+                and not c.is_matrix_factorization
+                and c.optimizer == OptimizerType.NEWTON
+            ):
+                problems.append(
+                    f"coordinate '{name}': NEWTON cannot stream the fixed "
+                    "effect (dense [d, d] Hessian); use TRON or LBFGS"
+                )
+            if c.is_random_effect and c.projector != ProjectorType.IDENTITY:
+                problems.append(
+                    f"coordinate '{name}': projector {c.projector.name} "
+                    "does not stream; use IDENTITY or train in-core"
+                )
+            if len(c.reg_weights) != 1:
+                problems.append(
+                    f"coordinate '{name}': --streaming-chunks trains one "
+                    "λ per coordinate; pass a single reg.weights value"
+                )
+            if c.reg_alpha > 0.0:
+                problems.append(
+                    f"coordinate '{name}': elastic-net L1 does not stream "
+                    "on the GAME path; set reg.alpha=0 or train in-core"
+                )
+            if c.compute_variance:
+                problems.append(
+                    f"coordinate '{name}': variances need the in-core "
+                    "Hessian path; drop compute.variance or "
+                    "--streaming-chunks"
+                )
+            if c.down_sampling_rate < 1.0:
+                problems.append(
+                    f"coordinate '{name}': down-sampling does not stream "
+                    "yet; use down.sampling.rate=1"
+                )
+            if (
+                c.is_random_effect
+                and (c.active_data_lower_bound or c.active_data_upper_bound)
+            ):
+                problems.append(
+                    f"coordinate '{name}': active-data bounds are not "
+                    "supported streamed; drop them or train in-core"
+                )
 
 
 def _trace_exchange():
@@ -375,6 +521,10 @@ def _run_inner(
     job_log: PhotonLogger,
     telemetry: SolverTelemetry | None = None,
 ) -> dict:
+    if params.streaming_chunks > 0:
+        # the out-of-core path does its own streaming scans — the full
+        # read below would materialize exactly what it exists to avoid
+        return _run_streaming(params, job_log, telemetry)
     out = params.root_output_dir
     entity_columns = {
         c.random_effect_type
@@ -805,6 +955,225 @@ def _run_inner(
     return summary
 
 
+def _run_streaming(
+    params: GameTrainingParams,
+    job_log: PhotonLogger,
+    telemetry: SolverTelemetry | None = None,
+) -> dict:
+    """The --streaming-chunks GAME pipeline (ISSUE 11): one streaming scan
+    (index maps + entity vocabs + cluster keys, records discarded), an
+    entity-clustered chunk source, and StreamingGameProgram sweeps — the
+    input never materializes in core, so n is bounded by disk, not HBM.
+    validate() already restricted the surface (dense single FE + IDENTITY
+    REs, one λ, single process)."""
+    import jax  # noqa: F401  (platform selection must already be done)
+
+    from photon_ml_tpu.algorithm.streaming_game import (
+        DuHLChunkSchedule,
+        DuHLScheduleConfig,
+        StreamingGameProgram,
+    )
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+    from photon_ml_tpu.io.stream_reader import (
+        GameAvroChunkSource,
+        scan_game_stream,
+    )
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.models.glm import GeneralizedLinearModel
+    from photon_ml_tpu.parallel.distributed import (
+        FixedEffectStepSpec,
+        RandomEffectStepSpec,
+    )
+    from photon_ml_tpu.resilience import run_with_recovery
+    from photon_ml_tpu.telemetry import stream_counters
+
+    out = params.root_output_dir
+    sequence = tuple(params.update_sequence or params.coordinates.keys())
+    fe_name = next(
+        n for n in sequence
+        if not params.coordinates[n].is_random_effect
+    )
+    fe_cfg = params.coordinates[fe_name]
+    re_names = [n for n in sequence if n != fe_name]
+    cluster_by = (
+        params.coordinates[re_names[0]].random_effect_type
+        if re_names else None
+    )
+    shard_ids = {fe_cfg.feature_shard} | {
+        params.coordinates[n].feature_shard for n in re_names
+    }
+    shard_configs = {s: params.feature_shards[s] for s in shard_ids}
+    re_columns = tuple(sorted(
+        params.coordinates[n].random_effect_type for n in re_names
+    ))
+
+    files = avro_io.list_avro_files(params.input_data_path)
+    with Timed("streaming scan"):
+        index_maps, vocabs, cluster_keys, indexes, scalars = (
+            scan_game_stream(
+                files, shard_configs, re_columns,
+                cluster_by=cluster_by, on_corrupt=params.on_corrupt,
+            )
+        )
+    job_log.info(
+        "streaming scan: %d files, shards %s, entities %s",
+        len(files), {k: v.size for k, v in index_maps.items()},
+        {k: len(v) for k, v in vocabs.items()},
+    )
+    for shard_id, imap in index_maps.items():
+        if isinstance(imap, IndexMap):
+            imap.save(os.path.join(out, "index-maps"), shard_id)
+
+    source = GameAvroChunkSource(
+        files, shard_configs, index_maps,
+        chunk_records=params.streaming_chunks,
+        random_effect_id_columns=re_columns,
+        entity_vocabs=vocabs,
+        cluster_by=cluster_by,
+        cluster_keys=cluster_keys,
+        indexes=indexes,
+        on_corrupt=params.on_corrupt,
+    )
+    job_log.info(
+        "planned %d entity-clustered chunks (<=%d records requested, "
+        "chunk_rows=%d)",
+        source.num_chunks, params.streaming_chunks, source.chunk_rows,
+    )
+
+    def opt_config(cfg):
+        return cfg.optimization_config(cfg.reg_weights[0])
+
+    fe_opt = opt_config(fe_cfg)
+    fe_spec = FixedEffectStepSpec(
+        feature_shard_id=fe_cfg.feature_shard,
+        optimizer=fe_opt.optimizer,
+        l2_weight=fe_opt.l2_weight,
+    )
+    re_specs = []
+    for n in re_names:
+        cfg = params.coordinates[n]
+        o = opt_config(cfg)
+        re_specs.append(RandomEffectStepSpec(
+            re_type=cfg.random_effect_type,
+            feature_shard_id=cfg.feature_shard,
+            optimizer=o.optimizer,
+            l2_weight=o.l2_weight,
+        ))
+
+    schedule = None
+    if params.duhl_working_set > 0:
+        schedule = DuHLChunkSchedule(
+            DuHLScheduleConfig(
+                working_set_chunks=params.duhl_working_set,
+                tail_chunks_per_sweep=params.duhl_tail_chunks,
+            ),
+            source.num_chunks,
+        )
+    checkpointer = (
+        TrainingCheckpointer(
+            os.path.join(params.checkpoint_dir, "streaming-game")
+        )
+        if params.checkpoint_dir else None
+    )
+
+    with Timed("streamed game train"):
+        def attempt(restart: int):
+            program = StreamingGameProgram(
+                params.task_type, source, fe_spec, tuple(re_specs),
+                num_entities={t: len(vocabs[t]) for t in re_columns},
+                schedule=schedule,
+                prefetch=params.streaming_prefetch,
+                # the scan pass already collected the [n] scalars — the
+                # program skips its decode fallback entirely
+                scalars=scalars,
+            )
+            return program.train(
+                num_sweeps=params.coordinate_descent_iterations,
+                checkpointer=checkpointer,
+                resume=params.resume or restart > 0,
+            )
+
+        result = run_with_recovery(
+            attempt,
+            max_restarts=params.max_restarts,
+            checkpointer=checkpointer,
+            journal=telemetry.journal if telemetry is not None else None,
+            description="streamed game train",
+        )
+
+    state = result.state
+    models: dict = {
+        fe_name: FixedEffectModel(
+            glm=GeneralizedLinearModel(
+                Coefficients(means=state.fe_coefficients),
+                params.task_type,
+            ),
+            feature_shard_id=fe_cfg.feature_shard,
+        )
+    }
+    for n, spec in zip(re_names, re_specs):
+        models[n] = RandomEffectModel(
+            coefficients=state.re_tables[spec.re_type],
+            entity_keys=vocabs[spec.re_type],
+            random_effect_type=spec.re_type,
+            feature_shard_id=spec.feature_shard_id,
+            task=params.task_type,
+        )
+    model = GameModel(models=models)
+    if params.model_output_mode != ModelOutputMode.NONE:
+        save_game_model(
+            os.path.join(out, "best"), model, index_maps,
+            optimization_configurations={
+                "regWeights": {
+                    n: params.coordinates[n].reg_weights[0] for n in sequence
+                }
+            },
+        )
+    evidence = stream_counters.game_stream_evidence()
+    summary: dict = {
+        "distributed": False,
+        "streaming": {
+            "chunks": source.num_chunks,
+            "chunk_rows": source.chunk_rows,
+            "records": source.total_records,
+            "schedule": "duhl" if schedule is not None else "uniform",
+            **evidence,
+        },
+        "num_configurations": 1,
+        "effective_coordinate_configurations": {
+            name: format_coordinate_config(cfg)
+            for name, cfg in params.coordinates.items()
+        },
+        "best_configuration_index": 0,
+        "best_reg_weights": {
+            n: params.coordinates[n].reg_weights[0] for n in sequence
+        },
+        "best_metric": float("nan"),
+        "losses": [float(x) for x in result.losses],
+        "metric_history": [],
+    }
+    if telemetry is not None and telemetry.journal is not None:
+        telemetry.journal.record(
+            "config",
+            task_type=params.task_type.name,
+            distributed=False,
+            streaming_chunks=params.streaming_chunks,
+            duhl_working_set=params.duhl_working_set,
+            num_configurations=1,
+        )
+    summary["timings"] = timing_summary()
+    with open(os.path.join(out, "training-summary.json"), "w") as f:
+        json.dump(_json_safe(summary), f, indent=2, default=float)
+    events.send(TrainingFinishEvent(job_name="game-training", succeeded=True))
+    return summary
+
+
 def _json_safe(obj):
     """NaN/Inf -> None so the summary is strict JSON."""
     if isinstance(obj, dict):
@@ -904,6 +1273,23 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="mid-sweep recovery budget: restore the latest "
                         "intact checkpoint and resume after a divergence/"
                         "transient failure up to N times (0 disables)")
+    p.add_argument("--streaming-chunks", type=int, default=0,
+                   help="out-of-core streamed GAME: records per chunk "
+                        "(> 0 opts in; entity-clustered chunks stream "
+                        "through the one-jitted-step accumulators — "
+                        "dense single-FE + IDENTITY-RE configs over an "
+                        "entity-sorted Avro input)")
+    p.add_argument("--no-streaming-prefetch", action="store_true",
+                   help="decode chunks inline instead of double-buffered "
+                        "(the same-run OFF baseline for overlap evidence)")
+    p.add_argument("--duhl-working-set", type=int, default=0,
+                   help="DuHL importance-ordered schedule: pin this many "
+                        "gap-hottest chunks resident and stream the cold "
+                        "tail round-robin (0 = uniform order, bitwise the "
+                        "unscheduled streamed sweep)")
+    p.add_argument("--duhl-tail-chunks", type=int, default=1,
+                   help="cold-tail chunks revisited per sweep under "
+                        "--duhl-working-set")
     return p
 
 
@@ -959,6 +1345,10 @@ def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
         partitioned_io=args.partitioned_io,
         on_corrupt=args.on_corrupt,
         max_restarts=args.max_restarts,
+        streaming_chunks=args.streaming_chunks,
+        streaming_prefetch=not args.no_streaming_prefetch,
+        duhl_working_set=args.duhl_working_set,
+        duhl_tail_chunks=args.duhl_tail_chunks,
     )
 
 

@@ -68,7 +68,12 @@ from photon_ml_tpu.ops.variance import (
     resolve_variance_mode_for,
     validate_variance_mode,
 )
-from photon_ml_tpu.optim.optimizer import OptimizerConfig, OptimizerType, solve
+from photon_ml_tpu.optim.optimizer import (
+    OptimizerConfig,
+    OptimizerType,
+    resolve_auto_optimizer,
+    solve,
+)
 from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.types import TaskType
 
@@ -438,6 +443,11 @@ class GameEstimator:
         )
 
         sequence = list(self.update_sequence or self.coordinate_configs.keys())
+        # AUTO resolution needs the solve SHAPE: RE/MF bucket solves are
+        # the small-dense Newton-eligible kind, FE solves are not — the
+        # spec sites below pass it so AUTO-through-the-estimator behaves
+        # exactly like AUTO-through-GameTrainProgram
+        task_loss = loss_for_task(self.task)
         locked = set(self.locked_coordinates)
         if locked and initial_model is None:
             raise ValueError(
@@ -549,7 +559,9 @@ class GameEstimator:
                 extra_fe_cid_of_shard[cfg.feature_shard_id] = cid
                 extra_fe_specs.append(FixedEffectStepSpec(
                     feature_shard_id=cfg.feature_shard_id,
-                    optimizer=_solve_config(cfg.optimization),
+                    optimizer=_solve_config(
+                        cfg.optimization, loss=task_loss
+                    ),
                     l2_weight=cfg.optimization.l2_weight,
                     down_sampling_rate=cfg.optimization.down_sampling_rate,
                     intercept_index=self.intercept_indices.get(
@@ -568,7 +580,9 @@ class GameEstimator:
                     row_effect_type=cfg.row_effect_type,
                     col_effect_type=cfg.col_effect_type,
                     num_latent_factors=cfg.num_latent_factors,
-                    optimizer=_solve_config(cfg.optimization),
+                    optimizer=_solve_config(
+                        cfg.optimization, loss=task_loss, small_dense=True
+                    ),
                     l2_weight=cfg.optimization.l2_weight,
                     num_alternations=cfg.num_alternations,
                     seed=cfg.seed,
@@ -618,7 +632,9 @@ class GameEstimator:
             re_specs.append(RandomEffectStepSpec(
                 re_type=re_type,
                 feature_shard_id=cfg.feature_shard_id,
-                optimizer=_solve_config(cfg.optimization),
+                optimizer=_solve_config(
+                    cfg.optimization, loss=task_loss, small_dense=True
+                ),
                 l2_weight=cfg.optimization.l2_weight,
                 # the dataset's projector, not the config's: sparse shards
                 # coerce to the compact INDEX_MAP representation
@@ -651,7 +667,7 @@ class GameEstimator:
             self.task,
             FixedEffectStepSpec(
                 feature_shard_id=fe_shard,
-                optimizer=_solve_config(fe_cfg.optimization),
+                optimizer=_solve_config(fe_cfg.optimization, loss=task_loss),
                 l2_weight=fe_cfg.optimization.l2_weight,
                 down_sampling_rate=fe_cfg.optimization.down_sampling_rate,
             ),
@@ -966,7 +982,7 @@ def train_glm_grid(
     (elastic net included); TRON's trust-region loop is per-lane scalar
     control flow and stays on the sequential path.
     """
-    optimizer = optimizer or OptimizerConfig()
+    optimizer = resolve_auto_optimizer(optimizer or OptimizerConfig())
     if optimizer.optimizer_type not in (
         OptimizerType.LBFGS, OptimizerType.OWLQN
     ):
@@ -1147,7 +1163,7 @@ def train_glm(
     telemetry: optional ``telemetry.SolverTelemetry`` — one convergence row
     (iterations, reason, value history) per λ solve.
     """
-    optimizer = optimizer or OptimizerConfig()
+    optimizer = resolve_auto_optimizer(optimizer or OptimizerConfig())
     validate_variance_mode(variance_mode)
     has_bounds = lower_bounds is not None or upper_bounds is not None
     if has_bounds and (
@@ -1275,7 +1291,9 @@ def train_glm_streaming(
     from photon_ml_tpu.optim.optimizer import solver_state_class
     from photon_ml_tpu.telemetry import resilience_counters
 
-    optimizer = optimizer or OptimizerConfig()
+    # AUTO -> LBFGS: a streamed host-loop objective is never the small-d
+    # dense vmapped shape Newton promotion targets
+    optimizer = resolve_auto_optimizer(optimizer or OptimizerConfig())
     if optimizer.optimizer_type == OptimizerType.NEWTON:
         raise ValueError(
             "NEWTON cannot stream (dense [d, d] Hessian); use TRON for "

@@ -296,6 +296,532 @@ class SparseArrayChunkSource(ChunkSource):
         )
 
 
+# ---------------------------------------------------------------------------
+# Entity-clustered GAME chunks (ISSUE 11): the out-of-core GAME contract
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GameChunk:
+    """One decoded GAME chunk (host numpy, fixed ``chunk_rows`` padding).
+
+    The GAME analogue of a :class:`LabeledPointBatch` chunk: per-shard
+    feature blocks, per-sample scalars, per-RE-type entity indices (into
+    the GLOBAL entity vocab, -1 for absent/padding), and each slot's
+    GLOBAL sample row (``rows``, -1 padding) so host-resident [n] score
+    vectors can be read/written per chunk. Padding rows carry weight 0 /
+    zero features per the framework padding contract.
+    """
+
+    features: "dict[str, np.ndarray]"
+    labels: np.ndarray
+    offsets: np.ndarray
+    weights: np.ndarray
+    entity_idx: "dict[str, np.ndarray]"
+    rows: np.ndarray
+    num_records: int
+
+
+def plan_entity_chunks(
+    entity_idx: np.ndarray, chunk_records: int
+) -> "list[np.ndarray]":
+    """Entity-clustered chunk plan over in-memory rows: pack WHOLE
+    entities (all rows sharing an entity index, in ascending row order)
+    greedily into chunks of at most ``chunk_records`` rows — an entity
+    larger than the budget forms its own chunk, like an over-budget Avro
+    block in :func:`plan_chunks`. Rows with entity -1 (vocab-absent:
+    scored, never trained) pack as singletons wherever they fall.
+
+    This is what lets a random-effect bucket solve run per chunk with the
+    chunk resident: every entity's rows co-reside in exactly ONE chunk,
+    so its per-entity solve sees the identical padded block the in-core
+    path builds (zero-weight cap padding is an exact no-op). Returns the
+    per-chunk global row-index arrays.
+    """
+    if chunk_records <= 0:
+        raise ValueError(f"chunk_records must be positive, got {chunk_records}")
+    entity_idx = np.asarray(entity_idx)
+    n = len(entity_idx)
+    if n == 0:
+        return []
+    # stable sort groups each entity's rows contiguously while preserving
+    # ascending row order within the entity (the in-core bucketing's order)
+    order = np.argsort(entity_idx, kind="stable")
+    ents = entity_idx[order]
+    boundaries = np.concatenate(
+        [[0], np.nonzero(ents[1:] != ents[:-1])[0] + 1, [n]]
+    )
+    chunks: list[np.ndarray] = []
+    cur: list[np.ndarray] = []
+    cur_n = 0
+
+    def flush():
+        nonlocal cur, cur_n
+        if cur:
+            chunks.append(np.concatenate(cur))
+            cur, cur_n = [], 0
+
+    for start, end in zip(boundaries[:-1], boundaries[1:]):
+        if ents[start] < 0:
+            # vocab-absent rows: no clustering constraint — split freely
+            group_rows = order[start:end]
+            for lo in range(0, len(group_rows), chunk_records):
+                sub = group_rows[lo:lo + chunk_records]
+                if cur and cur_n + len(sub) > chunk_records:
+                    flush()
+                cur.append(sub)
+                cur_n += len(sub)
+            continue
+        group = order[start:end]
+        if cur and cur_n + len(group) > chunk_records:
+            flush()
+        cur.append(group)
+        cur_n += len(group)
+    flush()
+    return chunks
+
+
+def entities_spanning_chunks(
+    row_plan: "Sequence[np.ndarray]", entity_idx: np.ndarray
+) -> np.ndarray:
+    """Entity rows (vocab indices) whose samples land in MORE than one
+    chunk of ``row_plan`` — the entities a per-chunk random-effect solve
+    would silently train on partial data (last chunk wins). Empty means
+    the plan entity-clusters this RE type."""
+    entity_idx = np.asarray(entity_idx)
+    chunk_of = np.full(len(entity_idx), -1, dtype=np.int64)
+    for i, rows in enumerate(row_plan):
+        chunk_of[rows] = i
+    valid = entity_idx >= 0
+    if not valid.any():
+        return np.zeros((0,), dtype=np.int64)
+    pairs = np.unique(
+        np.stack([entity_idx[valid].astype(np.int64), chunk_of[valid]]),
+        axis=1,
+    )
+    ents, counts = np.unique(pairs[0], return_counts=True)
+    return ents[counts > 1]
+
+
+class GameArrayChunkSource:
+    """Entity-clustered in-memory GAME chunk source: host arrays chunked
+    by whole-entity row groups (:func:`plan_entity_chunks`).
+
+    The host-RAM >> HBM tier of the out-of-core hierarchy (Snap ML,
+    arXiv:1803.06333): per-sample scalars ([n] labels/offsets/weights/
+    entity indices and the score vectors the streamed GAME program keeps)
+    stay host-resident, while the O(n·d) feature blocks enter the device
+    one fixed-shape chunk at a time through the module-level jitted steps
+    (algorithm/streaming_game.py — chunks as jit ARGUMENTS, lint check 9).
+
+    ``cluster_by``: the RE type whose entities define chunk grouping
+    (required when any random-effect coordinate trains from this source);
+    other RE types must nest inside those groups —
+    ``StreamingGameProgram`` verifies with :func:`entities_spanning_chunks`
+    and fails fast otherwise. ``decode_hook`` runs once per load in the
+    loading thread (prefetch-overlap and fault-injection seam, like
+    :class:`ArrayChunkSource`).
+    """
+
+    sparse = False
+
+    def __init__(
+        self,
+        *,
+        features: "Mapping[str, np.ndarray]",
+        labels: np.ndarray,
+        entity_idx: "Mapping[str, np.ndarray]",
+        offsets: np.ndarray | None = None,
+        weights: np.ndarray | None = None,
+        chunk_records: int,
+        cluster_by: str | None = None,
+        decode_hook: Callable[[], None] | None = None,
+    ):
+        self.features = {k: np.asarray(v) for k, v in features.items()}
+        self.labels = np.asarray(labels)
+        n = self.labels.shape[0]
+        dtype = self.labels.dtype
+        self.offsets = (
+            np.zeros((n,), dtype) if offsets is None
+            else np.asarray(offsets, dtype=dtype)
+        )
+        self.weights = (
+            np.ones((n,), dtype) if weights is None
+            else np.asarray(weights, dtype=dtype)
+        )
+        self.entity_idx = {
+            t: np.asarray(v, dtype=np.int32) for t, v in entity_idx.items()
+        }
+        self.decode_hook = decode_hook
+        if cluster_by is not None and cluster_by not in self.entity_idx:
+            raise ValueError(
+                f"cluster_by={cluster_by!r} is not an entity-index column "
+                f"({sorted(self.entity_idx)})"
+            )
+        self.cluster_by = cluster_by
+        if cluster_by is not None:
+            self.row_plan = plan_entity_chunks(
+                self.entity_idx[cluster_by], chunk_records
+            )
+        else:
+            self.row_plan = [
+                np.arange(lo, min(lo + chunk_records, n))
+                for lo in range(0, n, chunk_records)
+            ]
+        self.specs = [
+            ChunkSpec(index=i, num_records=len(rows))
+            for i, rows in enumerate(self.row_plan)
+        ]
+        self.chunk_rows = max((len(r) for r in self.row_plan), default=0)
+        self.dims = {k: int(v.shape[1]) for k, v in self.features.items()}
+
+    @property
+    def num_chunks(self) -> int:
+        return len(self.specs)
+
+    @property
+    def total_records(self) -> int:
+        return int(sum(s.num_records for s in self.specs))
+
+    def load(self, spec: ChunkSpec) -> GameChunk:
+        if self.decode_hook is not None:
+            self.decode_hook()
+        idx = self.row_plan[spec.index]
+        pad = self.chunk_rows - len(idx)
+
+        def pad1(a, fill=0):
+            out = a[idx]
+            if pad:
+                out = np.concatenate(
+                    [out, np.full((pad,) + out.shape[1:], fill, out.dtype)]
+                )
+            return out
+
+        rows = idx.astype(np.int64)
+        if pad:
+            rows = np.concatenate([rows, np.full((pad,), -1, np.int64)])
+        return GameChunk(
+            # copies, not views (fancy indexing copies): the accumulator
+            # must never alias the source arrays
+            features={k: pad1(v) for k, v in self.features.items()},
+            labels=pad1(self.labels),
+            offsets=pad1(self.offsets),
+            weights=pad1(self.weights),
+            entity_idx={
+                t: pad1(v, fill=-1) for t, v in self.entity_idx.items()
+            },
+            rows=rows,
+            num_records=len(idx),
+        )
+
+
+def plan_entity_chunks_avro(
+    files: Sequence[str],
+    chunk_records: int,
+    cluster_keys: np.ndarray,
+    *,
+    indexes: "list[list[tuple[int, int, int]]] | None" = None,
+    on_corrupt: str = "raise",
+):
+    """Entity-clustered Avro chunk plan at RECORD granularity: a chunk is
+    a record range whose end lands on the first clustering-entity CHANGE
+    at or after ``chunk_records`` rows (``cluster_keys``: the per-record
+    entity key of the cluster column in file+record order; "" — a missing
+    id — is itself a vocab entity and clusters like any other), so an
+    entity-sorted input yields chunks
+    that hold whole entities without requiring entities to align to
+    container-block boundaries. Each chunk's ``runs`` are the COVERING
+    block ranges (a boundary block decodes for both neighbors — bounded
+    extra decode, exact chunks); loads slice the decoded records to the
+    range. An entity larger than the budget extends its chunk; unsorted
+    input degrades to over-budget chunks rather than wrong solves
+    (``StreamingGameProgram`` still verifies clustering per RE type).
+    Returns (specs, per-file block indexes, per-chunk record starts,
+    per-chunk leading-record skips into the first covering block).
+    """
+    if chunk_records <= 0:
+        raise ValueError(f"chunk_records must be positive, got {chunk_records}")
+    if indexes is None:
+        indexes = [
+            avro_io.scan_block_index(f, on_corrupt=on_corrupt) for f in files
+        ]
+    cluster_keys = np.asarray(cluster_keys).astype(str)
+    blocks = [
+        (fi, bi, file_index[bi][0])
+        for fi, file_index in enumerate(indexes)
+        for bi in range(len(file_index))
+    ]
+    if not blocks:
+        raise ValueError("no Avro blocks to stream")
+    total = sum(b[2] for b in blocks)
+    if len(cluster_keys) != total:
+        raise ValueError(
+            f"cluster_keys covers {len(cluster_keys)} records but the "
+            f"block index holds {total}"
+        )
+    # global record offset at each block start
+    block_starts = np.concatenate(
+        [[0], np.cumsum([b[2] for b in blocks])]
+    ).astype(np.int64)
+    # "" (a record missing the id column) is a REAL vocab entity on the
+    # decode path (np.unique of keys, the in-core build_game_dataset
+    # rule), so "" runs cluster like any other entity — splitting them
+    # freely would make the program's clustering verification reject an
+    # input the in-core path trains fine
+    splittable = np.ones(total + 1, dtype=bool)
+    if total > 1:
+        same = cluster_keys[1:] == cluster_keys[:-1]
+        splittable[1:total] = ~same
+    specs: list[ChunkSpec] = []
+    starts: list[int] = []
+    skips: list[int] = []
+    pos = 0
+    while pos < total:
+        end = min(pos + chunk_records, total)
+        while end < total and not splittable[end]:
+            end += 1
+        # covering blocks: those whose record ranges intersect [pos, end)
+        first = int(np.searchsorted(block_starts, pos, side="right") - 1)
+        last = int(np.searchsorted(block_starts, end, side="left") - 1)
+        runs: list[tuple[str, int, int]] = []
+        cover = [(blocks[i][0], blocks[i][1]) for i in range(first, last + 1)]
+        for fi, group in itertools.groupby(cover, key=lambda b: b[0]):
+            bis = [bi for _, bi in group]
+            run_start = prev = bis[0]
+            for bi in bis[1:] + [None]:
+                if bi is None or bi != prev + 1:
+                    runs.append((files[fi], run_start, prev - run_start + 1))
+                    run_start = bi
+                prev = bi if bi is not None else prev
+        specs.append(
+            ChunkSpec(index=len(specs), num_records=end - pos,
+                      runs=tuple(runs))
+        )
+        starts.append(int(pos))
+        skips.append(int(pos - block_starts[first]))
+        pos = end
+    return specs, indexes, starts, skips
+
+
+class GameAvroChunkSource:
+    """Streams GAME chunks from Avro container files, each chunk decoded
+    through the SAME per-record assembly as the in-core read
+    (io/data_reader.records_to_game_dataset with globally-agreed index
+    maps and entity vocabs — label/response fallback, None offset/weight
+    defaults, metadataMap id extraction), so a streamed epoch consumes
+    the identical numbers the full read would build. Entity-clustered via
+    :func:`plan_entity_chunks_avro` when ``cluster_by`` is given
+    (reference AvroDataReader.scala never materializes the full input on
+    one machine either; this is the single-host accelerator equivalent).
+    """
+
+    sparse = False
+
+    def __init__(
+        self,
+        files: Sequence[str],
+        shard_configs: "Mapping[str, object]",
+        index_maps: "Mapping[str, object]",
+        *,
+        chunk_records: int,
+        random_effect_id_columns: Sequence[str] = (),
+        entity_vocabs: "Mapping[str, np.ndarray] | None" = None,
+        cluster_by: str | None = None,
+        cluster_keys: np.ndarray | None = None,
+        indexes=None,
+        on_corrupt: str = "raise",
+        dtype=np.float32,
+    ):
+        self.files = [str(f) for f in files]
+        self.shard_configs = dict(shard_configs)
+        self.index_maps = dict(index_maps)
+        self.re_columns = tuple(random_effect_id_columns)
+        self.entity_vocabs = dict(entity_vocabs or {})
+        self.on_corrupt = on_corrupt
+        self.dtype = dtype
+        if cluster_by is not None:
+            if cluster_keys is None:
+                raise ValueError(
+                    "cluster_by needs cluster_keys (the per-record entity "
+                    "keys collected by scan_game_stream's vocab pass)"
+                )
+            self.specs, self.indexes, self.record_starts, self._skips = (
+                plan_entity_chunks_avro(
+                    self.files, chunk_records, cluster_keys,
+                    indexes=indexes, on_corrupt=on_corrupt,
+                )
+            )
+        else:
+            self.specs, self.indexes = plan_chunks(
+                self.files, chunk_records, on_corrupt=on_corrupt,
+                indexes=indexes,
+            )
+            self.record_starts = list(
+                np.concatenate(
+                    [[0], np.cumsum([s.num_records for s in self.specs])[:-1]]
+                ).astype(int)
+            ) if self.specs else []
+            self._skips = [0] * len(self.specs)
+        self.cluster_by = cluster_by
+        self.chunk_rows = max((s.num_records for s in self.specs), default=0)
+        self.dims = {
+            shard: int(self.index_maps[shard].size)
+            for shard in self.shard_configs
+        }
+        self._file_pos = {f: i for i, f in enumerate(self.files)}
+
+    @property
+    def num_chunks(self) -> int:
+        return len(self.specs)
+
+    @property
+    def total_records(self) -> int:
+        return int(sum(s.num_records for s in self.specs))
+
+    def load(self, spec: ChunkSpec) -> GameChunk:
+        from photon_ml_tpu.io.data_reader import records_to_game_dataset
+
+        records: list = []
+        payload_bytes = 0
+        for path, start, count in spec.runs:
+            index = self.indexes[self._file_pos[path]]
+            payload_bytes += sum(sz for _, sz, _ in index[start:start + count])
+            records.extend(
+                avro_io.read_container_block_range(
+                    path, start, count, index=index,
+                    on_corrupt=self.on_corrupt,
+                )
+            )
+        io_counters.record_bytes_decoded(payload_bytes)
+        # entity-clustered plans slice the covering blocks' records to the
+        # chunk's exact record range (boundary blocks decode for both
+        # neighbors)
+        skip = self._skips[spec.index]
+        records = records[skip:skip + spec.num_records]
+        result = records_to_game_dataset(
+            records, self.shard_configs, self.index_maps,
+            random_effect_id_columns=self.re_columns,
+            entity_vocabs=self.entity_vocabs,
+            dtype=self.dtype,
+        )
+        ds = result.dataset
+        n = spec.num_records
+        pad = self.chunk_rows - n
+
+        def pad1(a, fill=0):
+            a = np.asarray(a)
+            if pad:
+                a = np.concatenate(
+                    [a, np.full((pad,) + a.shape[1:], fill, a.dtype)]
+                )
+            return a
+
+        start = self.record_starts[spec.index]
+        rows = np.arange(start, start + n, dtype=np.int64)
+        return GameChunk(
+            features={
+                k: pad1(ds.feature_shards[k]) for k in self.shard_configs
+            },
+            labels=pad1(ds.labels),
+            offsets=pad1(ds.offsets),
+            weights=pad1(ds.weights),
+            entity_idx={
+                t: pad1(ds.entity_idx[t], fill=-1) for t in self.re_columns
+            },
+            rows=pad1(rows, fill=-1),
+            num_records=n,
+        )
+
+
+def scan_game_stream(
+    files: Sequence[str],
+    shard_configs: "Mapping[str, object]",
+    random_effect_id_columns: Sequence[str],
+    *,
+    cluster_by: str | None = None,
+    on_corrupt: str = "raise",
+    dtype=np.float32,
+):
+    """One streaming pass over the input collecting everything a GAME
+    chunk plan needs — records decoded and DISCARDED (memory stays
+    O(vocabulary + [n] scalars), the out-of-core requirement):
+
+    - global feature index maps (same keyset+sort rule as the full read,
+      io/data_reader.build_index_maps),
+    - entity vocabs per RE column (np.unique of observed keys — bitwise
+      the in-core build_game_dataset rule),
+    - per-record keys of the ``cluster_by`` column (the entity-clustered
+      chunk planner's input), the per-file block indexes, and
+    - the [n] per-sample SCALARS (labels/offsets/weights with the exact
+      records_to_game_dataset defaults, plus per-RE-column entity
+      indices into the vocabs) — so the streamed GAME program never has
+      to re-decode the whole input just to collect them.
+
+    Returns ``(index_maps, entity_vocabs, cluster_keys, indexes,
+    scalars)``; ``scalars`` feeds ``StreamingGameProgram(scalars=...)``.
+    """
+    from photon_ml_tpu.io.data_reader import (
+        META_DATA_MAP,
+        OFFSET,
+        RESPONSE,
+        WEIGHT,
+        build_index_maps,
+    )
+
+    indexes = [
+        avro_io.scan_block_index(f, on_corrupt=on_corrupt) for f in files
+    ]
+    re_cols = tuple(random_effect_id_columns)
+    keys: dict[str, list[str]] = {c: [] for c in re_cols}
+    cluster: list[str] = []
+    labels: list[float] = []
+    offsets: list[float] = []
+    weights: list[float] = []
+
+    def records():
+        for f in files:
+            for record in avro_io.read_container(f, on_corrupt=on_corrupt):
+                label = record.get("label", record.get(RESPONSE))
+                if label is None:
+                    raise ValueError(
+                        "record has neither 'label' nor 'response'"
+                    )
+                labels.append(float(label))
+                offset = record.get(OFFSET)
+                offsets.append(0.0 if offset is None else float(offset))
+                weight = record.get(WEIGHT)
+                weights.append(1.0 if weight is None else float(weight))
+                meta = record.get(META_DATA_MAP) or {}
+                for c in re_cols:
+                    value = meta.get(c, record.get(c))
+                    keys[c].append("" if value is None else str(value))
+                if cluster_by is not None:
+                    value = meta.get(cluster_by, record.get(cluster_by))
+                    cluster.append("" if value is None else str(value))
+                yield record
+
+    index_maps = build_index_maps(records(), shard_configs)
+    vocabs = {c: np.unique(np.asarray(v).astype(str)) for c, v in keys.items()}
+    cluster_keys = (
+        np.asarray(cluster).astype(str) if cluster_by is not None else None
+    )
+    # vocab = np.unique(keys) is sorted with every key present, so
+    # searchsorted IS the build_game_dataset index mapping
+    entity_idx = {
+        c: np.searchsorted(vocabs[c], np.asarray(v).astype(str)).astype(
+            np.int32
+        )
+        for c, v in keys.items()
+    }
+    scalars = {
+        "labels": np.asarray(labels, dtype=dtype),
+        "offsets": np.asarray(offsets, dtype=dtype),
+        "weights": np.asarray(weights, dtype=dtype),
+        "entity_idx": entity_idx,
+    }
+    return index_maps, vocabs, cluster_keys, indexes, scalars
+
+
 class DenseRecordAssembler:
     """TrainingExampleAvro record dicts -> one fixed-shape dense chunk.
 

@@ -289,10 +289,33 @@ class GameTrainProgram:
         fe_feature_sharded: bool = False,
     ):
         self.task = task
+        # AUTO resolution happens ONCE, at program build: FE coordinates
+        # (big-d, possibly sharded/streamed) take LBFGS; RE/MF coordinates
+        # (small-d dense vmapped buckets) take NEWTON when the loss is
+        # eligible (optim/optimizer.resolve_auto_optimizer) — the measured
+        # 18 vs 48 ms fused-sweep win, now reachable without naming the
+        # solver. Explicit configs pass through untouched.
+        from photon_ml_tpu.optim.optimizer import resolve_auto_optimizer
+
+        _loss_for_auto = loss_for_task(task)
+
+        def _resolved(spec, small_dense):
+            opt = resolve_auto_optimizer(
+                spec.optimizer, loss=_loss_for_auto, small_dense=small_dense
+            )
+            return (
+                spec if opt is spec.optimizer
+                else dataclasses.replace(spec, optimizer=opt)
+            )
+
+        fe = _resolved(fe, False)
         self.fe = fe
-        self.re_specs = tuple(re_specs)
-        self.mf_specs = tuple(mf_specs)
-        self.extra_fes = tuple(extra_fes)
+        self.re_specs = tuple(_resolved(s, True) for s in re_specs)
+        self.mf_specs = tuple(_resolved(s, True) for s in mf_specs)
+        self.extra_fes = tuple(_resolved(s, False) for s in extra_fes)
+        re_specs = self.re_specs
+        mf_specs = self.mf_specs
+        extra_fes = self.extra_fes
         # coordinate names share one namespace: residual skip keys and the
         # GameModel coordinate ids of state_to_game_model (where each FE
         # coordinate is named after its feature shard)

@@ -27,6 +27,15 @@ STREAM_METRIC_PREFIX = "stream/"
 OVERLAP_FRACTION = "stream/overlap_fraction"
 #: chunk count of the most recent epoch
 CHUNKS_PER_EPOCH = "stream/chunks_per_epoch"
+#: streamed-GAME run evidence (algorithm/streaming_game.py): total chunk
+#: LOADS (source decodes — DuHL working-set cache hits don't count; the
+#: cache is exactly what the schedule saves) and chunk VISITS (schedule
+#: entries processed by random-effect solves, loads or hits)
+GAME_CHUNK_LOADS = "stream/game_chunk_loads"
+GAME_CHUNK_VISITS = "stream/game_chunk_visits"
+#: sweeps the most recent streamed-GAME train ran (epochs-to-tolerance
+#: evidence for the DuHL-vs-uniform comparison)
+GAME_SWEEPS = "stream/game_sweeps"
 
 
 def reset_stream_metrics(registry=None) -> None:
@@ -59,6 +68,23 @@ def overlap_fraction() -> float:
 def chunks_per_epoch() -> int:
     value = default_registry().gauge(CHUNKS_PER_EPOCH).value
     return int(value or 0)
+
+
+def set_game_stream_evidence(
+    *, chunk_loads: int, chunk_visits: int, sweeps: int
+) -> None:
+    default_registry().gauge(GAME_CHUNK_LOADS).set(int(chunk_loads))
+    default_registry().gauge(GAME_CHUNK_VISITS).set(int(chunk_visits))
+    default_registry().gauge(GAME_SWEEPS).set(int(sweeps))
+
+
+def game_stream_evidence() -> dict:
+    reg = default_registry()
+    return {
+        "chunk_loads": int(reg.gauge(GAME_CHUNK_LOADS).value or 0),
+        "chunk_visits": int(reg.gauge(GAME_CHUNK_VISITS).value or 0),
+        "sweeps": int(reg.gauge(GAME_SWEEPS).value or 0),
+    }
 
 
 def chunk_decode_summary() -> dict:

@@ -30,6 +30,7 @@ EXPECTED_METRICS = [
     "sparse_giant_fe_composed",
     "sparse_1e8_fe_tron_ms_per_iter",
     "stream_fe_chunked",
+    "stream_game_duhl",
     "serve_microbatch",
 ]
 

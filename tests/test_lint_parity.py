@@ -363,6 +363,39 @@ def test_lint_catches_streaming_jit_closures(tmp_path):
     assert not any("other.py" in p for p in problems)
 
 
+def test_lint_covers_streaming_game_module(tmp_path):
+    """Check 9 scans algorithm/streaming_game.py (the ISSUE 11 streamed
+    GAME path): a nested jit there is reported — the 413 landmine stays
+    structural on the new path — while the sanctioned module-scope
+    decorator-with-batch form passes."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    alg = tmp_path / "photon_ml_tpu" / "algorithm"
+    alg.mkdir(parents=True)
+    (alg / "streaming_game.py").write_text(
+        '"""Cites CoordinateDescent.scala:1."""\n'
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('objective',))\n"
+        "def good_step(table, batch, *, objective):\n"
+        "    return table + objective(batch)\n"
+        "def bad_nested(chunk, table):\n"
+        "    step = jax.jit(lambda t: t + chunk['features'].sum())\n"
+        "    return step(table)\n"
+    )
+    problems = lint_parity.run_lints(tmp_path)
+    assert any(
+        "streaming_game.py:8" in p and "nested" in p for p in problems
+    ), problems
+    assert not any(
+        "streaming_game.py" in p and "good_step" in p for p in problems
+    )
+
+
 def test_lint_catches_serving_jit_closures(tmp_path):
     """Check 9 covers photon_ml_tpu/serving/: a jit built inside a
     serving-module function (closure risk over the resident model's device

@@ -1497,3 +1497,195 @@ class TestServingChaos:
         server.stop()
         with pytest.raises(ServeError, match="not running"):
             server.submit(requests[0])
+
+
+def _streamed_game_fixture(seed=4):
+    """Entity-blocked in-memory GAME fixture for the streamed-GAME chaos
+    tests (algorithm/streaming_game.py)."""
+    from photon_ml_tpu.io.stream_reader import GameArrayChunkSource
+
+    rng = np.random.default_rng(seed)
+    n, d_fe, d_re, n_users = 96, 5, 3, 6
+    ents = np.sort(rng.integers(0, n_users, size=n)).astype(np.int32)
+    x_fe = rng.normal(size=(n, d_fe)).astype(np.float32)
+    x_re = rng.normal(size=(n, d_re)).astype(np.float32)
+    y = (x_fe.sum(1) + 0.1 * rng.normal(size=n)).astype(np.float32)
+    return GameArrayChunkSource(
+        features={"g": x_fe, "p": x_re}, labels=y,
+        entity_idx={"user": ents}, chunk_records=24, cluster_by="user",
+    )
+
+
+def _streamed_game_program(schedule=None, seed=4):
+    from photon_ml_tpu.algorithm.streaming_game import StreamingGameProgram
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig
+    from photon_ml_tpu.parallel.distributed import (
+        FixedEffectStepSpec,
+        RandomEffectStepSpec,
+    )
+    from photon_ml_tpu.types import TaskType
+
+    opt = OptimizerConfig(max_iterations=4)
+    return StreamingGameProgram(
+        TaskType.LINEAR_REGRESSION, _streamed_game_fixture(seed),
+        FixedEffectStepSpec("g", opt, l2_weight=0.5),
+        (RandomEffectStepSpec("user", "p", opt, l2_weight=1.0),),
+        schedule=schedule,
+    )
+
+
+class TestCrashSafeStreamedGameResume:
+    """ISSUE 11 chaos acceptance: a streamed-GAME run killed mid-sweep by
+    a simulated pool preemption resumes via run_with_recovery BITWISE
+    equal to the uninterrupted run; the checkpoint fingerprint covers the
+    chunk plan AND the schedule mode/budget, so a restore under a
+    different working-set budget fails fast naming it."""
+
+    SWEEPS = 4
+
+    def test_preemption_mid_sweep_resumes_and_matches_bitwise(
+            self, tmp_path):
+        from photon_ml_tpu.algorithm.streaming_game import (
+            StreamingGameProgram,
+        )
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+
+        ref = _streamed_game_program().train(num_sweeps=self.SWEEPS)
+
+        ck = TrainingCheckpointer(tmp_path / "sgck")
+        before = (rc.checkpoint_restores(), rc.preemptions())
+        with faultinject.preempt_after_calls(
+            StreamingGameProgram, "_sweep", 2
+        ) as crash:
+            res = run_with_recovery(
+                lambda restart: _streamed_game_program().train(
+                    num_sweeps=self.SWEEPS, checkpointer=ck
+                ),
+                max_restarts=2,
+                checkpointer=ck,
+                description="streamed game chaos",
+            )
+        assert crash["fired"], "the injected preemption never happened"
+        np.testing.assert_array_equal(
+            np.asarray(res.state.fe_coefficients),
+            np.asarray(ref.state.fe_coefficients),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res.state.re_tables["user"]),
+            np.asarray(ref.state.re_tables["user"]),
+        )
+        np.testing.assert_array_equal(res.losses, ref.losses)
+        assert rc.checkpoint_restores() > before[0]
+        assert rc.preemptions() > before[1]
+
+    def test_checkpointing_on_is_bitwise_checkpointing_off(self, tmp_path):
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+
+        base = _streamed_game_program().train(num_sweeps=self.SWEEPS)
+        ck = TrainingCheckpointer(tmp_path / "sgck2")
+        withck = _streamed_game_program().train(
+            num_sweeps=self.SWEEPS, checkpointer=ck
+        )
+        np.testing.assert_array_equal(
+            np.asarray(base.state.fe_coefficients),
+            np.asarray(withck.state.fe_coefficients),
+        )
+        np.testing.assert_array_equal(base.losses, withck.losses)
+        assert ck.latest_step() is not None
+
+    def test_duhl_resume_replays_schedule_bitwise(self, tmp_path):
+        """DuHL schedule state (importances, cursor, warmup progress)
+        rides the checkpoint: the resumed run replays the identical chunk
+        plans, so results stay bitwise."""
+        from photon_ml_tpu.algorithm.streaming_game import (
+            DuHLChunkSchedule,
+            DuHLScheduleConfig,
+            StreamingGameProgram,
+        )
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+
+        def sched(chunks=4):
+            return DuHLChunkSchedule(
+                DuHLScheduleConfig(working_set_chunks=2), chunks
+            )
+
+        def program():
+            p = _streamed_game_program()
+            p.schedule = sched(p.source.num_chunks)
+            return p
+
+        ref = program().train(num_sweeps=self.SWEEPS)
+        ck = TrainingCheckpointer(tmp_path / "dck")
+        with faultinject.preempt_after_calls(
+            StreamingGameProgram, "_sweep", 3
+        ) as crash:
+            res = run_with_recovery(
+                lambda restart: program().train(
+                    num_sweeps=self.SWEEPS, checkpointer=ck
+                ),
+                max_restarts=2,
+                checkpointer=ck,
+                description="streamed game duhl chaos",
+            )
+        assert crash["fired"]
+        np.testing.assert_array_equal(res.losses, ref.losses)
+        np.testing.assert_array_equal(
+            np.asarray(res.state.re_tables["user"]),
+            np.asarray(ref.state.re_tables["user"]),
+        )
+
+    def test_schedule_budget_change_fails_fast_named(self, tmp_path):
+        from photon_ml_tpu.algorithm.streaming_game import (
+            DuHLChunkSchedule,
+            DuHLScheduleConfig,
+        )
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+
+        ck = TrainingCheckpointer(tmp_path / "fck")
+        p = _streamed_game_program()
+        p.schedule = DuHLChunkSchedule(
+            DuHLScheduleConfig(working_set_chunks=2), p.source.num_chunks
+        )
+        p.train(num_sweeps=2, checkpointer=ck)
+        p2 = _streamed_game_program()
+        p2.schedule = DuHLChunkSchedule(
+            DuHLScheduleConfig(working_set_chunks=3), p2.source.num_chunks
+        )
+        with pytest.raises(ValueError, match="working_set_chunks"):
+            p2.train(num_sweeps=2, checkpointer=ck)
+
+    def test_chunk_plan_change_fails_fast_named(self, tmp_path):
+        from photon_ml_tpu.algorithm.streaming_game import (
+            StreamingGameProgram,
+        )
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+        from photon_ml_tpu.io.stream_reader import GameArrayChunkSource
+        from photon_ml_tpu.optim.optimizer import OptimizerConfig
+        from photon_ml_tpu.parallel.distributed import (
+            FixedEffectStepSpec,
+            RandomEffectStepSpec,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        ck = TrainingCheckpointer(tmp_path / "pck")
+        _streamed_game_program().train(num_sweeps=1, checkpointer=ck)
+        # same data, different chunk budget -> different plan fingerprint
+        rng = np.random.default_rng(4)
+        n = 96
+        ents = np.sort(rng.integers(0, 6, size=n)).astype(np.int32)
+        src = GameArrayChunkSource(
+            features={
+                "g": rng.normal(size=(n, 5)).astype(np.float32),
+                "p": rng.normal(size=(n, 3)).astype(np.float32),
+            },
+            labels=rng.normal(size=n).astype(np.float32),
+            entity_idx={"user": ents}, chunk_records=48, cluster_by="user",
+        )
+        opt = OptimizerConfig(max_iterations=4)
+        p2 = StreamingGameProgram(
+            TaskType.LINEAR_REGRESSION, src,
+            FixedEffectStepSpec("g", opt, l2_weight=0.5),
+            (RandomEffectStepSpec("user", "p", opt, l2_weight=1.0),),
+        )
+        with pytest.raises(ValueError, match="num_chunks|chunk_rows"):
+            p2.train(num_sweeps=1, checkpointer=ck)

@@ -1,0 +1,865 @@
+"""Streamed GAME training (io/stream_reader GAME chunk sources +
+algorithm/streaming_game.py): out-of-core coordinate descent with the DuHL
+importance-ordered chunk schedule (ISSUE 11).
+
+The correctness backbone mirrors the repo's other opt-in layers: streamed
+GAME matches the in-core fused path (train_distributed) to float round-off
+on the warm fixture; schedule=None is pinned bitwise against the explicit
+uniform schedule; the chunked FE accumulation is sharding-invariant
+(1 == 8 devices); and DuHL reaches tolerance in strictly fewer chunk
+visits (and loads) than uniform on a gap-skewed fixture. The
+OptimizerType.AUTO satellite (Newton promotion on eligible RE
+coordinates) is pinned here too.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.algorithm.streaming_game import (
+    DuHLChunkSchedule,
+    DuHLScheduleConfig,
+    StreamingGameProgram,
+    UniformChunkSchedule,
+)
+from photon_ml_tpu.data.game_data import (
+    build_game_dataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.io import avro as avro_io
+from photon_ml_tpu.io.stream_reader import (
+    GameArrayChunkSource,
+    GameAvroChunkSource,
+    entities_spanning_chunks,
+    plan_entity_chunks,
+    plan_entity_chunks_avro,
+    scan_game_stream,
+)
+from photon_ml_tpu.optim.optimizer import (
+    OptimizerConfig,
+    OptimizerType,
+    resolve_auto_optimizer,
+)
+from photon_ml_tpu.parallel.distributed import (
+    FixedEffectStepSpec,
+    GameTrainProgram,
+    RandomEffectStepSpec,
+    train_distributed,
+)
+from photon_ml_tpu.types import TaskType
+
+
+def _blocked_entities(rng, n, n_entities):
+    """Entity assignment whose rows are contiguous per entity (the
+    entity-sorted layout streamed GAME clusters on)."""
+    return np.sort(rng.integers(0, n_entities, size=n)).astype(np.int32)
+
+
+def _game_fixture(rng, n=96, d_fe=8, d_re=4, n_users=6, dtype=np.float64):
+    users_idx = _blocked_entities(rng, n, n_users)
+    users = np.array([f"u{i}" for i in users_idx])
+    x_fe = rng.normal(size=(n, d_fe)).astype(dtype)
+    x_re = rng.normal(size=(n, d_re)).astype(dtype)
+    y = (rng.uniform(size=n) < 0.5).astype(dtype)
+    offsets = (0.1 * rng.normal(size=n)).astype(dtype)
+    weights = rng.uniform(0.5, 2.0, size=n).astype(dtype)
+    dataset = build_game_dataset(
+        labels=y,
+        feature_shards={"global": x_fe, "per_entity": x_re},
+        entity_keys={"user": users},
+        offsets=offsets,
+        weights=weights,
+        dtype=dtype,
+    )
+    source = GameArrayChunkSource(
+        features={"global": x_fe, "per_entity": x_re},
+        labels=y,
+        offsets=offsets,
+        weights=weights,
+        entity_idx={"user": np.asarray(dataset.entity_idx["user"])},
+        chunk_records=24,
+        cluster_by="user",
+    )
+    return dataset, source
+
+
+def _specs(max_iter=8, fe_l2=0.1, re_l2=1.0, re_opt=None):
+    opt = OptimizerConfig(max_iterations=max_iter)
+    return (
+        FixedEffectStepSpec("global", opt, l2_weight=fe_l2),
+        (RandomEffectStepSpec("user", "per_entity", re_opt or opt,
+                              l2_weight=re_l2),),
+    )
+
+
+# ---------------------------------------------------------------------------
+# entity-clustered chunk planning
+# ---------------------------------------------------------------------------
+
+
+class TestEntityChunkPlanning:
+    def test_whole_entities_never_split(self):
+        ents = np.repeat(np.arange(5), [3, 10, 2, 7, 4])
+        plan = plan_entity_chunks(ents, 8)
+        assert sum(len(c) for c in plan) == len(ents)
+        assert len(entities_spanning_chunks(plan, ents)) == 0
+        # every chunk respects the budget unless one entity exceeds it
+        for rows in plan:
+            groups = np.unique(ents[rows])
+            assert len(rows) <= 8 or len(groups) == 1
+
+    def test_oversized_entity_forms_its_own_chunk(self):
+        ents = np.repeat([0, 1, 2], [4, 20, 4])
+        plan = plan_entity_chunks(ents, 8)
+        sizes = sorted(len(c) for c in plan)
+        assert 20 in sizes
+        assert len(entities_spanning_chunks(plan, ents)) == 0
+
+    def test_absent_entities_split_freely(self):
+        ents = np.full(30, -1, dtype=np.int64)
+        plan = plan_entity_chunks(ents, 8)
+        assert all(len(c) <= 8 for c in plan)
+        assert sum(len(c) for c in plan) == 30
+
+    def test_row_order_within_entity_preserved(self):
+        ents = np.array([1, 0, 1, 0, 1, 0])
+        plan = plan_entity_chunks(ents, 6)
+        rows = np.concatenate(plan)
+        # entity 0's rows ascend, entity 1's rows ascend
+        assert list(rows[ents[rows] == 0]) == [1, 3, 5]
+        assert list(rows[ents[rows] == 1]) == [0, 2, 4]
+
+    def test_spanning_detection(self):
+        ents = np.array([0, 0, 1, 1])
+        plan = [np.array([0, 1, 2]), np.array([3])]
+        assert list(entities_spanning_chunks(plan, ents)) == [1]
+
+    def test_rejects_nonpositive_budget(self):
+        with pytest.raises(ValueError, match="positive"):
+            plan_entity_chunks(np.zeros(4, int), 0)
+
+
+# ---------------------------------------------------------------------------
+# streamed vs in-core agreement
+# ---------------------------------------------------------------------------
+
+
+class TestStreamedGameParity:
+    def test_streamed_matches_incore_train_distributed(self, rng):
+        dataset, source = _game_fixture(rng)
+        fe, res = _specs()
+        re_ds = {
+            "user": build_random_effect_dataset(
+                dataset, "user", "per_entity", bucket_sizes=(8, 32, 128)
+            )
+        }
+        ref = train_distributed(
+            GameTrainProgram(TaskType.LOGISTIC_REGRESSION, fe, res),
+            dataset, re_ds, num_iterations=2,
+        )
+        program = StreamingGameProgram(
+            TaskType.LOGISTIC_REGRESSION, source, fe, res,
+            num_entities={"user": len(dataset.entity_vocabs["user"])},
+            bucket_sizes=(8, 32, 128),
+        )
+        streamed = program.train(num_sweeps=2)
+        np.testing.assert_allclose(
+            np.asarray(streamed.state.fe_coefficients),
+            np.asarray(ref.state.fe_coefficients),
+            rtol=1e-9, atol=1e-9,
+        )
+        np.testing.assert_allclose(
+            np.asarray(streamed.state.re_tables["user"]),
+            np.asarray(ref.state.re_tables["user"]),
+            rtol=1e-9, atol=1e-9,
+        )
+        np.testing.assert_allclose(streamed.losses, ref.losses, rtol=1e-9)
+
+    def test_multi_re_streamed_matches_incore(self, rng):
+        """Two RE coordinates with nested groupings: the chunk-outer RE
+        phase (one decode per chunk for ALL coordinates) must still
+        replay the coordinate-outer Gauss-Seidel recursion exactly."""
+        n, n_users = 96, 6
+        users_idx = _blocked_entities(rng, n, n_users)
+        # "site" nests inside "user" groups (2 sites per user), so one
+        # entity-clustered plan serves both coordinates
+        site_idx = (users_idx * 2 + (np.arange(n) % 2)).astype(np.int32)
+        users = np.array([f"u{i}" for i in users_idx])
+        sites = np.array([f"s{i}" for i in site_idx])
+        x_fe = rng.normal(size=(n, 6))
+        x_re = rng.normal(size=(n, 3))
+        y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+        dataset = build_game_dataset(
+            labels=y,
+            feature_shards={"global": x_fe, "per_entity": x_re},
+            entity_keys={"user": users, "site": sites},
+            dtype=np.float64,
+        )
+        re_ds = {
+            t: build_random_effect_dataset(
+                dataset, t, "per_entity", bucket_sizes=(8, 32, 128)
+            )
+            for t in ("user", "site")
+        }
+        opt = OptimizerConfig(max_iterations=6)
+        fe = FixedEffectStepSpec("global", opt, l2_weight=0.1)
+        res = (
+            RandomEffectStepSpec("user", "per_entity", opt, l2_weight=1.0),
+            RandomEffectStepSpec("site", "per_entity", opt, l2_weight=1.0),
+        )
+        ref = train_distributed(
+            GameTrainProgram(TaskType.LOGISTIC_REGRESSION, fe, res),
+            dataset, re_ds, num_iterations=2,
+        )
+        source = GameArrayChunkSource(
+            features={"global": x_fe, "per_entity": x_re},
+            labels=y,
+            entity_idx={
+                "user": np.asarray(dataset.entity_idx["user"]),
+                "site": np.asarray(dataset.entity_idx["site"]),
+            },
+            chunk_records=24,
+            cluster_by="user",
+        )
+        program = StreamingGameProgram(
+            TaskType.LOGISTIC_REGRESSION, source, fe, res,
+            num_entities={
+                t: len(dataset.entity_vocabs[t]) for t in ("user", "site")
+            },
+            bucket_sizes=(8, 32, 128),
+        )
+        streamed = program.train(num_sweeps=2)
+        for t in ("user", "site"):
+            np.testing.assert_allclose(
+                np.asarray(streamed.state.re_tables[t]),
+                np.asarray(ref.state.re_tables[t]),
+                rtol=1e-9, atol=1e-9,
+            )
+        np.testing.assert_allclose(
+            np.asarray(streamed.state.fe_coefficients),
+            np.asarray(ref.state.fe_coefficients),
+            rtol=1e-9, atol=1e-9,
+        )
+        np.testing.assert_allclose(streamed.losses, ref.losses, rtol=1e-9)
+
+    def test_chunk_count_is_layout_not_semantics(self, rng):
+        """1 chunk == many chunks to round-off (the PR 7 rule, GAME-wide)."""
+        dataset, _ = _game_fixture(rng)
+        fe, res = _specs()
+        results = []
+        for chunk_records in (96, 24):
+            source = GameArrayChunkSource(
+                features={
+                    "global": dataset.host_array("shard/global"),
+                    "per_entity": dataset.host_array("shard/per_entity"),
+                },
+                labels=dataset.host_array("labels"),
+                offsets=dataset.host_array("offsets"),
+                weights=dataset.host_array("weights"),
+                entity_idx={"user": dataset.host_array("entity_idx/user")},
+                chunk_records=chunk_records,
+                cluster_by="user",
+            )
+            program = StreamingGameProgram(
+                TaskType.LOGISTIC_REGRESSION, source, fe, res,
+                num_entities={"user": len(dataset.entity_vocabs["user"])},
+            )
+            out = program.train(num_sweeps=2)
+            results.append(
+                (np.asarray(out.state.fe_coefficients),
+                 np.asarray(out.state.re_tables["user"]), out.losses)
+            )
+        (fe1, re1, l1), (fen, ren, ln) = results
+        np.testing.assert_allclose(fen, fe1, rtol=1e-9, atol=1e-9)
+        np.testing.assert_allclose(ren, re1, rtol=1e-9, atol=1e-9)
+        np.testing.assert_allclose(ln, l1, rtol=1e-9)
+
+    @pytest.mark.parametrize("devices", [1, 8])
+    def test_sharding_invariance_of_streamed_sweep(self, rng, devices):
+        from jax.sharding import Mesh
+
+        dataset, source = _game_fixture(rng)
+        fe, res = _specs()
+        mesh = Mesh(
+            np.asarray(jax.devices()[:devices]).reshape(devices), ("data",)
+        )
+        program = StreamingGameProgram(
+            TaskType.LOGISTIC_REGRESSION, source, fe, res,
+            num_entities={"user": len(dataset.entity_vocabs["user"])},
+            mesh=mesh,
+        )
+        out = program.train(num_sweeps=2)
+        # reference: unsharded streamed run on identical inputs
+        _, ref_source = _game_fixture(np.random.default_rng(0))
+        ref_program = StreamingGameProgram(
+            TaskType.LOGISTIC_REGRESSION, ref_source, fe, res,
+            num_entities={"user": len(dataset.entity_vocabs["user"])},
+        )
+        ref = ref_program.train(num_sweeps=2)
+        np.testing.assert_allclose(
+            np.asarray(out.state.fe_coefficients),
+            np.asarray(ref.state.fe_coefficients),
+            rtol=1e-9, atol=1e-9,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out.state.re_tables["user"]),
+            np.asarray(ref.state.re_tables["user"]),
+            rtol=1e-9, atol=1e-9,
+        )
+
+    def test_entity_spanning_chunks_fails_fast(self, rng):
+        dataset, _ = _game_fixture(rng)
+        # un-clustered plan: plain row ranges split entities across chunks
+        source = GameArrayChunkSource(
+            features={
+                "global": dataset.host_array("shard/global"),
+                "per_entity": dataset.host_array("shard/per_entity"),
+            },
+            labels=dataset.host_array("labels"),
+            entity_idx={"user": dataset.host_array("entity_idx/user")},
+            chunk_records=10,  # no cluster_by: boundaries ignore entities
+        )
+        fe, res = _specs()
+        with pytest.raises(ValueError, match="span chunk boundaries"):
+            StreamingGameProgram(
+                TaskType.LOGISTIC_REGRESSION, source, fe, res,
+                num_entities={"user": len(dataset.entity_vocabs["user"])},
+            )
+
+
+# ---------------------------------------------------------------------------
+# schedules: uniform bitwise pin + DuHL fewer visits
+# ---------------------------------------------------------------------------
+
+
+def _skewed_fixture(seed=3):
+    """Gap-skewed data: HOT entities couple to the FE signal (their
+    residuals move every sweep); COLD entities see zero FE features, so
+    their per-entity optimum never moves after the first solve."""
+    rng = np.random.default_rng(seed)
+    d_fe, d_re = 6, 4
+    hot_rows, cold_rows = 256, 768
+    n = hot_rows + cold_rows
+    ents = np.concatenate([
+        np.repeat(np.arange(4), hot_rows // 4),
+        4 + np.arange(cold_rows) // 8,
+    ]).astype(np.int32)
+    x_fe = rng.normal(size=(n, d_fe))
+    x_fe[hot_rows:] = 0.0
+    x_re = rng.normal(size=(n, d_re))
+    w_fe = rng.normal(size=d_fe)
+    w_re = 0.5 * rng.normal(size=(int(ents.max()) + 1, d_re))
+    w_re[:4] *= 6.0
+    y = x_fe @ w_fe + (x_re * w_re[ents]).sum(1) + 0.05 * rng.normal(size=n)
+    return x_fe, x_re, y, ents
+
+
+def _run_skewed(schedule_factory, tol=1e-5, sweeps=10):
+    x_fe, x_re, y, ents = _skewed_fixture()
+    source = GameArrayChunkSource(
+        features={"g": x_fe, "p": x_re}, labels=y,
+        entity_idx={"user": ents}, chunk_records=64, cluster_by="user",
+    )
+    opt = OptimizerConfig(max_iterations=6)
+    program = StreamingGameProgram(
+        TaskType.LINEAR_REGRESSION, source,
+        FixedEffectStepSpec("g", opt, l2_weight=0.1),
+        (RandomEffectStepSpec("user", "p", opt, l2_weight=1.0),),
+        schedule=schedule_factory(source.num_chunks),
+    )
+    return program.train(num_sweeps=sweeps, tolerance=tol)
+
+
+class TestChunkSchedules:
+    def test_schedule_none_bitwise_uniform_schedule(self):
+        base = _run_skewed(lambda c: None, sweeps=3, tol=0.0)
+        uni = _run_skewed(lambda c: UniformChunkSchedule(c), sweeps=3,
+                          tol=0.0)
+        assert base.losses == uni.losses
+        np.testing.assert_array_equal(
+            np.asarray(base.state.fe_coefficients),
+            np.asarray(uni.state.fe_coefficients),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(base.state.re_tables["user"]),
+            np.asarray(uni.state.re_tables["user"]),
+        )
+
+    def test_duhl_reaches_tolerance_in_fewer_chunk_visits(self):
+        uniform = _run_skewed(lambda c: None)
+        duhl = _run_skewed(
+            lambda c: DuHLChunkSchedule(
+                DuHLScheduleConfig(working_set_chunks=4,
+                                   tail_chunks_per_sweep=1),
+                c,
+            )
+        )
+        # strictly fewer RE chunk visits AND fewer source decodes, at a
+        # comparable final loss (the acceptance criterion, same-run pair)
+        assert duhl.chunk_visits < uniform.chunk_visits
+        assert duhl.chunk_loads < uniform.chunk_loads
+        assert abs(duhl.losses[-1] - uniform.losses[-1]) < 5e-3
+        assert np.isfinite(duhl.losses).all()
+
+    def test_duhl_plan_warmup_then_working_set(self):
+        cfg = DuHLScheduleConfig(working_set_chunks=2,
+                                 tail_chunks_per_sweep=1, warmup_sweeps=2)
+        sched = DuHLChunkSchedule(cfg, 6)
+        assert sched.plan_sweep() == list(range(6))
+        sched.sweep_done()
+        assert sched.plan_sweep() == list(range(6))  # warmup sweep 2
+        for c, imp in enumerate([0.1, 5.0, 0.2, 9.0, 0.0, 0.3]):
+            sched.record(c, imp)
+        sched.sweep_done()
+        plan = sched.plan_sweep()
+        assert set([1, 3]).issubset(plan)  # the two hottest pinned
+        assert len(plan) == 3  # + one round-robin tail chunk
+        assert sched.pinned() == {1, 3}
+
+    def test_duhl_state_roundtrip(self):
+        cfg = DuHLScheduleConfig(working_set_chunks=2)
+        a = DuHLChunkSchedule(cfg, 4)
+        a.record(2, 7.0)
+        a.sweep_done()
+        a.sweep_done()
+        a.cursor = 3
+        b = DuHLChunkSchedule(cfg, 4)
+        b.load_state(a.state_dict())
+        assert b.plan_sweep() == a.plan_sweep()
+
+    def test_schedule_config_validation(self):
+        with pytest.raises(ValueError, match="working_set_chunks"):
+            DuHLScheduleConfig(working_set_chunks=0)
+        with pytest.raises(ValueError, match="tail_chunks_per_sweep"):
+            DuHLScheduleConfig(working_set_chunks=1, tail_chunks_per_sweep=0)
+
+
+# ---------------------------------------------------------------------------
+# Avro GAME chunk source
+# ---------------------------------------------------------------------------
+
+
+SCHEMA = {
+    "type": "record",
+    "name": "TrainingExampleAvro",
+    "fields": [
+        {"name": "uid", "type": ["string", "null"], "default": None},
+        {"name": "label", "type": "double"},
+        {"name": "userId", "type": ["string", "null"], "default": None},
+        {
+            "name": "features",
+            "type": {
+                "type": "array",
+                "items": {
+                    "type": "record",
+                    "name": "FeatureAvro",
+                    "fields": [
+                        {"name": "name", "type": "string"},
+                        {"name": "term", "type": ["string", "null"],
+                         "default": None},
+                        {"name": "value", "type": "double"},
+                    ],
+                },
+            },
+        },
+        {"name": "weight", "type": ["double", "null"], "default": None},
+        {"name": "offset", "type": ["double", "null"], "default": None},
+    ],
+}
+
+
+def _avro_game_records(n=200, d=5, n_users=8, seed=7):
+    rng = np.random.default_rng(seed)
+    users = np.sort(rng.integers(0, n_users, size=n))
+    recs = []
+    for i in range(n):
+        x = rng.normal(size=d)
+        recs.append({
+            "uid": str(i),
+            "label": float(x.sum() + 0.1 * rng.normal()),
+            "userId": f"u{users[i]:02d}",
+            "features": [
+                {"name": f"f{j}", "term": "", "value": float(x[j])}
+                for j in range(d)
+            ],
+            "weight": float(rng.uniform(0.5, 2.0)),
+            "offset": float(0.1 * rng.normal()),
+        })
+    return recs
+
+
+def _write_avro(tmp_path, records, block_records=16):
+    data = tmp_path / "train"
+    os.makedirs(data, exist_ok=True)
+    avro_io.write_container(
+        str(data / "part-00000.avro"), SCHEMA, records,
+        block_records=block_records,
+    )
+    return str(data)
+
+
+class TestGameAvroChunkSource:
+    def test_record_granular_entity_boundaries(self, tmp_path):
+        records = _avro_game_records()
+        path = _write_avro(tmp_path, records)
+        from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
+
+        cfg = {"global": FeatureShardConfiguration(feature_bags=("features",))}
+        files = avro_io.list_avro_files(path)
+        _maps, _vocabs, keys, indexes, _scalars = scan_game_stream(
+            files, cfg, ("userId",), cluster_by="userId"
+        )
+        specs, _, starts, _skips = plan_entity_chunks_avro(
+            files, 40, keys, indexes=indexes
+        )
+        assert len(specs) > 1
+        assert sum(s.num_records for s in specs) == len(records)
+        # every boundary closes an entity: key changes across it
+        for start in starts[1:]:
+            assert keys[start - 1] != keys[start]
+
+    def test_chunks_bitwise_match_full_read(self, tmp_path):
+        """Concatenated chunk arrays equal the in-core read (same index
+        maps, same per-record semantics) — entity clustering only
+        permutes nothing on an entity-sorted input."""
+        from photon_ml_tpu.io.data_reader import (
+            FeatureShardConfiguration,
+            read_merged,
+        )
+
+        records = _avro_game_records()
+        path = _write_avro(tmp_path, records)
+        cfg = {"global": FeatureShardConfiguration(feature_bags=("features",))}
+        full = read_merged(path, cfg, random_effect_id_columns=("userId",))
+        files = avro_io.list_avro_files(path)
+        maps, vocabs, keys, indexes, scalars = scan_game_stream(
+            files, cfg, ("userId",), cluster_by="userId"
+        )
+        assert maps["global"].size == full.index_maps["global"].size
+        np.testing.assert_array_equal(
+            vocabs["userId"], full.dataset.entity_vocabs["userId"]
+        )
+        source = GameAvroChunkSource(
+            files, cfg, maps,
+            chunk_records=40,
+            random_effect_id_columns=("userId",),
+            entity_vocabs=vocabs,
+            cluster_by="userId",
+            cluster_keys=keys,
+            indexes=indexes,
+        )
+        feats, labels, offsets, weights, ents, rows = [], [], [], [], [], []
+        for spec in source.specs:
+            chunk = source.load(spec)
+            m = chunk.num_records
+            feats.append(chunk.features["global"][:m])
+            labels.append(chunk.labels[:m])
+            offsets.append(chunk.offsets[:m])
+            weights.append(chunk.weights[:m])
+            ents.append(chunk.entity_idx["userId"][:m])
+            rows.append(chunk.rows[:m])
+        order = np.argsort(np.concatenate(rows))
+        ds = full.dataset
+        np.testing.assert_array_equal(
+            np.concatenate(feats)[order],
+            np.asarray(ds.feature_shards["global"]),
+        )
+        np.testing.assert_array_equal(
+            np.concatenate(labels)[order], np.asarray(ds.labels))
+        np.testing.assert_array_equal(
+            np.concatenate(offsets)[order], np.asarray(ds.offsets))
+        np.testing.assert_array_equal(
+            np.concatenate(weights)[order], np.asarray(ds.weights))
+        np.testing.assert_array_equal(
+            np.concatenate(ents)[order], np.asarray(ds.entity_idx["userId"]))
+
+
+# ---------------------------------------------------------------------------
+# the streamed GAME driver path
+# ---------------------------------------------------------------------------
+
+
+class TestStreamingGameDriver:
+    def _run(self, path, out, extra=()):
+        from photon_ml_tpu.cli import game_training_driver
+
+        return game_training_driver.main([
+            "--input-data-path", str(path),
+            "--root-output-dir", str(out),
+            "--task-type", "LINEAR_REGRESSION",
+            "--feature-shard-configurations",
+            "name=global,feature.bags=features",
+            "--coordinate-configurations",
+            "name=fe,feature.shard=global,reg.weights=0.1,max.iter=5",
+            "--coordinate-configurations",
+            "name=per-user,feature.shard=global,"
+            "random.effect.type=userId,reg.weights=1,max.iter=5",
+            "--coordinate-descent-iterations", "2",
+            *extra,
+        ])
+
+    def test_streamed_driver_trains_and_saves(self, tmp_path):
+        path = _write_avro(tmp_path, _avro_game_records())
+        summary = self._run(
+            path, tmp_path / "out",
+            ["--streaming-chunks", "48", "--duhl-working-set", "2"],
+        )
+        assert summary["streaming"]["chunks"] > 1
+        assert summary["streaming"]["schedule"] == "duhl"
+        assert summary["streaming"]["chunk_loads"] > 0
+        assert np.isfinite(summary["losses"]).all()
+        assert (tmp_path / "out" / "best").is_dir()
+        assert (tmp_path / "out" / "training-summary.json").is_file()
+
+    def test_streamed_driver_matches_incore_driver(self, tmp_path):
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        path = _write_avro(tmp_path, _avro_game_records(n=160))
+        self._run(path, tmp_path / "a")
+        self._run(path, tmp_path / "b", ["--streaming-chunks", "40"])
+        from photon_ml_tpu.io.index_map import IndexMap
+
+        maps = IndexMap.load_directory(str(tmp_path / "b" / "index-maps"))
+        incore = load_game_model(str(tmp_path / "a" / "best"), maps)
+        streamed = load_game_model(str(tmp_path / "b" / "best"), maps)
+        np.testing.assert_allclose(
+            np.asarray(streamed.models["fe"].glm.coefficients.means),
+            np.asarray(incore.models["fe"].glm.coefficients.means),
+            rtol=2e-3, atol=2e-3,  # driver trains in f32
+        )
+
+    @pytest.mark.parametrize("extra,match", [
+        (["--distributed"], "single-process"),
+        (["--normalization", "STANDARDIZATION"], "NONE"),
+        (["--hyperparameter-tuning", "BAYESIAN"], "tuning"),
+        (["--input-format", "libsvm"], "Avro"),
+    ])
+    def test_driver_rejects_unsupported_combinations(
+            self, tmp_path, extra, match):
+        path = _write_avro(tmp_path, _avro_game_records(n=40))
+        with pytest.raises(ValueError, match=match):
+            self._run(path, tmp_path / "out",
+                      ["--streaming-chunks", "20", *extra])
+
+    def test_driver_rejects_newton_on_streamed_fe(self, tmp_path):
+        from photon_ml_tpu.cli import game_training_driver
+
+        path = _write_avro(tmp_path, _avro_game_records(n=40))
+        with pytest.raises(ValueError, match="TRON or LBFGS"):
+            game_training_driver.main([
+                "--input-data-path", str(path),
+                "--root-output-dir", str(tmp_path / "out"),
+                "--task-type", "LINEAR_REGRESSION",
+                "--feature-shard-configurations",
+                "name=global,feature.bags=features",
+                "--coordinate-configurations",
+                "name=fe,feature.shard=global,optimizer=NEWTON,"
+                "reg.weights=0.1",
+                "--streaming-chunks", "20",
+            ])
+
+    def test_duhl_flag_requires_streaming(self, tmp_path):
+        path = _write_avro(tmp_path, _avro_game_records(n=40))
+        with pytest.raises(ValueError, match="streaming-chunks"):
+            self._run(path, tmp_path / "out", ["--duhl-working-set", "2"])
+
+
+# ---------------------------------------------------------------------------
+# OptimizerType.AUTO (satellite): Newton promotion on eligible REs
+# ---------------------------------------------------------------------------
+
+
+class TestAutoOptimizer:
+    def test_resolution_rules(self):
+        from photon_ml_tpu.ops.losses import loss_for_task
+
+        logistic = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+        auto = OptimizerConfig(optimizer_type=OptimizerType.AUTO)
+        # small-d dense vmapped shape + twice-differentiable loss -> NEWTON
+        assert resolve_auto_optimizer(
+            auto, loss=logistic, small_dense=True
+        ).optimizer_type == OptimizerType.NEWTON
+        # FE / big-d shape -> LBFGS
+        assert resolve_auto_optimizer(
+            auto, loss=logistic, small_dense=False
+        ).optimizer_type == OptimizerType.LBFGS
+        # L1 blocks Newton — and resolves straight to OWLQN (plain LBFGS
+        # would silently drop l1_weight at spec sites with no later flip)
+        assert resolve_auto_optimizer(
+            auto.with_l1(0.5), loss=logistic, small_dense=True
+        ).optimizer_type == OptimizerType.OWLQN
+        # non-twice-differentiable loss -> LBFGS
+        from photon_ml_tpu.ops.losses import loss_for_task as lft
+
+        hinge = lft(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM)
+        assert resolve_auto_optimizer(
+            auto, loss=hinge, small_dense=True
+        ).optimizer_type == OptimizerType.LBFGS
+        # explicit configs pass through
+        explicit = OptimizerConfig(optimizer_type=OptimizerType.TRON)
+        assert resolve_auto_optimizer(
+            explicit, loss=logistic, small_dense=True
+        ) is explicit
+
+    def test_solve_rejects_unresolved_auto(self, rng):
+        from photon_ml_tpu.data.batch import LabeledPointBatch
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.ops.objective import BoundObjective, GLMObjective
+        from photon_ml_tpu.optim.optimizer import solve
+
+        x = rng.normal(size=(16, 3))
+        batch = LabeledPointBatch(
+            features=jnp.asarray(x),
+            labels=jnp.asarray((rng.uniform(size=16) < 0.5).astype(float)),
+            offsets=jnp.zeros(16), weights=jnp.ones(16),
+        )
+        obj = BoundObjective(
+            GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION), 0.1),
+            batch,
+        )
+        with pytest.raises(ValueError, match="resolve_auto_optimizer"):
+            solve(OptimizerConfig(optimizer_type=OptimizerType.AUTO), obj,
+                  jnp.zeros(3))
+
+    def test_fused_program_auto_promotes_re_to_newton(self, rng):
+        """AUTO on the fused program's coordinates == explicit NEWTON REs
+        + LBFGS FE, bitwise (resolution happens at program build)."""
+        users = np.sort(rng.integers(0, 6, size=64))
+        dataset = build_game_dataset(
+            labels=(rng.uniform(size=64) < 0.5).astype(np.float64),
+            feature_shards={
+                "global": rng.normal(size=(64, 6)),
+                "per_entity": rng.normal(size=(64, 3)),
+            },
+            entity_keys={"user": np.array([f"u{i}" for i in users])},
+            dtype=np.float64,
+        )
+        re_ds = {
+            "user": build_random_effect_dataset(
+                dataset, "user", "per_entity", bucket_sizes=(64,)
+            )
+        }
+
+        def train(opt_type):
+            opt = OptimizerConfig(optimizer_type=opt_type, max_iterations=5)
+            lbfgs = OptimizerConfig(max_iterations=5)
+            program = GameTrainProgram(
+                TaskType.LOGISTIC_REGRESSION,
+                FixedEffectStepSpec(
+                    "global",
+                    lbfgs if opt_type != OptimizerType.AUTO else opt,
+                    l2_weight=0.1,
+                ),
+                (RandomEffectStepSpec("user", "per_entity", opt,
+                                      l2_weight=1.0),),
+            )
+            return program, train_distributed(
+                program, dataset, re_ds, num_iterations=2
+            )
+
+        auto_prog, auto = train(OptimizerType.AUTO)
+        newton_prog, newton = train(OptimizerType.NEWTON)
+        assert (
+            auto_prog.re_specs[0].optimizer.optimizer_type
+            == OptimizerType.NEWTON
+        )
+        assert (
+            auto_prog.fe.optimizer.optimizer_type == OptimizerType.LBFGS
+        )
+        np.testing.assert_array_equal(
+            np.asarray(auto.state.re_tables["user"]),
+            np.asarray(newton.state.re_tables["user"]),
+        )
+        np.testing.assert_array_equal(auto.losses, newton.losses)
+
+    def test_cd_coordinate_auto_matches_newton(self, rng):
+        """The host-loop CD path's RandomEffectCoordinate resolves AUTO to
+        NEWTON through _solve_config."""
+        from photon_ml_tpu.algorithm.coordinates import (
+            CoordinateOptimizationConfig,
+            RandomEffectCoordinate,
+        )
+
+        users = np.sort(rng.integers(0, 5, size=48))
+        dataset = build_game_dataset(
+            labels=(rng.uniform(size=48) < 0.5).astype(np.float64),
+            feature_shards={"per_entity": rng.normal(size=(48, 3))},
+            entity_keys={"user": np.array([f"u{i}" for i in users])},
+            dtype=np.float64,
+        )
+        re_ds = build_random_effect_dataset(
+            dataset, "user", "per_entity", bucket_sizes=(48,)
+        )
+
+        def fit(opt_type):
+            coord = RandomEffectCoordinate(
+                coordinate_id="re",
+                dataset=dataset,
+                re_dataset=re_ds,
+                task=TaskType.LOGISTIC_REGRESSION,
+                config=CoordinateOptimizationConfig(
+                    optimizer=OptimizerConfig(
+                        optimizer_type=opt_type, max_iterations=5
+                    ),
+                    l2_weight=1.0,
+                ),
+            )
+            model, _ = coord.update_model(coord.initial_model())
+            return np.asarray(model.coefficients)
+
+        np.testing.assert_array_equal(
+            fit(OptimizerType.AUTO), fit(OptimizerType.NEWTON)
+        )
+
+    def test_train_glm_auto_resolves_to_lbfgs(self, rng):
+        from photon_ml_tpu.data.batch import LabeledPointBatch
+        from photon_ml_tpu.estimators import train_glm
+
+        x = rng.normal(size=(64, 5))
+        y = (rng.uniform(size=64) < 0.5).astype(np.float64)
+        batch = LabeledPointBatch(
+            features=jnp.asarray(x), labels=jnp.asarray(y),
+            offsets=jnp.zeros(64), weights=jnp.ones(64),
+        )
+        auto = train_glm(
+            batch, TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerConfig(
+                optimizer_type=OptimizerType.AUTO, max_iterations=10
+            ),
+            regularization_weights=(0.5,),
+        )
+        lbfgs = train_glm(
+            batch, TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerConfig(max_iterations=10),
+            regularization_weights=(0.5,),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(auto[0.5].coefficients.means),
+            np.asarray(lbfgs[0.5].coefficients.means),
+        )
+
+    def test_streamed_game_auto_promotes_re(self, rng):
+        dataset, source = _game_fixture(rng)
+        auto = OptimizerConfig(optimizer_type=OptimizerType.AUTO,
+                               max_iterations=5)
+        program = StreamingGameProgram(
+            TaskType.LOGISTIC_REGRESSION, source,
+            FixedEffectStepSpec("global", auto, l2_weight=0.1),
+            (RandomEffectStepSpec("user", "per_entity", auto,
+                                  l2_weight=1.0),),
+            num_entities={"user": len(dataset.entity_vocabs["user"])},
+        )
+        assert (
+            program.re_specs[0].optimizer.optimizer_type
+            == OptimizerType.NEWTON
+        )
+        assert (
+            program.fe.optimizer.optimizer_type == OptimizerType.LBFGS
+        )
+        out = program.train(num_sweeps=1)
+        assert np.isfinite(out.losses).all()
