@@ -91,6 +91,48 @@ def render_report(report: dict) -> str:
     return json.dumps(report, separators=(",", ":"))
 
 
+def write_sidecar(report: dict, directory: str, *, config: dict | None = None):
+    """The full UNSLIMMED report as ``<dir>/bench-report.json`` (ISSUE 12):
+    never subject to the driver's 2,000-byte tail, every row's compact unit
+    pre-parsed into typed fields (telemetry/bench_history.parse_unit), so
+    ``dev/doctor.py`` reads structure instead of regexing the captured
+    line — and prefers this file when present. The stdout contract is
+    untouched: the ONE JSON line stays the driver's official record.
+    Written atomically (tmp + os.replace); returns the final path."""
+    import tempfile
+
+    from photon_ml_tpu.telemetry.bench_history import (
+        SIDECAR_FILENAME,
+        parse_unit,
+    )
+
+    def with_parsed(row: dict) -> dict:
+        return dict(row, parsed_unit=parse_unit(row["metric"], row["unit"]))
+
+    sidecar = {
+        "schema": 1,
+        "kind": "bench_report",
+        "config": config or {},
+        "report": dict(
+            with_parsed(report),
+            extra_metrics=[with_parsed(r) for r in report["extra_metrics"]],
+        ),
+    }
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, SIDECAR_FILENAME)
+    fd, staged = tempfile.mkstemp(dir=directory, prefix=".bench-report-",
+                                  suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(sidecar, f, indent=2)
+        os.replace(staged, path)
+    except BaseException:
+        if os.path.exists(staged):
+            os.unlink(staged)
+        raise
+    return path
+
+
 def _unit_primary(lane_iters: int, grid_sec: float) -> str:
     return (
         f"ex*it/s {GRID}lam n=2^18 d={D} "
@@ -1254,6 +1296,12 @@ def main():
     if telemetry_dir:
         from photon_ml_tpu.telemetry import RunJournal
 
+        # the full unslimmed report rides a sidecar the doctor prefers
+        # over the tail-captured line (ISSUE 12)
+        write_sidecar(
+            report, telemetry_dir,
+            config={"n": N, "d": D, "grid": GRID, "max_iter": MAX_ITER},
+        )
         with RunJournal(telemetry_dir, filename="bench-journal.jsonl") as journal:
             journal.record("config", n=N, d=D, grid=GRID, max_iter=MAX_ITER)
             for row in extra:

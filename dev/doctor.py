@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Run doctor: one offline findings report over a run directory's evidence.
+
+Takes a directory holding any mix of driver bench artifacts
+(``BENCH_r*.json`` / ``MULTICHIP_r*.json``), the ``bench-report.json``
+sidecar, JSONL run journals (``run-journal.jsonl`` and friends — with
+``--live`` also their crash-durable ``.partial`` stage files), and per-rank
+``trace-*.json`` files, and emits ONE report:
+
+- a verdict per bench row (telemetry/verdicts.py — the BASELINE.md same-run
+  win criteria as code), with known pathology signatures named with their
+  measured causes (negative marginals, ~40x contention blowouts,
+  ``parsed: null`` tail overruns);
+- cross-round history findings (improvements, plateaus) in each rule's
+  declared direction;
+- registry-counter cross-checks from the journal snapshot
+  (overlap_fraction ~ 0 with prefetch on, high serve pad fraction,
+  quarantined blocks, preemption restarts, exhausted restart budgets) plus
+  the last heartbeat cursor and failure rows of a crashed/in-flight run;
+- the straggler table from the per-rank trace files (dev/trace_summary.py
+  machinery — online and offline reports share one implementation).
+
+Exit status: nonzero iff the CURRENT round (the sidecar when present, else
+the highest BENCH round) contains a row that LOST its registered win
+criterion — so "fold the bench results into BASELINE.md" (ROADMAP item 1)
+starts from a machine verdict, not from hand-decoding unit strings.
+Historical pathologies (the r04/r05 ``parsed: null`` captures) are
+reported but only fail under ``--strict``.
+
+Run from the repo root (judges the checked-in history) or point it at a
+production run's ``--telemetry-dir``:
+
+    python -m dev.doctor [RUN_DIR] [--live] [--strict] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from photon_ml_tpu.telemetry import bench_history, verdicts  # noqa: E402
+from photon_ml_tpu.telemetry.journal import (  # noqa: E402
+    JOURNAL_PARTIAL_SUFFIX as PARTIAL_SUFFIX,
+    heartbeat_cursor,
+    read_journal,
+)
+
+#: journal basenames the doctor looks for (plus their .partial stages)
+JOURNAL_GLOB = "*.jsonl"
+
+
+def _find_journals(directory: str, live: bool) -> list[str]:
+    paths = sorted(glob.glob(os.path.join(directory, JOURNAL_GLOB)))
+    if live:
+        finalized = {os.path.basename(p) for p in paths}
+        for p in sorted(glob.glob(
+            os.path.join(directory, JOURNAL_GLOB + PARTIAL_SUFFIX)
+        )):
+            # a finalized journal supersedes its own leftover stage file
+            if os.path.basename(p)[: -len(PARTIAL_SUFFIX)] not in finalized:
+                paths.append(p)
+    return paths
+
+
+def _journal_section(path: str, live: bool) -> tuple[list, list[str]]:
+    """(findings, report lines) for one journal file."""
+    records = read_journal(path, tolerant=True)
+    lines = [f"-- {os.path.basename(path)}: {len(records)} row(s)"]
+    findings = verdicts.journal_findings(records)
+    if records:
+        last = records[-1]
+        age = time.time() - float(last.get("ts", time.time()))
+        if path.endswith(PARTIAL_SUFFIX) or live:
+            lines.append(
+                f"   last row: kind={last.get('kind')} seq={last.get('seq')} "
+                f"({age:.1f}s ago)"
+            )
+        hb = next((r for r in reversed(records)
+                   if r.get("kind") == "heartbeat"), None)
+        if hb is not None:
+            lines.append(f"   last heartbeat: {heartbeat_cursor(hb)}")
+    return findings, lines
+
+
+def _trace_section(directory: str) -> list[str]:
+    try:
+        from dev import trace_summary
+    except ImportError:  # running as a loose script next to trace_summary
+        import trace_summary  # type: ignore[no-redef]
+    files = sorted(glob.glob(os.path.join(directory, "trace-*.json")))
+    if not files:
+        return []
+    events: list[dict] = []
+    unreadable: list[str] = []
+    for f in files:
+        try:
+            events.extend(trace_summary.load_trace_events(f))
+        except (OSError, ValueError):
+            # a SIGKILL'd rank can leave a torn trace file — keep the
+            # healthy ranks' evidence, name the torn one
+            unreadable.append(os.path.basename(f))
+    lines = [f"-- {len(files)} trace file(s), {len(events)} event(s)"]
+    if unreadable:
+        lines.append(f"   unreadable (torn mid-write?): {unreadable}")
+    if events:
+        lines.extend(trace_summary.format_report(events, top=5).splitlines())
+    return lines
+
+
+def run_doctor(
+    directory: str,
+    *,
+    live: bool = False,
+    strict: bool = False,
+) -> tuple[int, list, str]:
+    """The doctor's whole pass: returns (exit_code, findings, report_text).
+
+    Importable so tests judge findings structurally; ``main`` wraps it.
+    """
+    history = bench_history.load_history(directory)
+    lines: list[str] = [f"run doctor: {os.path.abspath(directory)}"]
+    findings: list = []
+    current_round_findings: list = []
+
+    if history.artifacts or history.sidecar is not None:
+        lines.append("")
+        lines.append("== bench verdicts ==")
+        latest = history.latest
+        for art in history.artifacts:
+            vs = verdicts.judge_artifact(art)
+            findings.extend(vs)
+            if art is latest:
+                current_round_findings.extend(vs)
+            for v in vs:
+                lines.append(v.line())
+        if history.sidecar is not None:
+            lines.append(f"-- sidecar {bench_history.SIDECAR_FILENAME} "
+                         "(preferred: never tail-truncated)")
+            vs = verdicts.judge_artifact(history.sidecar)
+            findings.extend(vs)
+            current_round_findings.extend(vs)
+            for v in vs:
+                lines.append(v.line())
+        # the CURRENT multichip round gates the exit code like the current
+        # bench round does — independently of the sidecar (which never
+        # carries multichip evidence)
+        current_multi = max(
+            (m.round for m in history.multichip if m.round is not None),
+            default=None,
+        )
+        for m in history.multichip:
+            v = verdicts.judge_multichip(m)
+            findings.append(v)
+            if m.round == current_multi:
+                current_round_findings.append(v)
+            lines.append(v.line())
+        hist = verdicts.history_findings(history)
+        if hist:
+            lines.append("")
+            lines.append("== cross-round history ==")
+            findings.extend(hist)
+            for v in hist:
+                lines.append(v.line())
+    else:
+        lines.append("(no BENCH_r*/MULTICHIP_r* artifacts or sidecar here)")
+
+    journal_paths = _find_journals(directory, live)
+    if journal_paths:
+        lines.append("")
+        lines.append("== run journals ==")
+        for path in journal_paths:
+            try:
+                jf, jl = _journal_section(path, live)
+            except OSError as e:
+                lines.append(f"-- {path}: unreadable ({e})")
+                continue
+            findings.extend(jf)
+            lines.extend(jl)
+            for v in jf:
+                lines.append(v.line())
+
+    trace_lines = _trace_section(directory)
+    if trace_lines:
+        lines.append("")
+        lines.append("== traces ==")
+        lines.extend(trace_lines)
+
+    regressions = verdicts.regressions(current_round_findings)
+    if strict:
+        regressions = regressions + [
+            v for v in findings
+            if v.status in (verdicts.PATHOLOGY, verdicts.WARNING)
+        ]
+    lines.append("")
+    if regressions:
+        lines.append(f"REGRESSIONS ({len(regressions)}):")
+        for v in regressions:
+            lines.append(f"  {v.metric} [{v.rule}]: {v.detail}")
+    else:
+        lines.append("REGRESSIONS: none")
+    return (1 if regressions else 0), findings, "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("directory", nargs="?", default=".",
+                   help="run directory (bench artifacts + journals + "
+                        "traces); default: cwd")
+    p.add_argument("--live", action="store_true",
+                   help="also tail crash-durable .partial journal stages "
+                        "(a wedged run's evidence before close)")
+    p.add_argument("--strict", action="store_true",
+                   help="fail on pathologies/warnings too, not just "
+                        "current-round win-criterion losses")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as one JSON object instead of text")
+    args = p.parse_args(argv)
+    code, findings, text = run_doctor(
+        args.directory, live=args.live, strict=args.strict
+    )
+    if args.json:
+        print(json.dumps({
+            "exit_code": code,
+            "findings": [vars(v) for v in findings],
+        }, indent=2))
+    else:
+        print(text)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

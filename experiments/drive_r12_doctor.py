@@ -1,0 +1,211 @@
+"""Verify drive for the run-doctor PR: user-style, end to end.
+
+A: doctor over the repo's checked-in BENCH history (CLI, exit 0, named
+   historical verdicts).
+B: doctor over a synthetic regression round (exit 1 naming row + rule).
+C: GLM driver streaming run with --telemetry-dir: journal heartbeats with
+   epoch cursors land, the journal finalizes, the doctor reads it clean.
+D: the SAME driver run SIGKILL'd mid-train: the crash-durable .partial
+   stage survives with heartbeats, and `doctor --live` names the cursor
+   and the never-finalized warning.
+E: bench sidecar preferred by the doctor over BENCH artifacts in the dir.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = "/root/repo"
+sys.path.insert(0, REPO)
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from photon_ml_tpu.io import avro as avro_io  # noqa: E402
+
+SCHEMA = {
+    "type": "record", "name": "TrainingExampleAvro",
+    "fields": [
+        {"name": "uid", "type": ["string", "null"], "default": None},
+        {"name": "label", "type": "double"},
+        {"name": "features", "type": {"type": "array", "items": {
+            "type": "record", "name": "FeatureAvro", "fields": [
+                {"name": "name", "type": "string"},
+                {"name": "term", "type": ["string", "null"], "default": None},
+                {"name": "value", "type": "double"},
+            ]}}},
+        {"name": "weight", "type": ["double", "null"], "default": None},
+        {"name": "offset", "type": ["double", "null"], "default": None},
+    ],
+}
+
+
+def make_avro(root, n=240, d=5, seed=7):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=d)
+    recs = []
+    for i in range(n):
+        x = rng.normal(size=d)
+        y = 1.0 if rng.random() < 1 / (1 + np.exp(-3 * float(x @ w))) else 0.0
+        recs.append({
+            "uid": str(i), "label": y,
+            "features": [{"name": f"f{j}", "term": "", "value": float(x[j])}
+                         for j in range(d)],
+            "weight": 1.0, "offset": 0.0,
+        })
+    os.makedirs(root, exist_ok=True)
+    avro_io.write_container(os.path.join(root, "part-00000.avro"), SCHEMA,
+                            recs, block_records=24)
+    return root
+
+
+def doctor(args):
+    return subprocess.run(
+        [sys.executable, "-m", "dev.doctor", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def main():
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="drive-doctor-")
+
+    # -- A: checked-in history ------------------------------------------
+    p = doctor([REPO])
+    assert p.returncode == 0, p.stdout + p.stderr
+    for needle in ("2.95x", "parsed:null", "plateau",
+                   "REGRESSIONS: none"):
+        assert needle in p.stdout, f"missing {needle!r}\n{p.stdout}"
+    print("A ok: doctor reproduces the checked-in history, exit 0")
+
+    # -- B: synthetic regression ----------------------------------------
+    bdir = os.path.join(tmp, "reg")
+    os.makedirs(bdir)
+    report = {"metric": "glm_lambda_grid_example_iters_per_sec",
+              "value": 6e8, "spread": [], "unit": "ex*it/s",
+              "vs_baseline": 200.0,
+              "extra_metrics": [{
+                  "metric": "sparse_giant_fe_hybrid", "value": 800.0,
+                  "spread": [],
+                  "unit": "ms/it d=1e7 zipf 17M hot256 cov0.62 ELLsr 644"}]}
+    with open(os.path.join(bdir, "BENCH_r06.json"), "w") as f:
+        json.dump({"n": 6, "rc": 0, "tail": json.dumps(report),
+                   "parsed": report}, f)
+    p = doctor([bdir])
+    assert p.returncode == 1, p.stdout
+    assert "sparse_giant_fe_hybrid" in p.stdout
+    assert "hybrid-beats-ell" in p.stdout
+    print("B ok: synthetic regression exits 1 naming row + rule")
+
+    # -- C: driver streaming run, telemetry journal, doctor reads it ----
+    data = make_avro(os.path.join(tmp, "train"))
+    tel = os.path.join(tmp, "tel")
+    from photon_ml_tpu.cli import glm_driver
+
+    glm_driver.main([
+        "--input-data-path", data, "--output-dir", os.path.join(tmp, "out"),
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--regularization-weights", "0.1,1.0",
+        "--max-iterations", "12",
+        "--streaming-chunks", "60",
+        "--telemetry-dir", tel,
+    ])
+    rows = []
+    with open(os.path.join(tel, "run-journal.jsonl")) as f:
+        rows = [json.loads(l) for l in f if l.strip()]
+    beats = [r for r in rows if r["kind"] == "heartbeat"]
+    assert beats and beats[-1]["stage"] == "glm_streaming", beats[:2]
+    assert beats[-1]["epochs"] >= 1 and beats[-1]["lam_index"] == 1
+    assert any("counter_deltas" in b for b in beats)
+    assert not os.path.exists(
+        os.path.join(tel, "run-journal.jsonl.partial"))  # published
+    assert rows[-1]["kind"] == "journal_close"
+    p = doctor([tel])
+    assert p.returncode == 0, p.stdout
+    assert "last heartbeat" in p.stdout and "glm_streaming" in p.stdout
+    print(f"C ok: {len(beats)} heartbeats, journal finalized, doctor clean")
+
+    # -- D: SIGKILL mid-run; doctor --live tails the stage --------------
+    kdata = make_avro(os.path.join(tmp, "ktrain"), n=400, d=6, seed=11)
+    ktel = os.path.join(tmp, "ktel")
+    script = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import photon_ml_tpu.io.stream_reader as sr\n"
+        "_real = sr.ChunkPrefetcher._load_timed\n"
+        "def slow(self, spec):\n"
+        "    time.sleep(0.35)\n"  # stretch the run so the kill lands mid-train
+        "    return _real(self, spec)\n"
+        "sr.ChunkPrefetcher._load_timed = slow\n"
+        "from photon_ml_tpu.cli import glm_driver\n"
+        "glm_driver.main([\n"
+        f"    '--input-data-path', {kdata!r},\n"
+        f"    '--output-dir', {os.path.join(tmp, 'kout')!r},\n"
+        "    '--task-type', 'LOGISTIC_REGRESSION',\n"
+        "    '--regularization-weights', '0.1,0.5,1.0',\n"
+        "    '--max-iterations', '40',\n"
+        "    '--streaming-chunks', '40',\n"
+        "    '--no-streaming-prefetch',\n"  # inline decode: sleep paces epochs
+        f"    '--telemetry-dir', {ktel!r},\n"
+        "])\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    partial = os.path.join(ktel, "run-journal.jsonl.partial")
+    deadline = time.monotonic() + 300
+    seen_beat = False
+    try:
+        while time.monotonic() < deadline:
+            if os.path.exists(partial):
+                with open(partial) as f:
+                    if any('"kind": "heartbeat"' in l for l in f):
+                        seen_beat = True
+                        break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.3)
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    assert seen_beat, "driver subprocess never heartbeat within deadline"
+    assert os.path.exists(partial), "stage file vanished"
+    assert not os.path.exists(os.path.join(ktel, "run-journal.jsonl"))
+    p = doctor([ktel, "--live"])
+    assert p.returncode == 0, p.stdout
+    assert "journal never finalized" in p.stdout
+    assert "last heartbeat" in p.stdout and "glm_streaming" in p.stdout
+    print("D ok: SIGKILL'd driver left a readable stage; --live names it")
+
+    # -- E: sidecar preferred -------------------------------------------
+    sys.path.insert(0, REPO)
+    import bench
+
+    sdir = os.path.join(tmp, "side")
+    os.makedirs(sdir)
+    # a BENCH artifact AND a sidecar: doctor must judge the sidecar
+    with open(os.path.join(sdir, "BENCH_r06.json"), "w") as f:
+        json.dump({"n": 6, "rc": 0, "tail": "", "parsed": None}, f)
+    report = bench.sample_report()
+    bench.write_sidecar(report, sdir, config={"drive": True})
+    p = doctor([sdir])
+    assert "sidecar" in p.stdout and "preferred" in p.stdout, p.stdout
+    print("E ok: doctor prefers the bench-report.json sidecar")
+
+    print("DRIVE PASSED")
+
+
+if __name__ == "__main__":
+    main()

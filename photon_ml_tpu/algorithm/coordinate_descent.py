@@ -224,6 +224,13 @@ def run_coordinate_descent(
                 # CD path is single-process, so the gate is a pass-through
                 commit_checkpoint(checkpointer, slot + 1, arrays, meta)
 
+        if telemetry is not None:
+            # liveness heartbeat (ISSUE 12): sweep cursor + registry deltas
+            # into the crash-durable journal stage; observes only
+            telemetry.heartbeat(
+                "game_cd", sweep=iteration + 1, num_sweeps=num_iterations
+            )
+
     final = GameModel(models=dict(models))
     if best_model is None:
         best_model = final

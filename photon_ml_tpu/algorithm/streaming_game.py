@@ -1092,8 +1092,13 @@ class StreamingGameProgram:
         checkpointer=None,
         checkpoint_every: int = 1,
         resume: bool = True,
+        on_sweep=None,
     ) -> StreamingGameResult:
         """Run up to ``num_sweeps`` streamed CD sweeps.
+
+        on_sweep: optional observer ``(sweep_done, num_sweeps, loss)``
+        called at the end of every sweep (the driver wires the journal
+        heartbeat through it — ISSUE 12); observe-only.
 
         tolerance > 0 adds a loss-plateau stop: the run ends early when a
         sweep's relative training-loss decrease falls below it (the
@@ -1179,6 +1184,8 @@ class StreamingGameProgram:
                     },
                     exchange=self.exchange,
                 )
+            if on_sweep is not None:
+                on_sweep(sweep + 1, num_sweeps, losses[-1])
             if (
                 tolerance > 0.0 and len(losses) >= 2
                 and abs(losses[-2] - losses[-1])

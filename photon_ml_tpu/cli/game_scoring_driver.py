@@ -140,6 +140,7 @@ def run(
             fe_feature_sharded=fe_feature_sharded,
             partitioned=partitioned,
             on_corrupt=on_corrupt,
+            journal=journal,
         )
         succeeded = True
         if journal is not None:
@@ -276,6 +277,7 @@ def _run_inner(
     fe_feature_sharded: bool,
     partitioned: bool,
     on_corrupt: str,
+    journal=None,
 ) -> dict:
     import jax
     if partitioned and evaluators:
@@ -465,6 +467,17 @@ def _run_inner(
 
                 json.dump(_json_safe(summary), f, indent=2, default=float)
         summaries.append(summary)
+        if journal is not None:
+            # per-dataset liveness heartbeat (ISSUE 12): which dataset the
+            # multi-dataset loop last finished, with registry deltas, in
+            # the crash-durable journal stage; inert on worker ranks
+            from photon_ml_tpu.telemetry import default_registry
+
+            journal.heartbeat(
+                registry=default_registry(), stage="game_scoring",
+                dataset_index=di, num_datasets=len(paths),
+                num_scored=summary.get("num_scored"),
+            )
 
     if len(paths) == 1:
         return summaries[0]

@@ -1097,6 +1097,13 @@ def _run_streaming(
                 num_sweeps=params.coordinate_descent_iterations,
                 checkpointer=checkpointer,
                 resume=params.resume or restart > 0,
+                on_sweep=(
+                    None if telemetry is None else
+                    lambda sweep, total, loss: telemetry.heartbeat(
+                        "game_streaming", sweep=sweep, num_sweeps=total,
+                        loss=loss,
+                    )
+                ),
             )
 
         result = run_with_recovery(

@@ -813,6 +813,13 @@ class GameEstimator:
                 training_evaluator=default_evaluator_for_task(self.task),
                 training_eval_data=train_eval_data,
                 check_finite=self.check_finite,
+                on_sweep=(
+                    None if self.telemetry is None else
+                    lambda sweep, total, loss: self.telemetry.heartbeat(
+                        "fused_game", sweep=sweep, num_sweeps=total,
+                        loss=loss,
+                    )
+                ),
             )
 
         trainable_cids = {} if fe_cid is None else {fe_shard: fe_cid}
@@ -1197,6 +1204,8 @@ def train_glm(
         w = result.coefficients
         if telemetry is not None:
             telemetry.record_solve("glm", result, extra={"lambda": lam})
+            telemetry.heartbeat("glm", lam=lam,
+                                n_lambdas=len(regularization_weights))
         norm = objective.normalization
         means = norm.to_model_space(w, intercept_index)
         variances = None
@@ -1436,7 +1445,18 @@ def train_glm_streaming(
                 k: jnp.asarray(v) for k, v in resume_state_arrays.items()
             })
             objective.epochs = resume_epochs_lambda
-        state_observer = None
+        observers = []
+        if telemetry is not None:
+            # per-outer-iteration (== epoch-boundary) liveness heartbeat
+            # (ISSUE 12): the epoch cursor a wedged run is diagnosed by,
+            # appended to the crash-durable journal stage; observes only
+            def _hb_observer(state, _li=li, _obj=objective):
+                telemetry.heartbeat(
+                    "glm_streaming", lam_index=_li, n_lambdas=len(lams),
+                    iteration=int(state.iteration), epochs=_obj.epochs,
+                )
+
+            observers.append(_hb_observer)
         if checkpointer is not None and writes:
             def state_observer(state, _li=li, _obj=objective,
                                _mi=opt.max_iterations):
@@ -1456,6 +1476,16 @@ def train_glm_streaming(
                     completed=completed,
                     solver_state=state,
                 )
+
+            observers.append(state_observer)
+        if not observers:
+            state_observer = None
+        elif len(observers) == 1:
+            state_observer = observers[0]
+        else:
+            def state_observer(state, _obs=tuple(observers)):
+                for obs in _obs:
+                    obs(state)
         result = solve(
             opt, objective, w,
             lower_bounds=(

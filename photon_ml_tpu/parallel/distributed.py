@@ -1802,8 +1802,14 @@ def train_distributed(
     training_eval_data=None,
     down_sampling_seed: int = 0,
     check_finite: bool = True,
+    on_sweep=None,
 ) -> DistributedTrainResult:
     """Run ``num_iterations`` fused CD sweeps, optionally mesh-sharded.
+
+    on_sweep: optional observer ``(sweep_done, num_iterations, loss)``
+    called at the end of every sweep (ISSUE 12: the estimator wires the
+    journal heartbeat through it). Observe-only — it runs after all of the
+    sweep's collectives, on every rank, and must never gate one.
 
     put_fn: placement function forwarded to ``shard_inputs``. Defaults to
     ``jax.device_put`` single-process and to ``multihost.global_put`` when
@@ -2143,6 +2149,11 @@ def train_distributed(
                 {"losses": losses, "metric_history": history,
                  "best_metric": best_metric},
             )
+
+        if on_sweep is not None:
+            on_sweep(sweep + 1, num_iterations,
+                     losses[-1] if losses else None)
+
     def result_state(state_: GameTrainState) -> GameTrainState:
         clean = unpadded(state_)
         if jax.process_count() > 1:

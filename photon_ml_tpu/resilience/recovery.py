@@ -74,6 +74,22 @@ def run_with_recovery(
             divergent = isinstance(e, DivergenceError)
             recoverable = transient or (divergent and has_checkpoint)
             if not recoverable or restart >= max_restarts:
+                if journal is not None:
+                    # the run's terminal failure row (ISSUE 12): what
+                    # dev/doctor.py names when a crashed run's journal —
+                    # finalized by the driver's failure path, or the
+                    # crash-durable stage of one that never closed — is
+                    # read back
+                    journal.record(
+                        "run_failure",
+                        description=description,
+                        error=repr(e),
+                        transient=transient,
+                        divergent=divergent,
+                        preemption=is_preemption(e),
+                        restarts_used=restart,
+                        max_restarts=max_restarts,
+                    )
                 if recoverable:
                     resilience_counters.record_giveup()
                     logger.error(

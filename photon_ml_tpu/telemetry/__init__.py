@@ -8,7 +8,13 @@ Driver.scala:120-393) rebuilt as one subsystem the whole stack emits
 through — see each submodule's docstring for its slice of the map.
 """
 
-from photon_ml_tpu.telemetry.journal import JOURNAL_FILENAME, RunJournal, json_safe
+from photon_ml_tpu.telemetry.journal import (
+    JOURNAL_FILENAME,
+    JOURNAL_PARTIAL_SUFFIX,
+    RunJournal,
+    json_safe,
+    read_journal,
+)
 from photon_ml_tpu.telemetry.layout import (
     LAYOUT_METRIC_PREFIX,
     record_hybrid_layout,
@@ -71,8 +77,10 @@ def __getattr__(name: str):
 
 __all__ = [
     "JOURNAL_FILENAME",
+    "JOURNAL_PARTIAL_SUFFIX",
     "RunJournal",
     "json_safe",
+    "read_journal",
     "LAYOUT_METRIC_PREFIX",
     "record_hybrid_layout",
     "reset_layout_metrics",

@@ -14,6 +14,18 @@ directories, while collectives must still run on EVERY rank — so a journal
 constructed on rank > 0 is inert (all methods are no-ops) and callers never
 need to branch on rank themselves (which would tempt them to skip
 collectives inside ``if journal:`` blocks).
+
+Crash durability (ISSUE 12): with ``durable=True`` (the default) the spool
+IS the staged file ``<dir>/<filename>.partial`` and every row is
+append-fsync'd, so a SIGKILL'd run leaves a readable journal for
+``dev/doctor.py --live`` to tail; ``close()`` still publishes atomically
+(``os.replace`` of the stage onto the final name — readers of the final
+path never see a torn file). Flushing is observe-only: durable on/off
+changes nothing about what callers compute (pinned bitwise on an
+instrumented streaming solve, tests/test_doctor.py). Heartbeat rows
+(:meth:`RunJournal.heartbeat`) carry a training cursor plus registry
+counter DELTAS since the previous heartbeat — the live progress signal a
+wedged production run is diagnosed by.
 """
 
 from __future__ import annotations
@@ -27,6 +39,8 @@ import tempfile
 import time
 
 JOURNAL_FILENAME = "run-journal.jsonl"
+#: suffix of the crash-durable stage file a live/killed run is readable at
+JOURNAL_PARTIAL_SUFFIX = ".partial"
 
 
 def _process_index() -> int:
@@ -66,6 +80,21 @@ def json_safe(obj):
     return str(obj)
 
 
+#: fields the journal stamps onto every heartbeat row itself — everything
+#: ELSE in the row is the caller's progress cursor (dev/doctor.py and
+#: telemetry/verdicts.py both print "where was the run" from this split)
+_HEARTBEAT_BOOKKEEPING = frozenset(
+    {"kind", "seq", "ts", "elapsed_ms", "counter_deltas", "gauges"}
+)
+
+
+def heartbeat_cursor(row: dict) -> dict:
+    """The caller-supplied progress cursor of one ``heartbeat`` journal row
+    (stage, sweep/epoch/λ indices, ...) with the journal's own bookkeeping
+    fields stripped."""
+    return {k: v for k, v in row.items() if k not in _HEARTBEAT_BOOKKEEPING}
+
+
 class RunJournal:
     """``with RunJournal(out_dir) as j: j.record("phase_timing", ...)``.
 
@@ -82,22 +111,33 @@ class RunJournal:
         *,
         filename: str = JOURNAL_FILENAME,
         rank: int | None = None,
+        durable: bool = True,
     ):
         self.directory = None if directory is None else str(directory)
         self.filename = filename
         self.rank = _process_index() if rank is None else int(rank)
+        self.durable = bool(durable)
         self._seq = 0
         self._spool = None
         self._closed = False
+        self._hb_counters: dict[str, int] = {}
         # monotonic anchor: rows carry elapsed_ms since journal open so
         # they order correctly across host clock steps and correlate with
         # trace spans (telemetry/tracing.py durations are perf_counter too)
         self._t0 = time.perf_counter()
         if self.active:
-            self._spool = tempfile.NamedTemporaryFile(
-                mode="w", suffix=".jsonl", prefix="photon-journal-",
-                delete=False,
-            )
+            if self.durable:
+                # the spool IS the stage file, in the destination directory
+                # (os.replace is atomic only within one filesystem): every
+                # row is append-fsync'd below, so a killed run's journal is
+                # readable at <dir>/<filename>.partial before publish
+                os.makedirs(self.directory, exist_ok=True)
+                self._spool = open(self.partial_path, "w")
+            else:
+                self._spool = tempfile.NamedTemporaryFile(
+                    mode="w", suffix=".jsonl", prefix="photon-journal-",
+                    delete=False,
+                )
             self.record("journal_open", pid=os.getpid(), rank=self.rank)
 
     @property
@@ -110,6 +150,16 @@ class RunJournal:
         if self.directory is None:
             return None
         return os.path.join(self.directory, self.filename)
+
+    @property
+    def partial_path(self) -> str | None:
+        """The crash-durable stage file a live (or killed) durable run is
+        readable at — what ``dev/doctor.py --live`` tails."""
+        if self.directory is None:
+            return None
+        return os.path.join(
+            self.directory, self.filename + JOURNAL_PARTIAL_SUFFIX
+        )
 
     def record(self, kind: str, **fields) -> None:
         if not self.active:
@@ -128,6 +178,11 @@ class RunJournal:
         self._seq += 1
         self._spool.write(json.dumps(row, allow_nan=False) + "\n")
         self._spool.flush()
+        if self.durable:
+            # append-fsync per row: a SIGKILL between rows loses at most
+            # the row being written, never the file (journals are low-rate
+            # — tens of rows plus heartbeats per run)
+            os.fsync(self._spool.fileno())
 
     def record_timings(self, timings: dict[str, dict[str, float]]) -> None:
         """One ``phase_timing`` row per named phase — the shape
@@ -142,6 +197,37 @@ class RunJournal:
     def record_gauge(self, name: str, value) -> None:
         self.record("gauge", name=name, value=value)
 
+    def heartbeat(self, *, registry=None, **cursor) -> None:
+        """One periodic liveness row: the caller's progress cursor (sweep/
+        epoch/λ index, dataset id, ...) plus the registry's counter DELTAS
+        since the previous heartbeat (what moved, not the whole snapshot)
+        and its current gauges. ``dev/doctor.py --live`` reads the last of
+        these to say where a wedged run actually is. Observe-only: emitted
+        from observers/loop tails, never gating any training work."""
+        if not self.active:
+            return
+        fields = dict(cursor)
+        if registry is not None:
+            snap = registry.snapshot()
+            counters = {
+                str(k): int(v) for k, v in (snap.get("counters") or {}).items()
+            }
+            deltas = {
+                k: v - self._hb_counters.get(k, 0)
+                for k, v in counters.items()
+                if v != self._hb_counters.get(k, 0)
+            }
+            self._hb_counters = counters
+            if deltas:
+                fields["counter_deltas"] = deltas
+            gauges = {
+                k: v for k, v in (snap.get("gauges") or {}).items()
+                if v is not None
+            }
+            if gauges:
+                fields["gauges"] = gauges
+        self.record("heartbeat", **fields)
+
     def close(self) -> None:
         """Atomically publish the spool as ``<directory>/<filename>``."""
         if self._closed or self._spool is None:
@@ -152,6 +238,11 @@ class RunJournal:
         self._spool.flush()
         os.fsync(self._spool.fileno())
         self._spool.close()
+        if self.durable:
+            # the spool IS the stage file in the destination directory:
+            # publish is one atomic rename
+            os.replace(self._spool.name, self.path)
+            return
         os.makedirs(self.directory, exist_ok=True)
         # stage into the destination directory first: os.replace is atomic
         # only within one filesystem, and the spool lives in the system tmp
@@ -179,5 +270,21 @@ class RunJournal:
     @staticmethod
     def read(path: str | os.PathLike) -> list[dict]:
         """Parse a finalized journal back into a list of record dicts."""
-        with open(path) as f:
-            return [json.loads(line) for line in f if line.strip()]
+        return read_journal(path, tolerant=False)
+
+
+def read_journal(path: str | os.PathLike, *, tolerant: bool = False) -> list[dict]:
+    """Parse a JSONL journal. ``tolerant=True`` skips unparseable lines —
+    the shape of a crash-durable ``.partial`` stage whose final row was cut
+    mid-write by a SIGKILL (every earlier row is fsync'd whole)."""
+    records: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                if not tolerant:
+                    raise
+    return records

@@ -215,6 +215,16 @@ class SolverTelemetry:
         if self.journal is not None:
             self.journal.record(kind, **row)
 
+    def heartbeat(self, stage: str, **cursor) -> None:
+        """Periodic liveness row (ISSUE 12): training loops call this at
+        sweep/epoch/λ boundaries so ``dev/doctor.py --live`` can read a
+        wedged run's progress cursor + registry counter deltas out of the
+        crash-durable journal stage. Observe-only and inert without an
+        active journal (worker ranks, journal-less runs)."""
+        if self.journal is None or not getattr(self.journal, "active", False):
+            return
+        self.journal.heartbeat(registry=self.registry, stage=stage, **cursor)
+
     def _emit(self, coordinate_id: str, iteration: int, metrics: dict) -> None:
         if self.emitter is not None:
             self.emitter.send(OptimizationLogEvent(

@@ -56,3 +56,32 @@ def test_sample_report_carries_all_metrics():
     assert [r["metric"] for r in report["extra_metrics"]] == EXPECTED_METRICS
     for r in report["extra_metrics"]:
         assert set(r) == {"metric", "value", "spread", "unit"}
+
+
+def test_sidecar_rides_along_without_touching_the_line(tmp_path):
+    """ISSUE 12: the full unslimmed sidecar is extra output, never a change
+    to the ONE JSON line — the report dict is unmutated and the rendered
+    line stays inside the budget after writing it."""
+    report = bench.sample_report()
+    line_before = bench.render_report(report)
+    path = bench.write_sidecar(report, str(tmp_path), config={"n": 1})
+    assert bench.render_report(report) == line_before
+    assert len(line_before.encode()) < bench.MAX_LINE_BYTES
+    with open(path) as f:
+        sidecar = json.load(f)
+    # the sidecar is a superset: same rows, plus pre-parsed units
+    assert [r["metric"] for r in sidecar["report"]["extra_metrics"]] == \
+        EXPECTED_METRICS
+    assert all("parsed_unit" in r
+               for r in sidecar["report"]["extra_metrics"])
+
+
+def test_every_sample_row_has_a_registered_verdict_rule():
+    """Runtime twin of lint check 12: the doctor can judge every row the
+    bench emits (telemetry/verdicts.py covers sample_report exactly)."""
+    from photon_ml_tpu.telemetry import verdicts
+
+    report = bench.sample_report()
+    for row in [report] + report["extra_metrics"]:
+        rule = verdicts.rule_for(row["metric"])
+        assert rule is not None, f"no verdict rule for {row['metric']}"

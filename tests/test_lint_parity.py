@@ -543,3 +543,71 @@ def test_lint_catches_time_time_durations(tmp_path):
     assert any("durations.py:17" in p for p in problems)  # wrong file
     assert not any("journal.py:5" in p for p in problems)  # allowlisted
     assert any("journal.py:9" in p for p in problems)  # wrong class
+
+
+def test_lint_catches_bench_row_without_verdict_rule(tmp_path):
+    """Check 12 fires: a sample_report row key (literal or f-string
+    prefix) with no @rule(...) literal in telemetry/verdicts.py is
+    reported; covered keys — exact, prefix-glob, and f-string-prefix —
+    pass; roots without a bench surface are skipped."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    tel = tmp_path / "photon_ml_tpu" / "telemetry"
+    tel.mkdir(parents=True)
+    (tel / "verdicts.py").write_text(
+        '"""No reference analogue."""\n'
+        "def rule(pattern, **kw):\n"
+        "    def deco(fn):\n"
+        "        return fn\n"
+        "    return deco\n"
+        '@rule("covered_exact", name="a")\n'
+        "def j1(row, art):\n"
+        "    pass\n"
+        '@rule("covered_family_*", name="b")\n'
+        "def j2(row, art):\n"
+        "    pass\n"
+    )
+    (tmp_path / "bench.py").write_text(
+        "def _row(metric, value, spread, unit):\n"
+        "    return {}\n"
+        "def sample_report():\n"
+        '    rows = [_row("covered_exact", 1, [], "u")]\n'
+        '    rows += [_row(f"covered_family_{k}", 1, [], "u")'
+        ' for k in ("a", "b")]\n'
+        '    rows.append(_row("uncovered_row", 1, [], "u"))\n'
+        '    rows.append(_row(f"uncovered_prefix_{1}", 1, [], "u"))\n'
+        "    # a prefix SHORTER than the registered stem generates keys\n"
+        "    # the glob does not match (e.g. covered_x) — must be flagged\n"
+        '    rows.append(_row(f"covered_{1}", 1, [], "u"))\n'
+        "    return rows\n"
+        "def elsewhere():\n"
+        "    # rows built OUTSIDE sample_report are not the emitted set\n"
+        '    return _row("not_emitted", 1, [], "u")\n'
+    )
+    problems = lint_parity.check_bench_verdict_rules(tmp_path)
+    assert any("'uncovered_row'" in p for p in problems), problems
+    assert any("'uncovered_prefix_'" in p and "f-string prefix" in p
+               for p in problems)
+    assert not any("'covered_exact'" in p or "'covered_family_" in p
+                   for p in problems)
+    assert any("'covered_'" in p for p in problems)  # stem-substring trap
+    assert not any("not_emitted" in p for p in problems)
+    # a root with no bench.py (most synthetic lint roots) is out of scope
+    bare = tmp_path / "bare"
+    (bare / "photon_ml_tpu").mkdir(parents=True)
+    assert lint_parity.check_bench_verdict_rules(bare) == []
+
+
+def test_lint_clean_on_real_bench_and_verdicts():
+    """The real bench.py sample_report is fully covered by the real
+    verdict registry (check 12 over the repo itself)."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+    assert lint_parity.check_bench_verdict_rules(REPO_ROOT) == []
