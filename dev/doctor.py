@@ -17,6 +17,11 @@ sidecar, JSONL run journals (``run-journal.jsonl`` and friends — with
   (overlap_fraction ~ 0 with prefetch on, high serve pad fraction,
   quarantined blocks, preemption restarts, exhausted restart budgets) plus
   the last heartbeat cursor and failure rows of a crashed/in-flight run;
+- the per-program compiled-program ledger table (ISSUE 13): per labeled
+  jit program — calls, compiles, recompiles, signatures, compile seconds,
+  flops, peak bytes, with each label's LAST recompile attribution (the
+  exact signature leaves that changed), plus heartbeat staleness and
+  hbm/compile drift so a wedged run is distinguishable from a slow one;
 - the straggler table from the per-rank trace files (dev/trace_summary.py
   machinery — online and offline reports share one implementation).
 
@@ -83,11 +88,108 @@ def _journal_section(path: str, live: bool) -> tuple[list, list[str]]:
                 f"   last row: kind={last.get('kind')} seq={last.get('seq')} "
                 f"({age:.1f}s ago)"
             )
-        hb = next((r for r in reversed(records)
-                   if r.get("kind") == "heartbeat"), None)
-        if hb is not None:
+        heartbeats = [r for r in records if r.get("kind") == "heartbeat"]
+        if heartbeats:
+            hb = heartbeats[-1]
             lines.append(f"   last heartbeat: {heartbeat_cursor(hb)}")
+            if path.endswith(PARTIAL_SUFFIX) or live:
+                # staleness is a LIVE signal: a wedged run's newest
+                # heartbeat goes stale while a merely slow run's keeps
+                # advancing — meaningless for a finalized journal, whose
+                # age just says when the run happened
+                staleness = time.time() - float(hb.get("ts", time.time()))
+                lines.append(
+                    f"   heartbeat staleness: {staleness:.1f}s since the "
+                    f"newest of {len(heartbeats)} heartbeat(s) "
+                    f"(seq {hb.get('seq')})"
+                )
+                drift = _heartbeat_drift(heartbeats)
+                if drift:
+                    lines.append(f"   heartbeat drift: {drift}")
+    lines.extend(_ledger_table(records))
     return findings, lines
+
+
+def _heartbeat_drift(heartbeats: list) -> str:
+    """first -> last movement of the device-memory and compile-count
+    snapshots heartbeat rows carry (ISSUE 13): live-HBM drift and a mid-run
+    compile storm both show up here before the run ends."""
+    first, last = heartbeats[0], heartbeats[-1]
+    parts = []
+    if first.get("hbm_bytes") is not None or last.get("hbm_bytes") is not None:
+        parts.append(
+            f"hbm_bytes {first.get('hbm_bytes')} -> {last.get('hbm_bytes')}"
+        )
+    if first.get("compiles") is not None or last.get("compiles") is not None:
+        parts.append(
+            f"compiles {first.get('compiles')} -> {last.get('compiles')}"
+        )
+    return ", ".join(parts)
+
+
+def _ledger_table(records: list) -> list[str]:
+    """The per-program ledger table (ISSUE 13): one row per labeled
+    program from the journal's program_compile/program_signature/
+    program_recompile rows, with each label's last recompile attribution
+    underneath — the 'compile count went up' number next to its cause."""
+    per_label: dict[str, dict] = {}
+    for r in records:
+        kind = r.get("kind")
+        if kind not in ("program_compile", "program_signature",
+                        "program_recompile"):
+            continue
+        label = str(r.get("label"))
+        ent = per_label.setdefault(label, {
+            "compiles": 0, "recompiles": 0, "compile_s": 0.0,
+            "flops": None, "peak_bytes": None, "forecast": None,
+            "attribution": None,
+        })
+        if kind == "program_recompile":
+            ent["recompiles"] += 1
+            ent["attribution"] = r.get("summary")
+            continue
+        if kind == "program_compile":
+            ent["compiles"] += int(r.get("compiles") or 0)
+            ent["compile_s"] += float(r.get("compile_seconds") or 0.0)
+        cost = r.get("cost") or {}
+        if cost.get("flops") is not None:
+            ent["flops"] = cost["flops"]
+        mem = r.get("memory") or {}
+        peak = mem.get("peak_memory_in_bytes", mem.get("temp_size_in_bytes"))
+        if peak is not None:
+            ent["peak_bytes"] = peak
+        if r.get("hbm_forecast_bytes") is not None:
+            ent["forecast"] = r["hbm_forecast_bytes"]
+    if not per_label:
+        return []
+    # calls/signatures ride the final metrics snapshot when one was taken
+    metrics = next((r for r in reversed(records) if r.get("kind") == "metrics"),
+                   None)
+    snapshot = (metrics or {}).get("snapshot") or {}
+    counters = snapshot.get("counters") or {}
+    gauges = snapshot.get("gauges") or {}
+
+    def _fmt(v, unit=""):
+        return "-" if v is None else f"{v:g}{unit}"
+
+    lines = [f"   program ledger ({len(per_label)} labeled program(s)):"]
+    header = (f"   {'label':<38} {'calls':>6} {'compiles':>8} "
+              f"{'recomp':>6} {'sigs':>5} {'compile_s':>9} "
+              f"{'flops':>10} {'peak_B':>10} {'fcast_B':>10}")
+    lines.append(header)
+    for label in sorted(per_label):
+        ent = per_label[label]
+        calls = counters.get(f"xla/{label}/calls")
+        sigs = gauges.get(f"xla/{label}/signatures")
+        lines.append(
+            f"   {label:<38} {_fmt(calls):>6} {ent['compiles']:>8} "
+            f"{ent['recompiles']:>6} {_fmt(sigs):>5} "
+            f"{ent['compile_s']:>9.3f} {_fmt(ent['flops']):>10} "
+            f"{_fmt(ent['peak_bytes']):>10} {_fmt(ent['forecast']):>10}"
+        )
+        if ent["attribution"]:
+            lines.append(f"      last recompile: {ent['attribution']}")
+    return lines
 
 
 def _trace_section(directory: str) -> list[str]:

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static parity-convention lints for photon_ml_tpu (CLAUDE.md conventions).
 
-Twelve checks, all pure-AST (no jax import; runs in milliseconds):
+Thirteen checks, all pure-AST (no jax import; runs in milliseconds):
 
 1. **Docstring citations** — every ``photon_ml_tpu/**/*.py`` module (except
    ``__init__.py`` re-export shims) must carry a module docstring that
@@ -113,6 +113,15 @@ Twelve checks, all pure-AST (no jax import; runs in milliseconds):
    how BENCH_r04/r05 shipped with ``parsed: null`` unnoticed — the doctor
    (dev/doctor.py) can only judge rows the registry covers, so the
    coverage is enforced statically.
+
+13. **Raw jit sites in the hot-program packages** — every jit in
+   ``algorithm/``, ``serving/`` and ``parallel/`` must route through
+   ``telemetry.program_ledger.ledger_jit`` with a stable label (the
+   lint-as-memory discipline: labeling hot programs is structural, not
+   remembered), or sit on the reviewed class-qualified allowlist. A raw
+   ``jax.jit`` there compiles programs the ledger cannot see — its
+   recompile attribution, cost accounting, and the serving
+   ``replay_compiles == 0`` pin (ISSUE 13) all go blind to that site.
 
 Exit status 0 = clean; 1 = violations (printed one per line as
 ``path:lineno: message``). Run from the repo root:
@@ -323,6 +332,14 @@ BROAD_EXCEPT_ALLOWED = {
     # stack, which re-raises it attributed (io/stream_reader.py)
     (f"{PACKAGE}/io/stream_reader.py", "_producer"),
     (f"{PACKAGE}/telemetry/probes.py", "live_buffer_bytes"),
+    # same allocator capability probe as live_buffer_bytes: no
+    # memory_stats means no limit, and None IS the answer
+    (f"{PACKAGE}/telemetry/probes.py", "device_memory_limit_bytes"),
+    # the program ledger's cost/memory analysis is a capability probe:
+    # lower()/cost_analysis()/AOT compile each fail differently per
+    # backend, every failure degrades to None fields (logged at debug),
+    # and an analysis error must never reach the dispatch path it observes
+    (f"{PACKAGE}/telemetry/program_ledger.py", "_analyze"),
     (f"{PACKAGE}/telemetry/journal.py", "_process_index"),
     # same capability probe as the journal's: rank 0 when jax is absent
     (f"{PACKAGE}/telemetry/tracing.py", "_process_index"),
@@ -577,11 +594,17 @@ JIT_CLOSURE_ALLOWED = {
 }
 
 
+#: names check 9 treats as a jit constructor: the raw jax.jit and the
+#: ledger's labeled wrapper (telemetry/program_ledger.ledger_jit) — the
+#: closure discipline is identical either way (operands must be ARGUMENTS)
+_JIT_NAMES = ("jit", "ledger_jit")
+
+
 def _jit_references(node: ast.AST):
     for n in ast.walk(node):
-        if isinstance(n, ast.Attribute) and n.attr == "jit":
+        if isinstance(n, ast.Attribute) and n.attr in _JIT_NAMES:
             yield n
-        elif isinstance(n, ast.Name) and n.id == "jit":
+        elif isinstance(n, ast.Name) and n.id in _JIT_NAMES:
             yield n
 
 
@@ -649,8 +672,8 @@ def _nested_jit_hits(rel: str, tree: ast.AST) -> list[str]:
                 scan(child, inner)
             return
         is_jit = (
-            isinstance(node, ast.Attribute) and node.attr == "jit"
-        ) or (isinstance(node, ast.Name) and node.id == "jit")
+            isinstance(node, ast.Attribute) and node.attr in _JIT_NAMES
+        ) or (isinstance(node, ast.Name) and node.id in _JIT_NAMES)
         if is_jit and not (
             stack and (rel, ".".join(stack)) in JIT_CLOSURE_ALLOWED
         ):
@@ -781,6 +804,69 @@ def check_time_time_durations(root: pathlib.Path) -> list[str]:
     return problems
 
 
+#: hot-program packages whose jits must carry a ledger label (check 13):
+#: a raw jax.jit here compiles programs the ledger cannot attribute
+RAW_JIT_PREFIXES = (
+    f"{PACKAGE}/algorithm/",
+    f"{PACKAGE}/serving/",
+    f"{PACKAGE}/parallel/",
+)
+
+#: (file, dotted class-qualified scope) pairs whose RAW jax.jit use is
+#: reviewed — currently empty: every jit in the checked packages routes
+#: through ledger_jit. Add an entry only with a written reason the site
+#: cannot carry a label.
+RAW_JIT_ALLOWED: set = set()
+
+
+def check_raw_jit_sites(root: pathlib.Path) -> list[str]:
+    problems = []
+    for path in sorted((root / PACKAGE).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if not rel.startswith(RAW_JIT_PREFIXES):
+            continue
+        tree = ast.parse(path.read_text())
+        # names bound to jax.jit by `from jax import jit [as j]`
+        aliases: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for a in node.names:
+                    if a.name == "jit":
+                        aliases.add(a.asname or a.name)
+
+        stack: list[str] = []
+        hits: list[int] = []
+
+        def visit(node):
+            is_scope = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            )
+            if is_scope:
+                stack.append(node.name)
+            raw = (
+                isinstance(node, ast.Attribute) and node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id in JAX_ROOTS
+            ) or (isinstance(node, ast.Name) and node.id in aliases)
+            if raw and (rel, ".".join(stack)) not in RAW_JIT_ALLOWED:
+                hits.append(node.lineno)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_scope:
+                stack.pop()
+
+        visit(tree)
+        for lineno in hits:
+            problems.append(
+                f"{rel}:{lineno}: raw jax.jit in a hot-program package — "
+                "route the site through telemetry.program_ledger.ledger_jit "
+                "with a stable label so the program ledger can attribute "
+                "its compiles (ISSUE 13), or add the class-qualified scope "
+                "to RAW_JIT_ALLOWED with a written reason (lint check 13)"
+            )
+    return problems
+
+
 #: where check 12 reads its two sides from (relative to the lint root)
 BENCH_MODULE = "bench.py"
 VERDICTS_MODULE = f"{PACKAGE}/telemetry/verdicts.py"
@@ -892,6 +978,7 @@ def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
         + check_checkpoint_commit_sites(root)
         + check_time_time_durations(root)
         + check_bench_verdict_rules(root)
+        + check_raw_jit_sites(root)
     )
 
 

@@ -54,6 +54,7 @@ from photon_ml_tpu.ops.variance import (
     validate_variance_mode,
 )
 from photon_ml_tpu.optim.common import LaneTrace, LaneTraces
+from photon_ml_tpu.telemetry.program_ledger import ledger_jit
 from photon_ml_tpu.optim.optimizer import (
     OptimizerConfig,
     OptimizerType,
@@ -240,7 +241,7 @@ class FixedEffectCoordinate(Coordinate):
         return model.score_dataset(self.dataset)
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@partial(ledger_jit, label="coord/fe_solve", static_argnums=(0, 1))
 def _jitted_fe_solve(objective: GLMObjective, opt: OptimizerConfig,
                      batch: LabeledPointBatch, w0: Array):
     return solve(opt, objective.bind(batch), w0)
@@ -644,7 +645,7 @@ def solve_entity_bucket_traced(
     return table.at[entity_rows].set(solved), trace
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@partial(ledger_jit, label="coord/re_bucket_solve", static_argnums=(0, 1))
 def _jitted_re_bucket_solve(
     objective: GLMObjective,
     opt: OptimizerConfig,
@@ -662,7 +663,7 @@ def _jitted_re_bucket_solve(
     )
 
 
-@partial(jax.jit, static_argnums=(0,))
+@partial(ledger_jit, label="coord/re_bucket_variances", static_argnums=(0,))
 def _jitted_re_bucket_variances(
     objective: GLMObjective,
     features: Array,  # [e, cap, d]
@@ -686,7 +687,7 @@ def _jitted_re_bucket_variances(
     return var_table.at[entity_rows].set(vs)
 
 
-@partial(jax.jit, static_argnums=(0,))
+@partial(ledger_jit, label="coord/re_bucket_variances_diagonal", static_argnums=(0,))
 def _jitted_re_bucket_variances_diagonal(
     objective: GLMObjective,
     features: Array,
@@ -710,7 +711,7 @@ def _jitted_re_bucket_variances_diagonal(
     return var_table.at[entity_rows].set(vs)
 
 
-@partial(jax.jit, static_argnums=(0,))
+@partial(ledger_jit, label="coord/re_bucket_variances_indexmap", static_argnums=(0,))
 def _jitted_re_bucket_variances_indexmap(
     objective: GLMObjective,
     features: Array,  # [e, cap, k] index-projected (possibly pre-normalized)
@@ -738,7 +739,7 @@ def _jitted_re_bucket_variances_indexmap(
     return var_ext.at[entity_rows[:, None], col_index].set(vs)
 
 
-@partial(jax.jit, static_argnums=(0,))
+@partial(ledger_jit, label="coord/re_bucket_variances_indexmap_diagonal", static_argnums=(0,))
 def _jitted_re_bucket_variances_indexmap_diagonal(
     objective: GLMObjective,
     features: Array,
@@ -814,7 +815,7 @@ def solve_entity_bucket_indexmap_traced(
     return table_ext.at[:, -1].set(0.0), trace
 
 
-@partial(jax.jit, static_argnums=(0,))
+@partial(ledger_jit, label="coord/re_bucket_variances_random", static_argnums=(0,))
 def _jitted_re_bucket_variances_random(
     objective: GLMObjective,
     features: Array,  # [e, cap, k] (already projected)
@@ -877,7 +878,7 @@ def _recover_sketch_coefficients(rows: Array, matrix: Array) -> Array:
     return jnp.linalg.solve(gram, (rows @ matrix).T).T
 
 
-@partial(jax.jit, static_argnums=(0,))
+@partial(ledger_jit, label="coord/re_bucket_variances_random_diagonal", static_argnums=(0,))
 def _jitted_re_bucket_variances_random_diagonal(
     objective: GLMObjective,
     features: Array,
@@ -947,7 +948,7 @@ def solve_entity_bucket_random_traced(
     return table.at[entity_rows].set(solved @ matrix.T), trace
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@partial(ledger_jit, label="coord/re_bucket_solve_indexmap", static_argnums=(0, 1))
 def _jitted_re_bucket_solve_indexmap(
     objective: GLMObjective,
     opt: OptimizerConfig,
@@ -966,7 +967,7 @@ def _jitted_re_bucket_solve_indexmap(
     )
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@partial(ledger_jit, label="coord/re_bucket_solve_random", static_argnums=(0, 1))
 def _jitted_re_bucket_solve_random(
     objective: GLMObjective,
     opt: OptimizerConfig,

@@ -66,6 +66,7 @@ from photon_ml_tpu.optim.common import ConvergenceReason, LaneTrace
 from photon_ml_tpu.optim.optimizer import LaneSchedulerConfig, OptimizerConfig
 from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.telemetry import tracing
+from photon_ml_tpu.telemetry.program_ledger import ledger_jit
 
 Array = jax.Array
 
@@ -113,7 +114,7 @@ class SchedulerStats:
 # cache, so power-of-two rescue padding bounds compilation.
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@partial(ledger_jit, label="scheduler/solve_identity", static_argnums=(0, 1))
 def _block_solve_identity(
     objective, opt: OptimizerConfig,
     features: Array, labels: Array, weights: Array,
@@ -131,7 +132,7 @@ def _block_solve_identity(
     return table.at[entity_rows].set(solved), trace, delta, wnorm
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@partial(ledger_jit, label="scheduler/solve_indexmap", static_argnums=(0, 1))
 def _block_solve_indexmap(
     objective, opt: OptimizerConfig,
     features: Array, labels: Array, weights: Array,
@@ -150,7 +151,7 @@ def _block_solve_indexmap(
     return table_ext.at[:, -1].set(0.0), trace, delta, wnorm
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@partial(ledger_jit, label="scheduler/solve_random", static_argnums=(0, 1))
 def _block_solve_random(
     objective, opt: OptimizerConfig,
     features: Array, labels: Array, weights: Array,
@@ -168,7 +169,7 @@ def _block_solve_random(
     return table.at[entity_rows].set(solved @ matrix.T), trace, delta, wnorm
 
 
-@jax.jit
+@partial(ledger_jit, label="scheduler/extend_scratch")
 def _extend_scratch(table: Array) -> Array:
     """[E, d] -> [E, d+1]: the INDEX_MAP scratch column that absorbs padding
     gather/scatter slots (algorithm/coordinates.py convention)."""
@@ -177,7 +178,7 @@ def _extend_scratch(table: Array) -> Array:
     )
 
 
-@jax.jit
+@partial(ledger_jit, label="scheduler/strip_scratch")
 def _strip_scratch(table_ext: Array) -> Array:
     return table_ext[:, :-1]
 

@@ -45,6 +45,7 @@ from photon_ml_tpu.models.matrix_factorization import (
 )
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.optim.optimizer import OptimizerConfig
+from photon_ml_tpu.telemetry.program_ledger import ledger_jit
 from photon_ml_tpu.types import TaskType
 
 Array = jax.Array
@@ -206,7 +207,7 @@ def solve_mf_side_bucket(
     return table.at[entity_rows].set(solved)
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@partial(ledger_jit, label="coord/mf_side_solve", static_argnums=(0, 1))
 def _jitted_mf_side_solve(
     objective: GLMObjective,
     opt: OptimizerConfig,

@@ -54,6 +54,7 @@ from photon_ml_tpu.io.stream_reader import (
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.ops.sparse_objective import SparseGLMObjective
 from photon_ml_tpu.telemetry import tracing
+from photon_ml_tpu.telemetry.program_ledger import ledger_jit
 
 Array = jax.Array
 
@@ -63,7 +64,8 @@ Array = jax.Array
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("objective",))
+@partial(ledger_jit, label="streaming/accumulate_value_grad",
+         static_argnames=("objective",))
 def _accumulate_value_grad(acc_value, acc_grad, coefficients, batch, *, objective):
     """acc += chunk's DATA value/gradient (no regularization — that is
     added once per epoch, after any cross-rank sum). The accumulators are
@@ -72,7 +74,8 @@ def _accumulate_value_grad(acc_value, acc_grad, coefficients, batch, *, objectiv
     return acc_value + value, acc_grad + grad
 
 
-@partial(jax.jit, static_argnames=("objective",))
+@partial(ledger_jit, label="streaming/accumulate_hessian_vector",
+         static_argnames=("objective",))
 def _accumulate_hessian_vector(acc_hv, coefficients, vector, batch, *, objective):
     """acc += chunk's DATA Hessian-vector product (TRON's CG inner loop)."""
     return acc_hv + objective.hessian_vector(coefficients, vector, batch)

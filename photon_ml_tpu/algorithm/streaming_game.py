@@ -96,6 +96,7 @@ from photon_ml_tpu.parallel.distributed import (
 )
 from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.telemetry import stream_counters, tracing
+from photon_ml_tpu.telemetry.program_ledger import ledger_jit
 from photon_ml_tpu.types import TaskType
 
 Array = jax.Array
@@ -115,7 +116,8 @@ DEFAULT_BUCKET_SIZES = (8, 32, 128, 512, 2048)
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("objective", "opt"))
+@partial(ledger_jit, label="streaming_game/solve_re_chunk_bucket",
+         static_argnames=("objective", "opt"))
 def _solve_re_chunk_bucket(table, batch, *, objective, opt):
     """Solve one chunk-local entity bucket and scatter into the [E, d]
     table. ``batch``: features [e, cap, d], labels/weights/offsets
@@ -137,7 +139,8 @@ def _solve_re_chunk_bucket(table, batch, *, objective, opt):
     return table.at[batch["entity_rows"]].set(solved), trace, movement
 
 
-@partial(jax.jit, static_argnames=("objective",))
+@partial(ledger_jit, label="streaming_game/fe_margin_chunk",
+         static_argnames=("objective",))
 def _fe_margin_chunk(w, batch, *, objective):
     """Pure FE margin of one chunk (no offsets) from normalized-space
     coefficients — the chunk-wise twin of GameTrainProgram's
@@ -147,7 +150,7 @@ def _fe_margin_chunk(w, batch, *, objective):
     return batch["features"] @ eff - norm.margin_shift(eff)
 
 
-@jax.jit
+@partial(ledger_jit, label="streaming_game/re_score_chunk")
 def _re_score_chunk(table, batch):
     """One chunk's RE coordinate scores: x_i . table[entity_idx_i]
     (0 for absent entities / padding rows)."""

@@ -118,6 +118,16 @@ def run(
     reset_timings()
     reset_resilience_metrics()
     journal = RunJournal(telemetry_dir) if telemetry_dir else None
+    # program ledger rides --telemetry-dir (ISSUE 13): the scoring program
+    # (score/score_dataset) journals its compile/cost/signature accounting
+    ledger = None
+    if journal is not None:
+        from photon_ml_tpu.telemetry.program_ledger import (
+            ProgramLedger,
+            install_ledger,
+        )
+
+        ledger = install_ledger(ProgramLedger(journal=journal))
     tracer = None
     if trace_dir:
         from photon_ml_tpu.telemetry.tracing import Tracer, install_tracer
@@ -169,6 +179,10 @@ def run(
                 )
             finally:
                 uninstall_tracer()
+        if ledger is not None:
+            from photon_ml_tpu.telemetry.program_ledger import uninstall_ledger
+
+            uninstall_ledger()
         # failure-path journaling too: the resilience/* counters (retries,
         # giveups, quarantined_blocks) and quarantine spans are exactly
         # what a post-mortem of a dead scoring run needs

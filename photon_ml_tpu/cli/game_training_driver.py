@@ -456,6 +456,17 @@ def run(params: GameTrainingParams) -> dict:
         registry=default_registry() if journal and journal.active else None,
     )
     compiles = CompileMonitor()
+    # program ledger rides --telemetry-dir (ISSUE 13): labeled jit sites
+    # (train/step, coord/*, scheduler/*, score/*) journal per-program
+    # compile/cost rows with recompile attribution; inert without it
+    ledger = None
+    if journal is not None:
+        from photon_ml_tpu.telemetry.program_ledger import (
+            ProgramLedger,
+            install_ledger,
+        )
+
+        ledger = install_ledger(ProgramLedger(journal=journal))
     # span tracing is opt-in via --trace-dir; installed before any stage so
     # a failure mid-read still leaves a timeline on every rank
     tracer = None
@@ -498,6 +509,10 @@ def run(params: GameTrainingParams) -> dict:
                 )
             finally:
                 uninstall_tracer()
+        if ledger is not None:
+            from photon_ml_tpu.telemetry.program_ledger import uninstall_ledger
+
+            uninstall_ledger()
         # journal phase timings / gauges on failure too — a failed run's
         # journal is the one that most needs them. The registry snapshot
         # carries the resilience/* counters (retries, giveups,

@@ -255,6 +255,17 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
     journal = (
         RunJournal(params.telemetry_dir) if params.telemetry_dir else None
     )
+    # program ledger rides --telemetry-dir (ISSUE 13): labeled jit sites
+    # journal per-program compile/cost/signature rows with recompile
+    # attribution; inert (null-object) without it
+    ledger = None
+    if journal is not None:
+        from photon_ml_tpu.telemetry.program_ledger import (
+            ProgramLedger,
+            install_ledger,
+        )
+
+        ledger = install_ledger(ProgramLedger(journal=journal))
     # journal + registry are opt-in via --telemetry-dir; the emitter rides
     # along unconditionally (per-λ OptimizationLogEvents for any registered
     # listener). SolverTelemetry builds nothing — paying no host reads —
@@ -336,6 +347,10 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
                 )
             finally:
                 uninstall_tracer()
+        if ledger is not None:
+            from photon_ml_tpu.telemetry.program_ledger import uninstall_ledger
+
+            uninstall_ledger()
         # journal phase timings / gauges on failure too — a failed run's
         # journal is the one that most needs them (the registry snapshot
         # carries the resilience/* counters)

@@ -91,6 +91,18 @@ def run(
     reset_resilience_metrics()
     reset_serving_metrics()
     journal = RunJournal(telemetry_dir) if telemetry_dir else None
+    # the program ledger rides --telemetry-dir (ISSUE 13): every labeled
+    # jit dispatch journals its compile/signature accounting, so a nonzero
+    # replay compile count arrives WITH its attributed cause (the
+    # program_recompile row naming the differing signature leaves)
+    ledger = None
+    if journal is not None:
+        from photon_ml_tpu.telemetry.program_ledger import (
+            ProgramLedger,
+            install_ledger,
+        )
+
+        ledger = install_ledger(ProgramLedger(journal=journal))
     tracer = None
     if trace_dir:
         from photon_ml_tpu.telemetry.tracing import Tracer, install_tracer
@@ -119,6 +131,10 @@ def run(
             journal.record("serving_summary", **summary)
         return summary
     finally:
+        if ledger is not None:
+            from photon_ml_tpu.telemetry.program_ledger import uninstall_ledger
+
+            uninstall_ledger()
         if tracer is not None:
             from photon_ml_tpu.telemetry.tracing import (
                 flush_trace_best_effort,
@@ -226,7 +242,12 @@ def _run_inner(
         len(requests), total_rows, shapes,
     )
 
+    from photon_ml_tpu.telemetry.program_ledger import current_ledger
+
+    ledger = current_ledger()
     scorer = ResidentScorer(model, shapes=shapes, bf16=bf16)
+    if ledger is not None:
+        ledger.set_phase("warm")
     with Timed("warm compile"), CompileMonitor() as warm_compiles:
         scorer.warm(requests[0])
 
@@ -251,6 +272,11 @@ def _run_inner(
 
         reset_serving_metrics()
 
+    if ledger is not None:
+        # replay compiles are the SLO violation serving pins at zero: the
+        # phase stamp makes any program_compile row from here on
+        # attributable to the replay, not the warm-up
+        ledger.set_phase("replay")
     with Timed("batched replay"), CompileMonitor() as replay_compiles:
         server = MicroBatchServer(
             scorer,
@@ -281,6 +307,10 @@ def _run_inner(
         "compiled_signatures": len(scorer.signatures),
         "warm_compiles": warm_compiles.count,
         "replay_compiles": replay_compiles.count,
+        # per-label compile accounting from the program ledger (None when
+        # --telemetry-dir is off): the count's attribution lives in the
+        # journal's program_compile/program_recompile rows, phase-stamped
+        "program_compiles": None if ledger is None else ledger.snapshot(),
     }
     with open(os.path.join(output_dir, "serving-summary.json"), "w") as f:
         from photon_ml_tpu.cli.game_training_driver import _json_safe

@@ -74,6 +74,7 @@ from photon_ml_tpu.optim.optimizer import (
     resolve_auto_optimizer,
     solve,
 )
+from photon_ml_tpu.telemetry.program_ledger import ledger_jit
 from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.types import TaskType
 
@@ -1072,7 +1073,7 @@ def train_glm_grid(
     return models
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+@functools.partial(ledger_jit, label="glm/grid_solve", static_argnums=(0, 1, 2, 3, 4, 5))
 def _jitted_grid_solve(objective, use_owlqn, history, max_iter, tolerance,
                        rel_function_tolerance, batch, l2v, l1v, bounds=None):
     """Module-level jit: one compiled vmapped-grid program per
@@ -1126,14 +1127,14 @@ def _objective_for_batch(batch, loss, l2_weight, normalization,
                         use_pallas=use_pallas)
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
+@functools.partial(ledger_jit, label="glm/grid_diagonals", static_argnums=(0,))
 def _jitted_grid_diagonals(objective, batch, coeffs, l2v):
     """All lanes' Hessian diagonals in one shared read of the feature block."""
     per_lane = lambda w, l2: objective.hessian_diagonal(w, batch) + l2
     return jax.vmap(per_lane)(coeffs, l2v)
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
+@functools.partial(ledger_jit, label="glm/grid_full_variances", static_argnums=(0,))
 def _jitted_grid_full_variances(objective, batch, coeffs, l2v):
     """All lanes' diag(H⁻¹) (DistributedOptimizationProblem.scala:82-96)."""
     def per_lane(w, l2):

@@ -55,6 +55,7 @@ from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.optim.optimizer import OptimizerConfig, solve
+from photon_ml_tpu.telemetry.program_ledger import ledger_jit
 from photon_ml_tpu.types import TaskType
 
 Array = jax.Array
@@ -469,8 +470,11 @@ class GameTrainProgram:
                                  use_pallas=False)
             for m in self.mf_specs
         }
-        self._step = jax.jit(self._step_impl)
-        self._score = jax.jit(self._score_impl)
+        # ledger-labeled programs (telemetry/program_ledger.py): the whole
+        # CD sweep and the validation score, the two hottest signatures of
+        # a training run
+        self._step = ledger_jit(self._step_impl, label="train/step")
+        self._score = ledger_jit(self._score_impl, label="train/score")
 
     def fe_coefficients_model_space(self, state: GameTrainState,
                                     intercept_index: int | None = None) -> Array:
@@ -821,22 +825,35 @@ class GameTrainProgram:
         jits = getattr(self, "_sched_jits", None)
         if jits is None:
             jits = {
-                "scores": jax.jit(self._coordinate_scores),
-                "fe_solve": jax.jit(self._solve_primary_fe),
-                "fe_margin": jax.jit(self._fe_margin_score),
-                "extra_fe_solve": jax.jit(
-                    self._solve_extra_fe, static_argnums=(1,)
+                "scores": ledger_jit(self._coordinate_scores,
+                                     label="train/sched_scores"),
+                "fe_solve": ledger_jit(self._solve_primary_fe,
+                                       label="train/sched_fe_solve"),
+                "fe_margin": ledger_jit(self._fe_margin_score,
+                                        label="train/sched_fe_margin"),
+                "extra_fe_solve": ledger_jit(
+                    self._solve_extra_fe, label="train/sched_extra_fe_solve",
+                    static_argnums=(1,)
                 ),
-                "extra_fe_margin": jax.jit(
-                    self._extra_fe_margin, static_argnums=(1,)
+                "extra_fe_margin": ledger_jit(
+                    self._extra_fe_margin,
+                    label="train/sched_extra_fe_margin", static_argnums=(1,)
                 ),
-                "re_solve": jax.jit(self._solve_re, static_argnums=(2,)),
-                "re_score": jax.jit(
-                    self._re_coordinate_score, static_argnums=(1, 3)
+                "re_solve": ledger_jit(self._solve_re,
+                                       label="train/sched_re_solve",
+                                       static_argnums=(2,)),
+                "re_score": ledger_jit(
+                    self._re_coordinate_score, label="train/sched_re_score",
+                    static_argnums=(1, 3)
                 ),
-                "mf_solve": jax.jit(self._solve_mf, static_argnums=(2,)),
-                "offsets": jax.jit(self._sum_scores, static_argnums=(2,)),
-                "loss": jax.jit(self._weighted_loss),
+                "mf_solve": ledger_jit(self._solve_mf,
+                                       label="train/sched_mf_solve",
+                                       static_argnums=(2,)),
+                "offsets": ledger_jit(self._sum_scores,
+                                      label="train/sched_offsets",
+                                      static_argnums=(2,)),
+                "loss": ledger_jit(self._weighted_loss,
+                                   label="train/sched_loss"),
             }
             self._sched_jits = jits
         return jits

@@ -38,6 +38,7 @@ from photon_ml_tpu.models.game import (
     score_random_effect_compact,
 )
 from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
+from photon_ml_tpu.telemetry.program_ledger import ledger_jit
 
 Array = jax.Array
 
@@ -137,7 +138,12 @@ class DistributedScorer:
             raise ValueError("fe_feature_sharded requires a mesh")
         #: layout-signature -> placed params (see params_for_layouts)
         self._params_cache: dict = {}
-        self._jit_score = jax.jit(self._score_impl)
+        self._params_cache_bytes: int = 0
+        # ledger-labeled program (telemetry/program_ledger.py): data and
+        # params both enter as ARGUMENTS; the label keys compile/cost/
+        # recompile accounting when a ProgramLedger is installed
+        self._jit_score = ledger_jit(self._score_impl,
+                                     label="score/score_dataset")
 
     # -- data preparation ----------------------------------------------------
 
@@ -297,6 +303,23 @@ class DistributedScorer:
             if self.mesh is not None:
                 params = self._place_params(params)
             self._params_cache[key] = cached = params
+            # resident-params accounting (the HBM-forecast input of the
+            # program ledger): total bytes across every cached layout's
+            # placed params — metadata only, no device work
+            self._params_cache_bytes = sum(
+                leaf.nbytes
+                for entry in self._params_cache.values()
+                for leaf in jax.tree_util.tree_leaves(entry)
+                if hasattr(leaf, "nbytes")
+            )
+        # re-fed on HITS too: reset_serving_metrics() mid-run (the serve
+        # driver resets between its baseline and the replay) would
+        # otherwise leave the gauge empty for the rest of the run
+        from photon_ml_tpu.telemetry import serving_counters
+
+        serving_counters.set_resident_params_bytes(
+            int(self._params_cache_bytes)
+        )
         return cached
 
     def _place_data(self, data):

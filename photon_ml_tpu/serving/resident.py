@@ -46,7 +46,8 @@ from photon_ml_tpu.data.game_data import (
 )
 from photon_ml_tpu.models.game import GameModel
 from photon_ml_tpu.parallel.scoring import DistributedScorer, _pad_nnz
-from photon_ml_tpu.telemetry import serving_counters, tracing
+from photon_ml_tpu.telemetry import program_ledger, serving_counters, tracing
+from photon_ml_tpu.telemetry.program_ledger import ledger_jit
 
 #: default micro-batch shape buckets (rows); requests pad to the smallest
 #: bucket that fits and split across the largest when they exceed it
@@ -126,9 +127,18 @@ class ResidentScorer:
         # program as ARGUMENTS; nothing request- or model-sized is closed
         # over. donate_argnums=(0,) donates only the per-request data
         # buffers; params survive every call (they are the resident state).
-        self._program = (
-            jax.jit(self._scorer._score_impl, donate_argnums=(0,))
-            if self.donate else self._scorer._jit_score
+        # The program carries the "serve/score" ledger label (ISSUE 13):
+        # with a ProgramLedger installed, every serving compile — warm or,
+        # pathologically, mid-replay — journals its signature and
+        # recompile attribution under that label. The non-donate path
+        # therefore owns its program instead of aliasing the batch
+        # scorer's (serving compiles must not hide under
+        # score/score_dataset); the jit caches only coincided when a
+        # micro-batch signature exactly matched a prior full-dataset
+        # score, so the bound stays the bucket set either way.
+        self._program = ledger_jit(
+            self._scorer._score_impl, label="serve/score",
+            donate_argnums=(0,) if self.donate else (),
         )
         self._bf16_params_cache: dict = {}
         self._signatures: set = set()
@@ -258,16 +268,25 @@ class ResidentScorer:
                 data = self._scorer._place_data(data)
             params = self._params(layouts)
             sig = (bucket, tuple(sorted(layouts.items())), nnz_sig)
-            if sig not in self._signatures:
-                self._signatures.add(sig)
-                serving_counters.set_compiled_signatures(
-                    len(self._signatures)
-                )
+            self._signatures.add(sig)
             if self._scorer.mesh is not None:
                 with self._scorer.mesh:
                     out = self._program(data, params)
             else:
                 out = self._program(data, params)
+            # the compiled-signature gauge is ledger-backed (ISSUE 13):
+            # with a ProgramLedger installed the count comes from the
+            # "serve/score" program's observed signature registry; the
+            # local (bucket, layout, nnz) set is the fallback — and stays
+            # the public ``signatures`` property either way
+            ledger = program_ledger.current_ledger()
+            ledger_sigs = (
+                ledger.signature_count("serve/score")
+                if ledger is not None else 0
+            )
+            serving_counters.set_compiled_signatures(
+                ledger_sigs or len(self._signatures)
+            )
             scores = np.asarray(out)[:n]
             serving_counters.record_scored(rows=n, padded_rows=bucket - n)
         if scores.dtype != np.float32 and self.bf16:

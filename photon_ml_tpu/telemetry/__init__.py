@@ -33,6 +33,14 @@ from photon_ml_tpu.telemetry.probes import (
     scan_step_marginal,
     stream_calibration,
 )
+from photon_ml_tpu.telemetry.program_ledger import (
+    ProgramLedger,
+    current_ledger,
+    install_ledger,
+    ledger_active,
+    ledger_jit,
+    uninstall_ledger,
+)
 from photon_ml_tpu.telemetry.registry import (
     Counter,
     Gauge,
@@ -95,6 +103,12 @@ __all__ = [
     "read_scalar",
     "scan_step_marginal",
     "stream_calibration",
+    "ProgramLedger",
+    "current_ledger",
+    "install_ledger",
+    "ledger_active",
+    "ledger_jit",
+    "uninstall_ledger",
     "Counter",
     "Gauge",
     "Histogram",

@@ -82,10 +82,36 @@ def json_safe(obj):
 
 #: fields the journal stamps onto every heartbeat row itself — everything
 #: ELSE in the row is the caller's progress cursor (dev/doctor.py and
-#: telemetry/verdicts.py both print "where was the run" from this split)
+#: telemetry/verdicts.py both print "where was the run" from this split).
+#: ``hbm_bytes`` and ``compiles`` are the ISSUE 13 drift snapshots: live
+#: device-buffer bytes and the backend compile count, so ``doctor --live``
+#: can show device-memory drift and mid-run compile storms on a wedged run.
 _HEARTBEAT_BOOKKEEPING = frozenset(
-    {"kind", "seq", "ts", "elapsed_ms", "counter_deltas", "gauges"}
+    {"kind", "seq", "ts", "elapsed_ms", "counter_deltas", "gauges",
+     "hbm_bytes", "compiles"}
 )
+
+
+def _live_hbm_bytes() -> "int | None":
+    """Live device-buffer bytes for heartbeat rows; None unless a jax
+    backend is ALREADY initialized — a heartbeat must never force one
+    (journal-only processes exist, e.g. the SIGKILL chaos subprocess, and
+    on the tunneled platform a FIRST device call can block on the relay;
+    merely having jax imported is not enough). Observe-only: the probe
+    must never gate (or fail) a heartbeat. Training/scoring loops always
+    have a live backend by their first heartbeat, so the field is only
+    absent where probing would have been wrong anyway."""
+    import sys
+
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None or not getattr(xb, "_backends", None):
+        return None
+    try:
+        from photon_ml_tpu.telemetry.probes import live_buffer_bytes
+
+        return int(live_buffer_bytes())
+    except (ImportError, RuntimeError):
+        return None
 
 
 def heartbeat_cursor(row: dict) -> dict:
@@ -207,11 +233,20 @@ class RunJournal:
         if not self.active:
             return
         fields = dict(cursor)
+        hbm = _live_hbm_bytes()
+        if hbm is not None:
+            fields["hbm_bytes"] = hbm
         if registry is not None:
             snap = registry.snapshot()
             counters = {
                 str(k): int(v) for k, v in (snap.get("counters") or {}).items()
             }
+            # absolute compile-count snapshot (the delta alone cannot show
+            # a storm's trajectory across heartbeats)
+            from photon_ml_tpu.telemetry.probes import COMPILE_COUNT_METRIC
+
+            if COMPILE_COUNT_METRIC in counters:
+                fields["compiles"] = counters[COMPILE_COUNT_METRIC]
             deltas = {
                 k: v - self._hb_counters.get(k, 0)
                 for k, v in counters.items()

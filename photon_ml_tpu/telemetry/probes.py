@@ -175,8 +175,13 @@ def stream_calibration(
 
 # --- compile-event monitoring (jax.monitoring) ------------------------------
 
-_COMPILE_COUNTER = "jax/backend_compile_count"
-_COMPILE_SECONDS = "jax/backend_compile_seconds"
+#: registry names of the backend-compile counter/histogram the listener
+#: feeds — public so the program ledger (telemetry/program_ledger.py) can
+#: take scoped deltas against them and heartbeats can snapshot the count
+COMPILE_COUNT_METRIC = "jax/backend_compile_count"
+COMPILE_SECONDS_METRIC = "jax/backend_compile_seconds"
+_COMPILE_COUNTER = COMPILE_COUNT_METRIC
+_COMPILE_SECONDS = COMPILE_SECONDS_METRIC
 #: registries that already have a listener feeding them (the listener holds
 #: a strong reference, so the id() stays unique for the registry's lifetime)
 _installed_registry_ids: set[int] = set()
@@ -250,3 +255,20 @@ def live_buffer_bytes(device=None) -> int:
     if stats and "bytes_in_use" in stats:
         return int(stats["bytes_in_use"])
     return int(sum(a.nbytes for a in jax.live_arrays()))
+
+
+def device_memory_limit_bytes(device=None) -> "int | None":
+    """Allocator ``bytes_limit`` where the backend reports one (real TPUs);
+    None on backends without memory_stats (virtual CPU meshes) — the
+    capability-probe shape of :func:`live_buffer_bytes`, and the budget the
+    program ledger's HBM-overcommit forecast is judged against."""
+    import jax
+
+    dev = device or jax.local_devices()[0]
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    if stats and "bytes_limit" in stats:
+        return int(stats["bytes_limit"])
+    return None

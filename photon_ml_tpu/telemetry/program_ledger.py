@@ -1,0 +1,624 @@
+"""Compiled-program ledger: per-program compile, cost, and HBM accounting
+with recompile attribution.
+
+No reference analogue: Photon-ML's unit of execution observability is the
+Spark task (SURVEY.md §5); here the whole perf story rides a bounded set of
+module-level jitted programs (streaming accumulators, vmapped bucket
+solvers, serving shape buckets), so the compiled PROGRAM becomes the
+first-class observed object — the DrJAX framing (arXiv:2403.07128: the
+single traced program is the unit of system reasoning) crossed with
+Snap ML's memory-hierarchy budgeting (arXiv:1803.06333).
+
+Design (ISSUE 13):
+
+- ``ledger_jit(fn, label=...)`` wraps ``jax.jit`` with a STABLE LABEL.
+  Inert null-object by default (the tracing discipline — telemetry/
+  tracing.py): with no ledger installed the wrapper is one global read +
+  a passthrough call; installing a :class:`ProgramLedger` turns every
+  labeled call into an observation. Observes, NEVER gates: the wrapped
+  program dispatches exactly as the raw jit would — same arguments, same
+  donation, same order (ledger on/off is pinned bitwise by
+  tests/test_program_ledger.py).
+- **Compile detection is a scoped compile-counter delta** around each
+  dispatch (probes.install_compile_listener feeds the counter; the repo's
+  dispatch model is single-consumer, so the delta attributes cleanly).
+  This catches every real compile — new shapes, fresh program instances,
+  evicted executables — without guessing from the signature cache.
+- **Signatures** record every argument leaf's aval (shape, dtype,
+  sharding), weak-typed python scalars (whose VALUE changes never
+  recompile — they are deliberately not part of the signature), and
+  static args (described by value for simple types, by type+hash
+  otherwise — matching jit's own static-arg cache semantics, where a
+  fresh instance with identity hash IS a new cache entry).
+- **Recompile attribution is the headline**: a compile under a label that
+  already compiled diffs the new signature against the previous compiled
+  one and journals the exact differing leaves — turning "compile count
+  went up" into "arg3.features: shape (16384, 8) -> (16000, 8) at
+  streaming/accumulate_value_grad".
+- **Cost analysis is free; memory analysis is not.** ``Lowered.
+  cost_analysis()`` is an HLO-level analysis with NO backend compile
+  (measured on this stack), so it runs for every new signature.
+  ``Compiled.memory_analysis()`` requires an AOT ``lowered.compile()``,
+  which this JAX does NOT share with the dispatch cache — a real second
+  backend compile (measured; ~an extra remote compile per signature on
+  the tunnel) — so it is opt-in (``analyze_memory=True``). Both degrade
+  gracefully to None fields where the backend doesn't implement them
+  (the CPU mesh), never raising into the dispatch path.
+- **HBM forecast**: with memory analysis on, each compile row carries
+  ``hbm_forecast_bytes`` = resident placed params (the layout-keyed
+  cache's ``serve/resident_params_bytes`` gauge when fed, else the live
+  device-buffer bytes probe) + the program's temp bytes, against the
+  device's ``bytes_limit`` where the backend reports one —
+  telemetry/verdicts.py turns forecast > limit into a finding.
+
+Calls made while a jax trace is in flight bypass the ledger entirely: an
+inner jitted step invoked during an outer trace inlines into the outer
+program — it is not a separately dispatched program, and observing it
+would double-count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+#: registry namespace for every per-label metric the ledger emits
+LEDGER_METRIC_PREFIX = "xla/"
+
+#: journal row kinds (dev/doctor.py's ledger table reads all three)
+COMPILE_ROW = "program_compile"
+RECOMPILE_ROW = "program_recompile"
+SIGNATURE_ROW = "program_signature"
+
+#: signatures retained per label for diffing; the oldest fall off (the
+#: bounded-signature discipline is the point — a label that outgrows this
+#: is itself the signature-churn pathology)
+MAX_SIGNATURES_PER_LABEL = 64
+
+#: cost_analysis keys worth journaling (the per-opcode utilization{...}
+#: expansions are dropped — rows must stay small)
+_COST_KEYS = ("flops", "bytes accessed", "transcendentals", "optimal_seconds")
+
+#: CompiledMemoryStats attributes journaled when memory analysis runs
+_MEMORY_ATTRS = (
+    "generated_code_size_in_bytes",
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "alias_size_in_bytes",
+    "temp_size_in_bytes",
+    "peak_memory_in_bytes",
+)
+
+
+# ---------------------------------------------------------------------------
+# Signatures
+# ---------------------------------------------------------------------------
+
+#: leaf kinds
+ARRAY = "array"
+WEAK = "weak"
+STATIC = "static"
+
+
+def _describe_static(v) -> str:
+    """Stable description of a static argument, matching jit's cache
+    semantics: simple values by repr (value-equal -> same entry), rich
+    objects by type + hash (a default identity hash means a fresh instance
+    IS a new jit cache entry, and the ledger must say so)."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return repr(v)
+    if isinstance(v, tuple):
+        return "(" + ", ".join(_describe_static(x) for x in v) + ")"
+    try:
+        h = hash(v)
+    except TypeError:
+        return f"{type(v).__qualname__}@{id(v):#x}"
+    return f"{type(v).__qualname__}#{h}"
+
+
+def _describe_leaf(v) -> tuple:
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is not None and dtype is not None:
+        sharding = getattr(v, "sharding", None)
+        return (
+            ARRAY,
+            tuple(int(s) for s in shape),
+            str(dtype),
+            None if sharding is None else str(sharding),
+        )
+    if isinstance(v, (bool, int, float, complex)):
+        # traced weak-typed scalar: its VALUE never keys the jit cache
+        return (WEAK, type(v).__name__)
+    return (STATIC, _describe_static(v))
+
+
+def _path_str(path) -> str:
+    """['arg0'].features-style keys, compactly joined with dots."""
+    parts = []
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if key is None:
+            key = getattr(entry, "name", None)
+        if key is None:
+            key = getattr(entry, "idx", None)
+        parts.append(str(key) if key is not None else str(entry))
+    return ".".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSignature:
+    """One call's argument signature: dynamic leaves (path -> aval
+    description) + static args (name -> description)."""
+
+    leaves: tuple  # ((path, desc-tuple), ...)
+    static: tuple  # ((name, description), ...)
+
+    @property
+    def key(self):
+        return (self.leaves, self.static)
+
+    def to_json(self) -> dict:
+        return {
+            "leaves": [
+                {"path": p, "kind": d[0],
+                 **({"shape": list(d[1]), "dtype": d[2], "sharding": d[3]}
+                    if d[0] == ARRAY else {"value": d[1]})}
+                for p, d in self.leaves
+            ],
+            "static": [{"name": n, "value": s} for n, s in self.static],
+        }
+
+
+def build_signature(args, kwargs, static_argnums=(), static_argnames=()) -> ProgramSignature:
+    import jax
+
+    dyn: dict = {}
+    statics: list = []
+    nums = set(static_argnums or ())
+    names = set(static_argnames or ())
+    for i, a in enumerate(args):
+        if i in nums:
+            statics.append((f"arg{i}", _describe_static(a)))
+        else:
+            dyn[f"arg{i}"] = a
+    for k, v in kwargs.items():
+        if k in names:
+            statics.append((k, _describe_static(v)))
+        else:
+            dyn[k] = v
+    leaves = tuple(
+        (_path_str(path), _describe_leaf(leaf))
+        for path, leaf in jax.tree_util.tree_flatten_with_path(dyn)[0]
+    )
+    return ProgramSignature(leaves=leaves, static=tuple(sorted(statics)))
+
+
+_ARRAY_FIELDS = (("shape", 1), ("dtype", 2), ("sharding", 3))
+
+
+def diff_signatures(old: ProgramSignature, new: ProgramSignature) -> list[dict]:
+    """The differing leaves between two signatures — the attribution a
+    recompile row carries. Each change names the leaf path, the field
+    (shape/dtype/sharding/kind/presence/static) and old -> new values."""
+    changes: list[dict] = []
+    o, n = dict(old.leaves), dict(new.leaves)
+    for path in sorted(o.keys() | n.keys()):
+        a, b = o.get(path), n.get(path)
+        if a == b:
+            continue
+        if a is None or b is None:
+            changes.append({"leaf": path, "field": "presence",
+                            "old": None if a is None else list(a),
+                            "new": None if b is None else list(b)})
+            continue
+        if a[0] != b[0]:
+            changes.append({"leaf": path, "field": "kind",
+                            "old": a[0], "new": b[0]})
+            continue
+        if a[0] == ARRAY:
+            for field, idx in _ARRAY_FIELDS:
+                if a[idx] != b[idx]:
+                    changes.append({
+                        "leaf": path, "field": field,
+                        "old": list(a[idx]) if field == "shape" else a[idx],
+                        "new": list(b[idx]) if field == "shape" else b[idx],
+                    })
+        else:
+            changes.append({"leaf": path, "field": a[0],
+                            "old": a[1], "new": b[1]})
+    os_, ns_ = dict(old.static), dict(new.static)
+    for name in sorted(os_.keys() | ns_.keys()):
+        if os_.get(name) != ns_.get(name):
+            changes.append({"leaf": name, "field": "static",
+                            "old": os_.get(name), "new": ns_.get(name)})
+    return changes
+
+
+def diff_summary(changes: list[dict], limit: int = 4) -> str:
+    """One human line per recompile row: 'leaf: field old -> new; ...'."""
+    if not changes:
+        return ("signature identical to the previous compile — a fresh "
+                "program instance or an evicted executable recompiled the "
+                "same shapes")
+    parts = [
+        f"{c['leaf']}: {c['field']} {c['old']} -> {c['new']}"
+        for c in changes[:limit]
+    ]
+    if len(changes) > limit:
+        parts.append(f"(+{len(changes) - limit} more)")
+    return "; ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# The ledger
+# ---------------------------------------------------------------------------
+
+
+class _LabelRecord:
+    __slots__ = ("signatures", "order", "last_compiled", "calls", "compiles",
+                 "recompiles", "distinct")
+
+    def __init__(self):
+        self.signatures: dict = {}  # key -> ProgramSignature
+        self.order: list = []  # keys, oldest first (bounded eviction)
+        self.last_compiled: ProgramSignature | None = None
+        self.calls = 0
+        self.compiles = 0
+        self.recompiles = 0
+        #: MONOTONE distinct-signature count: eviction bounds the diff
+        #: cache above, never this — the signatures gauge and the doctor's
+        #: redundancy math (compiles - signatures) must stay exact past
+        #: max_signatures, or an unbounded-shape churn run would read as
+        #: executable thrash
+        self.distinct = 0
+
+
+class ProgramLedger:
+    """Per-label compile/cost/HBM accounting over ledger_jit call sites.
+
+    registry: metrics sink (default: the process registry) —
+    ``xla/<label>/{calls,compiles,recompiles}`` counters,
+    ``xla/<label>/compile_seconds`` histogram, ``xla/<label>/{signatures,
+    flops,bytes_accessed,temp_bytes,peak_bytes,hbm_forecast_bytes}``
+    gauges. journal: optional RunJournal — compile/recompile/signature
+    rows land there (inert on worker ranks, the journal's own rule).
+    analyze_cost: ``Lowered.cost_analysis()`` per NEW signature (default
+    on) — no backend compile, but the AOT ``lower()`` it needs re-traces
+    the program once per signature on the host (AOT lowering does not
+    share the dispatch path's trace); turn it off to make the ledger pure
+    bookkeeping on runs where tracing the biggest programs twice matters.
+    analyze_memory: opt-in ``Compiled.memory_analysis()`` — costs one
+    EXTRA backend compile per new signature on this JAX (the AOT cache is
+    not shared with dispatch; measured), so it must never default on.
+    """
+
+    def __init__(self, *, registry=None, journal=None,
+                 analyze_cost: bool = True,
+                 analyze_memory: bool = False,
+                 max_signatures: int = MAX_SIGNATURES_PER_LABEL):
+        from photon_ml_tpu.telemetry.registry import default_registry
+
+        self.registry = registry or default_registry()
+        self.journal = journal
+        self.analyze_cost = bool(analyze_cost)
+        self.analyze_memory = bool(analyze_memory)
+        self.max_signatures = int(max_signatures)
+        #: free-form run phase ("warm"/"replay"/...) stamped on rows —
+        #: serve_driver sets it so a replay compile is attributed to the
+        #: replay, not just to the label
+        self.phase: str | None = None
+        self._labels: dict[str, _LabelRecord] = {}
+        self._lock = threading.Lock()
+
+    # -- introspection -------------------------------------------------------
+
+    def set_phase(self, phase: str | None) -> None:
+        self.phase = phase
+
+    def labels(self) -> list[str]:
+        with self._lock:
+            return sorted(self._labels)
+
+    def signature_count(self, label: str) -> int:
+        """Distinct signatures observed under ``label`` — monotone (the
+        diff cache's eviction never shrinks it)."""
+        with self._lock:
+            rec = self._labels.get(label)
+            return 0 if rec is None else rec.distinct
+
+    def snapshot(self) -> dict:
+        """{label: {calls, compiles, recompiles, signatures}} — what
+        serve_driver folds into its summary."""
+        with self._lock:
+            return {
+                label: {
+                    "calls": rec.calls,
+                    "compiles": rec.compiles,
+                    "recompiles": rec.recompiles,
+                    "signatures": rec.distinct,
+                }
+                for label, rec in sorted(self._labels.items())
+            }
+
+    # -- observation ---------------------------------------------------------
+
+    def _metric(self, label: str, name: str) -> str:
+        return f"{LEDGER_METRIC_PREFIX}{label}/{name}"
+
+    def observed_call(self, jitted, label: str, args, kwargs,
+                      static_argnums=(), static_argnames=()):
+        """Dispatch ``jitted(*args, **kwargs)`` under observation. The
+        dispatch itself is untouched; everything else is bookkeeping on
+        the host, recorded on success AND failure paths."""
+        from photon_ml_tpu.telemetry import probes
+
+        probes.install_compile_listener(self.registry)
+        sig = build_signature(args, kwargs, static_argnums, static_argnames)
+        with self._lock:
+            rec = self._labels.setdefault(label, _LabelRecord())
+            is_new = sig.key not in rec.signatures
+        analysis = None
+        if is_new:
+            # args are still alive here (before any donation) — lowering
+            # needs only their avals, but never touch them post-dispatch
+            analysis = self._analyze(jitted, args, kwargs)
+        counter = self.registry.counter(probes.COMPILE_COUNT_METRIC)
+        seconds = self.registry.histogram(probes.COMPILE_SECONDS_METRIC)
+        c0, s0 = counter.value, seconds.total
+        error = None
+        try:
+            return jitted(*args, **kwargs)
+        except Exception as e:
+            error = type(e).__name__
+            raise
+        finally:
+            self._record(
+                label, sig, is_new, analysis,
+                compiles=counter.value - c0,
+                compile_seconds=seconds.total - s0,
+                error=error,
+            )
+
+    def _analyze(self, jitted, args, kwargs) -> dict:
+        """Lower the call for cost analysis (no backend compile) and, when
+        opted in, AOT-compile for memory analysis. A capability probe:
+        every failure IS the answer (None fields), logged at debug and
+        never raised into the dispatch path (reviewed broad except —
+        dev/lint_parity.py check 5 allowlist)."""
+        from photon_ml_tpu.telemetry import probes
+
+        out: dict = {"cost": None, "memory": None, "hbm_forecast_bytes": None,
+                     "device_bytes_limit": None}
+        if not (self.analyze_cost or self.analyze_memory):
+            return out
+        try:
+            lowered = jitted.lower(*args, **kwargs)
+        except Exception:
+            logger.debug("program ledger: lower() unavailable", exc_info=True)
+            return out
+        try:
+            cost = lowered.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else None
+            if cost:
+                out["cost"] = {
+                    k: float(cost[k]) for k in _COST_KEYS if k in cost
+                }
+        except Exception:
+            logger.debug("program ledger: cost_analysis unavailable",
+                         exc_info=True)
+        if not self.analyze_memory:
+            return out
+        try:
+            mem = lowered.compile().memory_analysis()
+            memory = {
+                a: int(getattr(mem, a))
+                for a in _MEMORY_ATTRS
+                if getattr(mem, a, None) is not None
+            }
+            out["memory"] = memory or None
+        except Exception:
+            logger.debug("program ledger: memory_analysis unavailable",
+                         exc_info=True)
+            return out
+        temp = (out["memory"] or {}).get("temp_size_in_bytes")
+        peak = (out["memory"] or {}).get("peak_memory_in_bytes", temp)
+        if peak is not None:
+            resident = self._resident_bytes()
+            if resident is not None:
+                out["hbm_forecast_bytes"] = int(resident) + int(peak)
+        out["device_bytes_limit"] = probes.device_memory_limit_bytes()
+        return out
+
+    def _resident_bytes(self) -> int | None:
+        """Resident placed-params bytes: the layout-keyed cache's gauge
+        when someone feeds it (parallel/scoring.py), else the live
+        device-buffer probe."""
+        from photon_ml_tpu.telemetry import serving_counters
+
+        gauge = self.registry.gauge(
+            serving_counters.RESIDENT_PARAMS_BYTES
+        ).value
+        if gauge is not None:
+            return int(gauge)
+        try:
+            from photon_ml_tpu.telemetry.probes import live_buffer_bytes
+
+            return int(live_buffer_bytes())
+        except (ImportError, RuntimeError):
+            return None
+
+    def _record(self, label: str, sig: ProgramSignature, is_new: bool,
+                analysis: dict | None, *, compiles: int,
+                compile_seconds: float, error: str | None) -> None:
+        reg = self.registry
+        with self._lock:
+            rec = self._labels[label]
+            rec.calls += 1
+            prior = rec.last_compiled
+            if prior is None:
+                # the program may have compiled before this ledger was
+                # installed — attribute against the most recent OTHER
+                # cached signature rather than dropping the diff
+                for key in reversed(rec.order):
+                    if key != sig.key:
+                        prior = rec.signatures[key]
+                        break
+            if is_new and sig.key not in rec.signatures:
+                rec.distinct += 1
+                rec.signatures[sig.key] = sig
+                rec.order.append(sig.key)
+                while len(rec.order) > self.max_signatures:
+                    del rec.signatures[rec.order.pop(0)]
+            if compiles > 0:
+                rec.compiles += compiles
+                rec.last_compiled = sig
+                if prior is not None:
+                    rec.recompiles += 1
+            num_signatures = rec.distinct
+            recompiled = compiles > 0 and prior is not None
+        reg.counter(self._metric(label, "calls")).inc()
+        reg.gauge(self._metric(label, "signatures")).set(num_signatures)
+        if compiles <= 0:
+            if is_new and self.journal is not None:
+                # observed without a compile: the program was already
+                # cached (ledger installed mid-run) — still worth a row so
+                # the doctor table covers it
+                self.journal.record(
+                    SIGNATURE_ROW, label=label, phase=self.phase,
+                    signature=sig.to_json(),
+                    cost=None if analysis is None else analysis["cost"],
+                )
+            return
+        reg.counter(self._metric(label, "compiles")).inc(compiles)
+        reg.histogram(self._metric(label, "compile_seconds")).observe(
+            compile_seconds
+        )
+        if recompiled:
+            reg.counter(self._metric(label, "recompiles")).inc()
+        cost = memory = forecast = limit = None
+        if analysis is not None:
+            cost = analysis["cost"]
+            memory = analysis["memory"]
+            forecast = analysis["hbm_forecast_bytes"]
+            limit = analysis["device_bytes_limit"]
+            if cost is not None:
+                for key, name in (("flops", "flops"),
+                                  ("bytes accessed", "bytes_accessed")):
+                    if key in cost:
+                        reg.gauge(self._metric(label, name)).set(cost[key])
+            if memory is not None:
+                for attr, name in (("temp_size_in_bytes", "temp_bytes"),
+                                   ("peak_memory_in_bytes", "peak_bytes"),
+                                   ("argument_size_in_bytes",
+                                    "argument_bytes"),
+                                   ("output_size_in_bytes", "output_bytes")):
+                    if attr in memory:
+                        reg.gauge(self._metric(label, name)).set(memory[attr])
+            if forecast is not None:
+                reg.gauge(
+                    self._metric(label, "hbm_forecast_bytes")
+                ).set(forecast)
+        if self.journal is None:
+            return
+        if recompiled:
+            changes = diff_signatures(prior, sig)
+            self.journal.record(
+                RECOMPILE_ROW, label=label, phase=self.phase,
+                changed=changes, summary=diff_summary(changes),
+                compiles=compiles,
+                compile_seconds=round(compile_seconds, 6), error=error,
+            )
+        self.journal.record(
+            COMPILE_ROW, label=label, phase=self.phase,
+            new_signature=is_new, signature=sig.to_json(),
+            compiles=compiles, compile_seconds=round(compile_seconds, 6),
+            cost=cost, memory=memory, hbm_forecast_bytes=forecast,
+            device_bytes_limit=limit, error=error,
+        )
+
+
+# ---------------------------------------------------------------------------
+# The module-level hook (inert by default) + the registration wrapper
+# ---------------------------------------------------------------------------
+
+_LEDGER: ProgramLedger | None = None
+
+
+def ledger_active() -> bool:
+    return _LEDGER is not None
+
+
+def current_ledger() -> ProgramLedger | None:
+    return _LEDGER
+
+
+def install_ledger(ledger: ProgramLedger) -> ProgramLedger:
+    """Make ``ledger`` the process-wide sink for ledger_jit sites."""
+    global _LEDGER
+    _LEDGER = ledger
+    return ledger
+
+
+def uninstall_ledger() -> ProgramLedger | None:
+    """Remove (and return) the installed ledger — drivers pair this with
+    install in a try/finally so a failed run never leaks observation into
+    the next one."""
+    global _LEDGER
+    ledger, _LEDGER = _LEDGER, None
+    return ledger
+
+
+def _as_tuple(v) -> tuple:
+    if v is None:
+        return ()
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v,)
+
+
+def ledger_jit(fn=None, *, label: str, **jit_kwargs):
+    """``jax.jit`` with a stable program label the ledger observes by.
+
+    Drop-in at every hot jit site (dev/lint_parity.py check 13 makes the
+    labeling structural in algorithm/, serving/ and parallel/): identical
+    dispatch semantics — all ``jit_kwargs`` (static_argnums/names,
+    donate_argnums, ...) pass straight through — plus, when a ledger is
+    installed, per-call compile/cost/signature observation. Usable bare
+    or through ``partial`` as a decorator. Calls made while a jax trace
+    is in flight bypass observation (an inlined inner step is not a
+    dispatched program).
+    """
+    if fn is None:
+        return functools.partial(ledger_jit, label=label, **jit_kwargs)
+    import jax
+
+    jitted = jax.jit(fn, **jit_kwargs)
+    static_argnums = _as_tuple(jit_kwargs.get("static_argnums"))
+    static_argnames = _as_tuple(jit_kwargs.get("static_argnames"))
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        ledger = _LEDGER
+        if ledger is None or not jax.core.trace_state_clean():
+            return jitted(*args, **kwargs)
+        return ledger.observed_call(
+            jitted, label, args, kwargs, static_argnums, static_argnames
+        )
+
+    wrapper.label = label
+    wrapper.jitted = jitted
+    # preserve the jit AOT surface: callers inspect programs via
+    # .lower(...).compile().as_text() (HLO pins in tests) and the ledger
+    # must not take that away
+    wrapper.lower = jitted.lower
+    for name in ("trace", "eval_shape", "clear_cache"):
+        attr = getattr(jitted, name, None)
+        if attr is not None:
+            setattr(wrapper, name, attr)
+    return wrapper

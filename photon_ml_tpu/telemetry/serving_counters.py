@@ -45,6 +45,10 @@ COMPILED_SIGNATURES = "serve/compiled_signatures"
 #: over-sized requests split across micro-batches instead of compiling a
 #: fresh signature (the bucket-miss rule)
 BUCKET_SPLITS = "serve/bucket_splits"
+#: bytes of placed model params resident in the layout-keyed cache
+#: (parallel/scoring.py params_for_layouts) — the resident half of the
+#: program ledger's HBM-overcommit forecast (telemetry/program_ledger.py)
+RESIDENT_PARAMS_BYTES = "serve/resident_params_bytes"
 
 
 def reset_serving_metrics(registry=None) -> None:
@@ -91,6 +95,10 @@ def record_scored(rows: int, padded_rows: int) -> None:
 
 def set_compiled_signatures(n: int) -> None:
     default_registry().gauge(COMPILED_SIGNATURES).set(int(n))
+
+
+def set_resident_params_bytes(n: int) -> None:
+    default_registry().gauge(RESIDENT_PARAMS_BYTES).set(int(n))
 
 
 def record_bucket_split(n: int = 1) -> None:

@@ -26,9 +26,11 @@ Three layers:
   concurrent CPU job — both measured, CLAUDE.md).
 - :func:`journal_findings` cross-checks a run journal's registry snapshot
   (overlap_fraction ~ 0 with prefetch on, high serve pad_fraction,
-  quarantined blocks, preemption restarts, stragglers) and
-  :func:`history_findings` reads cross-round trends (improvements,
-  plateaus) in the direction each rule declares.
+  quarantined blocks, preemption restarts, stragglers, and the program
+  ledger's compile pathologies — recompile storms with their attributed
+  cause, signature churn, compile-dominated runs, HBM overcommit
+  forecasts; ISSUE 13) and :func:`history_findings` reads cross-round
+  trends (improvements, plateaus) in the direction each rule declares.
 
 Statuses: only ``regression`` (a row losing its win criterion) fails a
 doctor run by default — pathologies and warnings are findings the operator
@@ -501,6 +503,28 @@ def history_findings(history: BenchHistory) -> list:
 #: serve/pad_fraction above this wastes most of every micro-batch on pads
 PAD_FRACTION_HIGH = 0.5
 
+#: program-ledger pathology thresholds (ISSUE 13; telemetry/program_ledger):
+#: a storm is REDUNDANT compiles — compiles beyond the label's distinct
+#: signature count, i.e. the same program compiled again (a program
+#: instance rebuilt per step, or executable-cache eviction). Healthy
+#: bounded ladders can never trip this no matter how many coordinates
+#: share a label (serving's 3 shape buckets, the 5 RE entity caps, one
+#: ladder per coordinate): every warm-up compile mints a NEW signature,
+#: so compiles == signatures and the redundancy is zero.
+RECOMPILE_STORM_REDUNDANT_MIN = 3
+#: distinct signatures under one label at/past this is churn — each one is
+#: a resident executable and a paid compile. A WARNING, not a pathology:
+#: a label shared across coordinates/buckets legitimately carries one
+#: signature per (coordinate, bucket) pair — compare the count against
+#: your configured ladder before acting
+SIGNATURE_CHURN_MIN = 8
+#: fraction of run wall-clock spent in backend compiles past which the run
+#: is compile-dominated (the tunnel's remote compiles make this fatal to
+#: iteration speed); only judged on runs longer than the floor, so tiny
+#: fixture runs don't all report it
+COMPILE_DOMINATED_FRACTION = 0.5
+COMPILE_DOMINATED_MIN_ELAPSED_S = 30.0
+
 
 def _last_row(records: list, kind: str) -> dict | None:
     for row in reversed(records):
@@ -584,6 +608,7 @@ def journal_findings(records: list) -> list:
             f"{giveups} giveup(s): the restart budget ran out — the run "
             "ended on an error recovery could not absorb",
         ))
+    findings.extend(_ledger_findings(records, counters, gauges, snapshot))
     straggler = _last_row(records, "straggler_report")
     if straggler is not None:
         # the PR 9 shape: {"num_ranks": N, "tags": [{tag, wait_s, count,
@@ -603,6 +628,93 @@ def journal_findings(records: list) -> list:
             f"straggler table over {len(tags)} exchange tag(s): "
             + ("; ".join(named) if named else "no stragglers named"),
         ))
+    return findings
+
+
+def _ledger_findings(records: list, counters: dict, gauges: dict,
+                     snapshot: dict) -> list:
+    """Program-ledger pathologies (ISSUE 13) over the journal's metrics
+    snapshot + program_* rows: recompile storms (with the last attributed
+    cause), signature churn, compile-seconds-dominated runs, and HBM
+    overcommit forecasts."""
+    findings: list[Verdict] = []
+    last_attribution: dict[str, str] = {}
+    for row in records:
+        if row.get("kind") == "program_recompile" and row.get("label"):
+            last_attribution[row["label"]] = str(row.get("summary"))
+    for key, value in sorted(counters.items()):
+        # NB "/recompiles" also endswith "/compiles" — exclude it first
+        if (
+            not key.startswith("xla/")
+            or not key.endswith("/compiles")
+            or key.endswith("/recompiles")
+        ):
+            continue
+        label = key[len("xla/"):-len("/compiles")]
+        sigs = gauges.get(f"xla/{label}/signatures")
+        if sigs is None:
+            continue
+        redundant = value - int(sigs)
+        if redundant >= RECOMPILE_STORM_REDUNDANT_MIN:
+            cause = last_attribution.get(label)
+            findings.append(Verdict(
+                key, "recompile-storm", PATHOLOGY,
+                f"{value} compiles for only {int(sigs)} distinct "
+                f"signature(s) under '{label}' — the same program "
+                f"recompiled {redundant} time(s): a program instance is "
+                "being rebuilt per step, or the executable cache is "
+                "thrashing"
+                + (f"; last attribution: {cause}" if cause else ""),
+            ))
+    for key, value in sorted(gauges.items()):
+        if not (key.startswith("xla/") and key.endswith("/signatures")):
+            continue
+        label = key[len("xla/"):-len("/signatures")]
+        if value is not None and value >= SIGNATURE_CHURN_MIN:
+            findings.append(Verdict(
+                key, "signature-churn", WARNING,
+                f"{int(value)} distinct signatures under '{label}' — each "
+                "is a paid compile and a resident executable; bound the "
+                "input shapes (power-of-two buckets)",
+            ))
+    compile_s = (
+        (snapshot.get("histograms") or {})
+        .get("jax/backend_compile_seconds") or {}
+    ).get("total")
+    elapsed_ms = records[-1].get("elapsed_ms") if records else None
+    if (
+        compile_s is not None and elapsed_ms
+        and elapsed_ms / 1e3 >= COMPILE_DOMINATED_MIN_ELAPSED_S
+        and compile_s >= COMPILE_DOMINATED_FRACTION * elapsed_ms / 1e3
+    ):
+        findings.append(Verdict(
+            "jax/backend_compile_seconds", "compile-dominated", WARNING,
+            f"{compile_s:.1f}s of backend compiles in a "
+            f"{elapsed_ms / 1e3:.1f}s run "
+            f"(>= {COMPILE_DOMINATED_FRACTION:.0%}) — the run is paying "
+            "compiles, not compute; check the recompile attributions "
+            "above / warm the signatures up front",
+        ))
+    overcommitted: set[str] = set()
+    for row in records:
+        if row.get("kind") != "program_compile":
+            continue
+        forecast = row.get("hbm_forecast_bytes")
+        limit = row.get("device_bytes_limit")
+        label = row.get("label")
+        if (
+            forecast is not None and limit is not None
+            and forecast > limit and label not in overcommitted
+        ):
+            overcommitted.add(label)
+            findings.append(Verdict(
+                f"xla/{label}/hbm_forecast_bytes", "hbm-overcommit-forecast",
+                WARNING,
+                f"'{label}' forecasts {forecast / 1e9:.2f} GB resident+temp "
+                f"against a {limit / 1e9:.2f} GB device limit — the next "
+                "dispatch risks an OOM; shrink the batch/bucket or shard "
+                "the params",
+            ))
     return findings
 
 

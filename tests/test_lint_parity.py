@@ -360,7 +360,12 @@ def test_lint_catches_streaming_jit_closures(tmp_path):
         "stream_reader.py:11" in p and "nested" in p for p in problems
     ), problems
     assert not any("good_step" in p for p in problems)
-    assert not any("other.py" in p for p in problems)
+    # other.py escapes CHECK 9 (not a streaming module) but its raw
+    # jax.jit in algorithm/ is exactly what check 13 exists to catch
+    assert not any("other.py" in p and "nested" in p for p in problems)
+    assert any(
+        "other.py" in p and "check 13" in p for p in problems
+    ), problems
 
 
 def test_lint_covers_streaming_game_module(tmp_path):
@@ -413,13 +418,14 @@ def test_lint_catches_serving_jit_closures(tmp_path):
     serving.mkdir(parents=True)
     (serving / "resident.py").write_text(
         '"""Cites GameTransformer.scala:156."""\n'
-        "import jax\n"
+        "from photon_ml_tpu.telemetry.program_ledger import ledger_jit\n"
         "class ResidentScorer:\n"
         "    def __init__(self, impl):\n"
-        "        self._program = jax.jit(impl)  # reviewed: args-only\n"
+        "        self._program = ledger_jit(impl, label='serve/score')\n"
         "class Rogue:\n"
         "    def __init__(self, impl, model):\n"
-        "        self._program = jax.jit(lambda d: impl(d, model))\n"
+        "        self._program = ledger_jit(lambda d: impl(d, model),\n"
+        "                                   label='serve/rogue')\n"
     )
     (serving / "batching.py").write_text(
         '"""Cites GameScoringDriver.scala:133."""\n'
@@ -433,6 +439,68 @@ def test_lint_catches_serving_jit_closures(tmp_path):
     ), problems
     assert any("batching.py:4" in p for p in problems), problems
     assert not any("resident.py:5" in p for p in problems), problems
+
+
+def test_lint_catches_raw_jit_in_hot_packages(tmp_path):
+    """Check 13: a raw jax.jit (attribute or `from jax import jit` name)
+    in algorithm/, serving/ or parallel/ is reported — hot programs must
+    carry a ledger label (ledger_jit) so the program ledger can attribute
+    their compiles — while ledger_jit sites pass, packages outside the
+    three prefixes are not scanned, and a class-qualified RAW_JIT_ALLOWED
+    entry exempts exactly its own scope."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    alg = tmp_path / "photon_ml_tpu" / "algorithm"
+    alg.mkdir(parents=True)
+    (alg / "hot.py").write_text(
+        '"""Cites CoordinateDescent.scala:1."""\n'
+        "import jax\n"
+        "from functools import partial\n"
+        "from jax import jit as fast\n"
+        "from photon_ml_tpu.telemetry.program_ledger import ledger_jit\n"
+        "@partial(ledger_jit, label='coord/good', static_argnums=(0,))\n"
+        "def good(objective, w):\n"
+        "    return w\n"
+        "@partial(jax.jit, static_argnums=(0,))\n"
+        "def bad_attr(objective, w):\n"
+        "    return w\n"
+        "def bad_alias(w):\n"
+        "    return fast(lambda v: v)(w)\n"
+        "class Reviewed:\n"
+        "    def __init__(self):\n"
+        "        self._p = jax.jit(lambda v: v)\n"
+    )
+    ops = tmp_path / "photon_ml_tpu" / "ops"
+    ops.mkdir(parents=True)
+    (ops / "kernel.py").write_text(
+        '"""Cites ValueAndGradientAggregator.scala:1."""\n'
+        "import jax\n"
+        "@jax.jit\n"
+        "def fine(w):\n"
+        "    return w  # ops/ is outside the check-13 packages\n"
+    )
+    problems = lint_parity.check_raw_jit_sites(tmp_path)
+    assert any("hot.py:9" in p and "check 13" in p for p in problems), problems
+    assert any("hot.py:13" in p for p in problems), problems
+    assert any("hot.py:16" in p for p in problems), problems
+    assert not any("good" in p for p in problems)
+    assert not any("kernel.py" in p for p in problems)
+
+    lint_parity.RAW_JIT_ALLOWED.add(
+        ("photon_ml_tpu/algorithm/hot.py", "Reviewed.__init__")
+    )
+    try:
+        allowed = lint_parity.check_raw_jit_sites(tmp_path)
+        assert not any("hot.py:16" in p for p in allowed), allowed
+        assert any("hot.py:9" in p for p in allowed)
+    finally:
+        lint_parity.RAW_JIT_ALLOWED.discard(
+            ("photon_ml_tpu/algorithm/hot.py", "Reviewed.__init__")
+        )
 
 
 def test_lint_catches_ungated_checkpoint_saves(tmp_path):

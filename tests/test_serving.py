@@ -377,6 +377,17 @@ class TestServeDriver:
         text = json.dumps(rows)
         assert "serve/latency_ms" in text
         assert "serve/requests" in text
+        # the program ledger rides --telemetry-dir (ISSUE 13): the warm
+        # compiles journal phase-stamped program rows under serve/score,
+        # and the summary carries the per-label snapshot — with zero
+        # replay compiles, every compile row is phase "warm"
+        compile_rows = [r for r in rows if r.get("kind") == "program_compile"]
+        serve_rows = [r for r in compile_rows
+                      if r.get("label") == "serve/score"]
+        assert serve_rows, kinds
+        assert all(r.get("phase") == "warm" for r in serve_rows)
+        assert s["program_compiles"]["serve/score"]["compiles"] >= 1
+        assert s["program_compiles"]["serve/score"]["recompiles"] >= 1
 
     def test_matches_scoring_driver_bitwise(self, trained, tmp_path):
         """The resident path and the batch scorer agree on the replay
