@@ -136,16 +136,13 @@ def write_sidecar(report: dict, directory: str, *, config: dict | None = None):
 def _unit_primary(lane_iters: int, grid_sec: float) -> str:
     return (
         f"ex*it/s {GRID}lam n=2^18 d={D} "
-        f"{lane_iters} ln-it {grid_sec:.0f}s/grid"
+        f"{lane_iters}it {grid_sec:.0f}s"
     )
 
 
-def _unit_stream(n: int, d: int) -> str:
+def _unit_stream() -> str:
     # "sr" = same-run throughout the unit grammar
-    return (
-        f"sr cal mv/step n=2^{n.bit_length() - 1} "
-        f"d={d} roof{HBM_ROOFLINE_GBPS:.0f}"
-    )
+    return f"sr cal roof{HBM_ROOFLINE_GBPS:.0f}"
 
 
 def _unit_hot_loop(note: str, frac: float) -> str:
@@ -155,48 +152,43 @@ def _unit_hot_loop(note: str, frac: float) -> str:
 
 def _unit_sweep(newton: bool) -> str:
     if newton:
-        return (
-            "ms/sw REs Newt FE same"
-        )
-    return (
-        "ms/sw FE d256 2REs 2k/1.5k d16 10it"
-    )
+        return "ms/sw Newt REs"
+    return "ms/sw FE 2REs 10it"
 
 
 def _unit_sweep_scheduled() -> str:
     # compare against fused_game_sweep_ms from the SAME run only (the
     # calibration discipline); includes the scheduler's host reads
-    return "ms/sw RE sched p2 ftol1e-6"
+    return "ms/sw sched ftol1e-6"
 
 
 def _unit_sweep_composed(ell_ms: float, cov: float) -> str:
     # compare against the embedded same-run ELL+unscheduled sweep only
     # (the calibration discipline); one Zipfian dataset, two configs
     return (
-        f"ms/sw d=1e6 zipf hot256 cov{cov:.2f} "
-        f"sch-p2 ELLunsr {ell_ms:.0f}"
+        f"ms/sw zipf hot256 cov{cov:.2f} "
+        f"ELLunsr {ell_ms:.0f}"
     )
 
 
-def _unit_sparse_1e7(nnz: int, ms_per_iter: float) -> str:
+def _unit_sparse_1e7(ms_per_iter: float) -> str:
     return (
-        f"nnz*it/s d=1e7 ELL {nnz / 1e6:.0f}M "
-        f"{ms_per_iter:.1f}ms/it"
+        f"nnz*it/s d=1e7 ELL {ms_per_iter:.1f}ms/it"
     )
 
 
-def _unit_sparse_hybrid(nnz: int, ell_ms: float, cov: float, k_hot: int) -> str:
+def _unit_sparse_hybrid(ell_ms: float, cov: float, k_hot: int) -> str:
     # compare against the embedded same-run ELL ms/it only (the calibration
     # discipline): same Zipfian data, same process, fractional comparison
     return (
-        f"ms/it d=1e7 zipf {nnz / 1e6:.0f}M hot{k_hot} "
+        f"ms/it zipf hot{k_hot} "
         f"cov{cov:.2f} ELLsr {ell_ms:.0f}"
     )
 
 
-def _unit_sparse_1e8(nnz: int, entry_iters_m: float) -> str:
+def _unit_sparse_1e8(entry_iters_m: float) -> str:
     return (
-        f"ms/TRON-it 2CG d=1e8 hyb hot512 {nnz / 1e6:.0f}M "
+        f"ms/TRON-it d=1e8 hyb hot512 "
         f"{entry_iters_m:.1f}M eit/s"
     )
 
@@ -212,6 +204,13 @@ def _unit_stream_game(visits_d: int, visits_u: int, sweeps_d: int,
     )
 
 
+def _unit_refresh(lanes_solved: int, lanes_total: int, full_ms: float) -> str:
+    # compare against the embedded same-run full-retrain ms only (the
+    # calibration discipline); ln = RE lane-solves refresh/full — the
+    # selection evidence (refresh must be STRICTLY fewer)
+    return f"ms/rf ln{lanes_solved}/{lanes_total} fullsr {full_ms:.0f}"
+
+
 def _unit_serve(p95_ms: float, unbatched_rate: float) -> str:
     # compare against the embedded same-run one-request-per-dispatch rate
     # only (the calibration discipline); p95 = request latency inside the
@@ -224,16 +223,16 @@ def _unit_stream_chunked(off_ms: float, overlap: float, chunks: int) -> str:
     # (the calibration discipline); zdec = per-chunk zlib-inflate decode
     # stand-in; ovl = epoch overlap fraction (decode hidden behind compute)
     return (
-        f"ms/ep ON {chunks}ch zdec "
+        f"ms/ep ON {chunks}ch "
         f"OFF{off_ms:.0f} ovl{overlap:.2f}"
     )
 
 
 #: hot-loop row labels -> telegraphic GB/s notes (prose: BASELINE.md r4)
 HOT_LOOP_NOTES = {
-    "autodiff_xla": "2X pass",
-    "pallas_kernel": "1 pass dflt",
-    "pallas_bf16": "bf16 f32acc",
+    "autodiff_xla": "2Xpass",
+    "pallas_kernel": "1pass",
+    "pallas_bf16": "bf16acc",
     "pallas_shardmap_mesh1": "shmap",
 }
 
@@ -251,14 +250,15 @@ def sample_report() -> dict:
     sweep ms rows 1e4 (10+ s where actuals are sub-second), epoch-scale
     streaming ms rows 1e4 (10 s/epoch vs ~3 s worst observed), serving
     rows 1e6 sc/s / 1e4 ms p95 (three decades above the tunnel's
-    dispatch-bound reality)."""
+    dispatch-bound reality), refresh lane pairs 4 digits (the bench
+    fixture has 256 entities)."""
     rate, rate_sp = 999999999.9, [999999999.9, 999999999.9]
     gbps, gbps_sp = 9999.9, [9999.9, 9999.9]
     ms, ms_sp = 9999.9, [9999.9, 9999.9]
     sc, sc_sp = 999999.9, [999999.9, 999999.9]
     extra = [
         _row("fe_hot_loop_stream_gbps", gbps, gbps_sp,
-             _unit_stream(1 << 17, D))
+             _unit_stream())
     ]
     extra += [
         _row(f"fe_hot_loop_hbm_gbps_{label}", gbps, gbps_sp,
@@ -271,19 +271,21 @@ def sample_report() -> dict:
         _row("fused_game_sweep_scheduled_ms", ms, ms_sp,
              _unit_sweep_scheduled()),
         _row("sparse_giant_fe_entry_iters_per_sec", rate, rate_sp,
-             _unit_sparse_1e7(25165824, 9999.9)),
+             _unit_sparse_1e7(9999.9)),
         _row("sparse_giant_fe_hybrid", ms, ms_sp,
-             _unit_sparse_hybrid(16777216, 9999.4, 9.99, 256)),
+             _unit_sparse_hybrid(9999.4, 9.99, 256)),
         _row("sparse_giant_fe_composed", ms, ms_sp,
              _unit_sweep_composed(9999.4, 9.99)),
         _row("sparse_1e8_fe_tron_ms_per_iter", ms, ms_sp,
-             _unit_sparse_1e8(4194304, 999.9)),
+             _unit_sparse_1e8(999.9)),
         _row("stream_fe_chunked", ms, ms_sp,
              _unit_stream_chunked(9999, 9.99, 99)),
         _row("stream_game_duhl", ms, ms_sp,
              _unit_stream_game(9999, 9999, 99, 99, 9999.4)),
         _row("serve_microbatch", sc, sc_sp,
              _unit_serve(9999.4, 999999.9)),
+        _row("refresh_incremental", ms, ms_sp,
+             _unit_refresh(9999, 9999, 9999.4)),
     ]
     report = _row(
         "glm_lambda_grid_example_iters_per_sec", rate, rate_sp,
@@ -440,7 +442,7 @@ def bench_hot_loop_bandwidth(x, y) -> list[dict]:
         "fe_hot_loop_stream_gbps",
         round(stream_gbps, 1),
         [round(s, 1) for s in cal["spread_gbps"]],
-        _unit_stream(n, d),
+        _unit_stream(),
     )]
     # prose for each row lives in HOT_LOOP_NOTES + BASELINE.md (the r4
     # kernel study); bf16 rides the reader's dtype=bf16 product cast so
@@ -853,7 +855,7 @@ def bench_sparse_fe() -> dict:
         "sparse_giant_fe_entry_iters_per_sec",
         round(nnz / marginal, 1),
         [round(nnz / s, 1) for s in sp[::-1]],
-        _unit_sparse_1e7(nnz, marginal * 1e3),
+        _unit_sparse_1e7(marginal * 1e3),
     )
 
 
@@ -898,7 +900,7 @@ def bench_sparse_fe_hybrid() -> dict:
         "sparse_giant_fe_hybrid",
         round(hyb_marginal * 1e3, 1),
         [round(s * 1e3, 1) for s in hyb_sp],
-        _unit_sparse_hybrid(nnz, ell_marginal * 1e3, cov, k_hot),
+        _unit_sparse_hybrid(ell_marginal * 1e3, cov, k_hot),
     )
 
 
@@ -972,7 +974,7 @@ def bench_sparse_fe_1e8() -> dict:
         "sparse_1e8_fe_tron_ms_per_iter",
         round(marginal * 1e3, 1),
         [round(s * 1e3, 1) for s in sp],
-        _unit_sparse_1e8(nnz, nnz / marginal / 1e6),
+        _unit_sparse_1e8(nnz / marginal / 1e6),
     )
 
 
@@ -1237,6 +1239,111 @@ def bench_serve_microbatch() -> dict:
     )
 
 
+def bench_refresh_incremental() -> dict:
+    """Incremental GAME retrain vs full retrain, back to back in THIS
+    process (ISSUE 14). One synthetic GAME dataset (dense FE + one
+    IDENTITY RE) trains a resident model; a few entities' labels then
+    change, and the SAME updated dataset retrains both ways: the full
+    warm-started fit (the honest baseline — it too starts from the
+    resident model) and the incremental refresh (gradient-screened
+    selection, frozen residuals, compacted selected-lane solve). Row value
+    is the refresh ms (median-of-GATE_REPS); the unit embeds the
+    acceptance evidence — RE lane-solves refresh/full and the same-run
+    full-retrain ms. Lane counts are deterministic; ms compares within the
+    run only (chip lottery)."""
+    from photon_ml_tpu.algorithm.coordinates import (
+        CoordinateOptimizationConfig,
+    )
+    from photon_ml_tpu.algorithm.refresh import RefreshPolicy
+    from photon_ml_tpu.data.game_data import build_game_dataset
+    from photon_ml_tpu.estimators import (
+        FixedEffectCoordinateConfig,
+        GameEstimator,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(23)
+    n, d_fe, d_re, n_ent, n_changed = 4096, 64, 8, 256, 8
+    users = np.array([f"u{i:04d}" for i in rng.integers(0, n_ent, size=n)])
+    ent = np.array([int(u[1:]) for u in users])
+    x_fe = rng.normal(size=(n, d_fe)).astype(np.float32)
+    x_re = rng.normal(size=(n, d_re)).astype(np.float32)
+    w_fe = rng.normal(size=d_fe).astype(np.float32)
+    w_re = rng.normal(size=(n_ent, d_re)).astype(np.float32)
+
+    noise = 0.05 * rng.normal(size=n)
+
+    def labels(w_tab):
+        # FIXED noise: unchanged entities' rows are IDENTICAL across the
+        # resident and refresh datasets, so only real change moves the
+        # gradient screen
+        return (
+            x_fe @ w_fe + (x_re * w_tab[ent]).sum(1) + noise
+        ).astype(np.float32)
+
+    def dataset(y):
+        return build_game_dataset(
+            labels=y,
+            feature_shards={"g": x_fe, "u": x_re},
+            entity_keys={"userId": users},
+        )
+
+    opt = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=24), l2_weight=1.0
+    )
+    estimator = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={
+            "fe": FixedEffectCoordinateConfig(
+                feature_shard_id="g", optimization=opt
+            ),
+            "re": RandomEffectCoordinateConfig(
+                random_effect_type="userId", feature_shard_id="u",
+                optimization=opt,
+            ),
+        },
+        num_iterations=1,
+    )
+    ds0 = dataset(labels(w_re))
+    resident = estimator.fit(ds0).model
+
+    w_re2 = w_re.copy()
+    changed_rows = rng.choice(n_ent, size=n_changed, replace=False)
+    w_re2[changed_rows] *= -2.0
+    ds1 = dataset(labels(w_re2))
+
+    policy = RefreshPolicy(gradient_tolerance=1e-1)
+    # warm every jit signature (solvers + grad screen + compacted solve)
+    # outside the timings — both sides below dispatch warm programs
+    estimator.fit(ds1, initial_model=resident)
+    estimator.refresh(ds1, resident, policy)
+
+    # same-run full-retrain baseline: warm-started from the resident
+    # model, like the refresh — the comparison isolates the selection win
+    t0 = time.perf_counter()
+    estimator.fit(ds1, initial_model=resident)
+    full_ms = (time.perf_counter() - t0) * 1e3
+
+    results = []
+
+    def once() -> float:
+        t0 = time.perf_counter()
+        results.append(estimator.refresh(ds1, resident, policy))
+        return (time.perf_counter() - t0) * 1e3
+
+    refresh_ms, spread = median_spread(once)
+    last = results[-1]
+    # lanes_total = every valid RE lane — exactly what the full sweep solves
+    return _row(
+        "refresh_incremental",
+        round(refresh_ms, 1),
+        [round(s, 1) for s in spread],
+        _unit_refresh(last.lanes_solved, last.lanes_total, full_ms),
+    )
+
+
 def bench_cpu_scipy(x, y) -> float:
     """scipy L-BFGS-B example-iters/sec over the same λ grid, sequential.
     Iteration-normalized so vs_baseline compares per-unit-work throughput —
@@ -1278,6 +1385,7 @@ def main():
     extra.append(bench_stream_fe_chunked())
     extra.append(bench_stream_game_duhl())
     extra.append(bench_serve_microbatch())
+    extra.append(bench_refresh_incremental())
     cpu_rate = bench_cpu_scipy(x[:CPU_SUBSAMPLE], y[:CPU_SUBSAMPLE])
 
     rate = N * lane_iters / tpu_time

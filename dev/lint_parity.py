@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static parity-convention lints for photon_ml_tpu (CLAUDE.md conventions).
 
-Thirteen checks, all pure-AST (no jax import; runs in milliseconds):
+Fourteen checks, all pure-AST (no jax import; runs in milliseconds):
 
 1. **Docstring citations** — every ``photon_ml_tpu/**/*.py`` module (except
    ``__init__.py`` re-export shims) must carry a module docstring that
@@ -122,6 +122,17 @@ Thirteen checks, all pure-AST (no jax import; runs in milliseconds):
    ``jax.jit`` there compiles programs the ledger cannot see — its
    recompile attribution, cost accounting, and the serving
    ``replay_compiles == 0`` pin (ISSUE 13) all go blind to that site.
+
+14. **Resident-param mutation outside the guarded swap API** — the
+   serving package holds a model resident across requests; swapping it
+   in-place is legal ONLY through ``ResidentScorer.swap_model``, whose
+   layout fingerprint guard rejects a layout-changing model typed (naming
+   the differing leaves) BEFORE any state mutates and re-feeds the
+   resident-bytes/HBM-forecast gauges after. An assignment to a
+   resident-param attribute (``.model``, the params caches) anywhere else
+   in ``photon_ml_tpu/serving/`` would bypass that guard — a silent
+   layout change recompiles per request (the bounded-signature contract
+   dies) or serves garbage. Class-qualified allowlist, like checks 9-13.
 
 Exit status 0 = clean; 1 = violations (printed one per line as
 ``path:lineno: message``). Run from the repo root:
@@ -867,6 +878,95 @@ def check_raw_jit_sites(root: pathlib.Path) -> list[str]:
     return problems
 
 
+#: resident-param attributes whose assignment in serving/ must route
+#: through the guarded swap API (check 14): the resident model reference
+#: and the layout-keyed params caches it invalidates
+RESIDENT_PARAM_ATTRS = {
+    "model",
+    "_params_cache",
+    "_bf16_params_cache",
+    "_params_cache_bytes",
+    "_kinds",
+    "_model_version",
+}
+
+#: (file, dotted class-qualified scope) pairs sanctioned to mutate
+#: resident params: construction, and the fingerprint-guarded swap
+RESIDENT_MUTATION_ALLOWED = {
+    (f"{PACKAGE}/serving/resident.py", "ResidentScorer.__init__"),
+    (f"{PACKAGE}/serving/resident.py", "ResidentScorer.swap_model"),
+}
+
+
+def check_resident_param_mutations(root: pathlib.Path) -> list[str]:
+    problems = []
+    serving_dir = root / PACKAGE / "serving"
+    if not serving_dir.is_dir():
+        return problems
+    for path in sorted(serving_dir.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        tree = ast.parse(path.read_text())
+
+        stack: list[str] = []
+        hits: list[tuple[int, str]] = []
+
+        def flatten(t):
+            # tuple/list unpacking and starred targets must not slip the
+            # ban: `self.model, x = ...` mutates resident params too
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    yield from flatten(e)
+            elif isinstance(t, ast.Starred):
+                yield from flatten(t.value)
+            else:
+                yield t
+
+        def targets(node):
+            raw = []
+            if isinstance(node, ast.Assign):
+                raw = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                raw = [node.target]
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                raw = [node.target]
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                raw = [
+                    item.optional_vars for item in node.items
+                    if item.optional_vars is not None
+                ]
+            return [t for r in raw for t in flatten(r)]
+
+        def visit(node):
+            is_scope = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            )
+            if is_scope:
+                stack.append(node.name)
+            for t in targets(node):
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr in RESIDENT_PARAM_ATTRS
+                    and (rel, ".".join(stack)) not in RESIDENT_MUTATION_ALLOWED
+                ):
+                    hits.append((node.lineno, t.attr))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_scope:
+                stack.pop()
+
+        visit(tree)
+        for lineno, attr in hits:
+            problems.append(
+                f"{rel}:{lineno}: assignment to resident-param attribute "
+                f"'.{attr}' outside the guarded swap API — resident-model "
+                "mutation in serving/ must go through "
+                "ResidentScorer.swap_model (layout-fingerprint-guarded, "
+                "gauge-refeeding) or a reviewed "
+                "RESIDENT_MUTATION_ALLOWED scope (lint check 14)"
+            )
+    return problems
+
+
 #: where check 12 reads its two sides from (relative to the lint root)
 BENCH_MODULE = "bench.py"
 VERDICTS_MODULE = f"{PACKAGE}/telemetry/verdicts.py"
@@ -979,6 +1079,7 @@ def run_lints(root: pathlib.Path | str | None = None) -> list[str]:
         + check_time_time_durations(root)
         + check_bench_verdict_rules(root)
         + check_raw_jit_sites(root)
+        + check_resident_param_mutations(root)
     )
 
 

@@ -267,12 +267,38 @@ class RandomEffectCoordinate(Coordinate):
     #: to know the final sweep (it runs everyone). Standalone update_model
     #: calls leave it None, which means "treat as final": never skip.
     _sweep_context: tuple = dataclasses.field(default=None, init=False, repr=False)
+    #: bool [num_entities] refresh selection (algorithm/refresh.py): when
+    #: set, update_model re-solves ONLY the selected entities' lanes
+    #: (compacted; warm-started from the incoming table) and the rest carry
+    #: over BITWISE. None (default) is the unchanged full solve — the
+    #: refresh path is strictly opt-in.
+    _refresh_selection: object = dataclasses.field(default=None, init=False, repr=False)
+    #: SchedulerStats of the last refresh-selected solve (telemetry)
+    last_refresh_stats: object = dataclasses.field(default=None, init=False, repr=False)
 
     def set_sweep(self, iteration: int, num_iterations: int) -> None:
         """Cross-sweep context hook, called by run_coordinate_descent before
         each update (CoordinateDescent.scala:198-255's per-iteration loop is
         where the reference knows the sweep index too)."""
         self._sweep_context = (iteration, num_iterations)
+
+    def set_refresh_selection(self, selected: "np.ndarray | None") -> None:
+        """Install (or clear, with None) the refresh policy's entity
+        selection for the next ``update_model`` — the partial-retraining
+        counterpart of the reference's locked coordinates
+        (CoordinateDescent.scala:44-49), at ENTITY granularity instead of
+        coordinate granularity (algorithm/refresh.py)."""
+        if selected is None:
+            self._refresh_selection = None
+            return
+        selected = np.ascontiguousarray(selected, dtype=bool)
+        if selected.shape != (self.re_dataset.num_entities,):
+            raise ValueError(
+                f"refresh selection covers {selected.shape} but coordinate "
+                f"'{self.coordinate_id}' has "
+                f"{self.re_dataset.num_entities} entities"
+            )
+        self._refresh_selection = selected
 
     def initial_model(self) -> RandomEffectModel:
         from photon_ml_tpu.data.batch import solve_dtype_of
@@ -295,7 +321,11 @@ class RandomEffectCoordinate(Coordinate):
             feature_dim=re.dim if re.is_compact else None,
         )
 
-    def update_model(self, model: RandomEffectModel, extra_offsets: Array | None = None):
+    def _prepare_solve(self, model: RandomEffectModel, extra_offsets: Array | None):
+        """Shared solve prologue for ``update_model`` and
+        ``refresh_gradient_norms``: validates the projector/normalization
+        composition and converts the model into solve space. Returns
+        (objective, projector, full_offsets, norm, compact_cols, table)."""
         projector = self.re_dataset.projector_type
         if (
             projector == ProjectorType.RANDOM
@@ -355,10 +385,6 @@ class RandomEffectCoordinate(Coordinate):
             else self.normalization
         )
         objective = _make_objective(self.task, self.config, solve_norm)
-        # AUTO resolves to NEWTON here: the per-entity bucket solve is
-        # exactly the small-d dense vmapped shape the batched-Newton
-        # solver was measured on (BASELINE.md r5)
-        opt = _solve_config(self.config, loss=objective.loss, small_dense=True)
         full_offsets = self.dataset.offsets
         if extra_offsets is not None:
             full_offsets = full_offsets + extra_offsets
@@ -377,9 +403,24 @@ class RandomEffectCoordinate(Coordinate):
             )
         else:
             table = norm.from_model_space(model.coefficients, self.intercept_index)
+        return objective, projector, full_offsets, norm, compact_cols, table
+
+    def update_model(self, model: RandomEffectModel, extra_offsets: Array | None = None):
+        objective, projector, full_offsets, norm, compact_cols, table = (
+            self._prepare_solve(model, extra_offsets)
+        )
+        # AUTO resolves to NEWTON here: the per-entity bucket solve is
+        # exactly the small-d dense vmapped shape the batched-Newton
+        # solver was measured on (BASELINE.md r5)
+        opt = _solve_config(self.config, loss=objective.loss, small_dense=True)
 
         traces: list[LaneTrace] = []
-        if opt.scheduler is not None:
+        refresh_sel = self._refresh_selection
+        if refresh_sel is not None:
+            table, traces = self._solve_refresh(
+                objective, opt, projector, full_offsets, table, refresh_sel
+            )
+        elif opt.scheduler is not None:
             table, traces = self._solve_scheduled(
                 objective, opt, projector, full_offsets, table
             )
@@ -511,6 +552,30 @@ class RandomEffectCoordinate(Coordinate):
             if compact_cols is not None
             else norm.to_model_space(table, self.intercept_index)
         )
+        if refresh_sel is not None:
+            # untouched entities carry over BITWISE — the compacted solve
+            # never scatters into their rows, and this restore also erases
+            # any normalization from/to-model-space round-off on them
+            sel = jnp.asarray(refresh_sel)[:, None]
+            table = jnp.where(
+                sel, table, jnp.asarray(model.coefficients, dtype=table.dtype)
+            )
+            # variances follow the same carry-over rule: unselected
+            # entities KEEP the resident variances; selected entities get
+            # the freshly computed ones, or NaN ("no variance computed" —
+            # the model writer drops NaN) when this refresh did not run
+            # the variance pass. A refresh must never silently drop the
+            # resident model's variances or overwrite carried entities'
+            # variances under the new residuals.
+            if variances is not None or model.variances is not None:
+                nans = jnp.full(table.shape, jnp.nan, table.dtype)
+                variances = jnp.where(
+                    sel,
+                    nans if variances is None
+                    else jnp.asarray(variances, dtype=table.dtype),
+                    nans if model.variances is None
+                    else jnp.asarray(model.variances, dtype=table.dtype),
+                )
         # info = the per-bucket lane traces: the coordinate-descent loop
         # hands them to telemetry (convergence-reason tallies over every
         # vmapped entity lane). LaneTraces keeps the device arrays unmerged —
@@ -524,20 +589,9 @@ class RandomEffectCoordinate(Coordinate):
     def score(self, model: RandomEffectModel) -> Array:
         return model.score_dataset(self.dataset)
 
-    def _solve_scheduled(self, objective, opt, projector, full_offsets, table):
-        """Probe/rescue (+ cross-sweep active-set) solve of every bucket via
-        algorithm/lane_scheduler.py; returns (table, host-numpy traces)."""
-        # lazy import: lane_scheduler builds on this module's bucket solvers
-        from photon_ml_tpu.algorithm.lane_scheduler import LaneScheduler
-
-        if self._scheduler is None or self._scheduler.config != opt.scheduler:
-            self._scheduler = LaneScheduler(opt.scheduler)
-        iteration, num_iterations = self._sweep_context or (0, 1)
-        matrix = (
-            jnp.asarray(self.re_dataset.projection.matrix, dtype=table.dtype)
-            if projector == ProjectorType.RANDOM else None
-        )
-        blocks = [
+    def _scheduler_blocks(self, projector) -> list:
+        """Bucket field dicts in the shape the lane scheduler consumes."""
+        return [
             {
                 "features": b.features,
                 "labels": b.labels,
@@ -549,12 +603,105 @@ class RandomEffectCoordinate(Coordinate):
             }
             for b in self.re_dataset.buckets
         ]
+
+    def _projection_matrix(self, projector, dtype):
+        return (
+            jnp.asarray(self.re_dataset.projection.matrix, dtype=dtype)
+            if projector == ProjectorType.RANDOM else None
+        )
+
+    def _solve_scheduled(self, objective, opt, projector, full_offsets, table):
+        """Probe/rescue (+ cross-sweep active-set) solve of every bucket via
+        algorithm/lane_scheduler.py; returns (table, host-numpy traces)."""
+        # lazy import: lane_scheduler builds on this module's bucket solvers
+        from photon_ml_tpu.algorithm.lane_scheduler import LaneScheduler
+
+        if self._scheduler is None or self._scheduler.config != opt.scheduler:
+            self._scheduler = LaneScheduler(opt.scheduler)
+        iteration, num_iterations = self._sweep_context or (0, 1)
         table, traces, _stats = self._scheduler.solve(
-            objective, opt, blocks, full_offsets, table,
-            projector=projector, matrix=matrix,
+            objective, opt, self._scheduler_blocks(projector), full_offsets,
+            table,
+            projector=projector,
+            matrix=self._projection_matrix(projector, table.dtype),
             final_sweep=iteration >= num_iterations - 1,
         )
         return table, traces
+
+    def _solve_refresh(self, objective, opt, projector, full_offsets, table,
+                       selected: np.ndarray):
+        """Refresh-policy solve (algorithm/refresh.py): the lane scheduler's
+        active-set freezing promoted to an EXTERNALLY chosen set — compact
+        and re-solve only the selected entities' lanes with the full
+        iteration budget, warm-started from the resident table rows;
+        unselected rows are never scattered into. A fresh scheduler per
+        call: a refresh selection does not outlive its update."""
+        from photon_ml_tpu.algorithm.lane_scheduler import LaneScheduler
+        from photon_ml_tpu.optim.optimizer import LaneSchedulerConfig
+
+        base = dataclasses.replace(opt, scheduler=None)
+        # probe budget == the whole budget: one compacted solve of the
+        # selected lanes, no rescue phase
+        # the probe IS the whole solve here (no rescue phase), so the
+        # "probe flags rarely fire without a live function stop" warning
+        # does not apply
+        scheduler = LaneScheduler(
+            LaneSchedulerConfig(probe_iterations=base.max_iterations),
+            warn_no_live_stop=False,
+        )
+        scheduler.freeze_rows(~selected)
+        table, traces, stats = scheduler.solve(
+            objective, base, self._scheduler_blocks(projector), full_offsets,
+            table,
+            projector=projector,
+            matrix=self._projection_matrix(projector, table.dtype),
+            final_sweep=False,
+        )
+        self.last_refresh_stats = stats
+        return table, traces
+
+    def refresh_gradient_norms(
+        self, model: RandomEffectModel, extra_offsets: Array | None = None
+    ) -> np.ndarray:
+        """[num_entities] solve-space gradient norms of ``model`` at its own
+        coefficients — the refresh policy's screening signal
+        (algorithm/refresh.py): an entity whose data changed since the
+        resident solve leaves a gradient well above rounding scale, while a
+        converged untouched entity sits at it. One vmapped gradient pass
+        per bucket (no solver state); entities in no bucket return NaN
+        (nothing to re-solve)."""
+        objective, projector, full_offsets, _norm, _cols, table = (
+            self._prepare_solve(model, extra_offsets)
+        )
+        num_rows = int(table.shape[0])
+        out = np.full(num_rows, np.nan)
+        matrix = self._projection_matrix(projector, table.dtype)
+        if projector == ProjectorType.INDEX_MAP:
+            table_ext = jnp.concatenate(
+                [table, jnp.zeros((num_rows, 1), table.dtype)], axis=1
+            )
+        for b in self.re_dataset.buckets:
+            if projector == ProjectorType.INDEX_MAP:
+                norms = _jitted_re_bucket_grad_norms_indexmap(
+                    objective, b.features, b.labels, b.weights,
+                    b.sample_rows, b.entity_rows, b.col_index,
+                    full_offsets, table_ext,
+                )
+            elif projector == ProjectorType.RANDOM:
+                norms = _jitted_re_bucket_grad_norms_random(
+                    objective, b.features, b.labels, b.weights,
+                    b.sample_rows, b.entity_rows, matrix,
+                    full_offsets, table,
+                )
+            else:
+                norms = _jitted_re_bucket_grad_norms(
+                    objective, b.features, b.labels, b.weights,
+                    b.sample_rows, b.entity_rows, full_offsets, table,
+                )
+            rows = np.asarray(b.entity_rows)
+            valid = (rows >= 0) & (rows < num_rows)
+            out[rows[valid]] = np.asarray(norms)[valid]
+        return out
 
 
 def _bucket_offsets(sample_rows: Array, full_offsets: Array) -> Array:
@@ -660,6 +807,72 @@ def _jitted_re_bucket_solve(
     return solve_entity_bucket_traced(
         objective, opt, features, labels, weights, sample_rows, entity_rows,
         full_offsets, table,
+    )
+
+
+def _bucket_grad_norms(objective, features, labels, weights, offsets, w0s):
+    """[e] gradient norms at each lane's warm start — the vmapped single
+    pass behind ``RandomEffectCoordinate.refresh_gradient_norms``."""
+
+    def one(f, l, o, wt, w):
+        batch = LabeledPointBatch(features=f, labels=l, offsets=o, weights=wt)
+        return jnp.linalg.norm(objective.gradient(w, batch))
+
+    return jax.vmap(one)(features, labels, offsets, weights, w0s)
+
+
+@partial(ledger_jit, label="refresh/grad_norms", static_argnums=(0,))
+def _jitted_re_bucket_grad_norms(
+    objective: GLMObjective,
+    features: Array,
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    full_offsets: Array,
+    table: Array,
+):
+    offsets = _bucket_offsets(sample_rows, full_offsets)
+    return _bucket_grad_norms(
+        objective, features, labels, weights, offsets, table[entity_rows]
+    )
+
+
+@partial(ledger_jit, label="refresh/grad_norms_indexmap", static_argnums=(0,))
+def _jitted_re_bucket_grad_norms_indexmap(
+    objective: GLMObjective,
+    features: Array,
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    col_index: Array,
+    full_offsets: Array,
+    table_ext: Array,
+):
+    offsets = _bucket_offsets(sample_rows, full_offsets)
+    w0s = table_ext[entity_rows[:, None], col_index]
+    return _bucket_grad_norms(
+        objective, features, labels, weights, offsets, w0s
+    )
+
+
+@partial(ledger_jit, label="refresh/grad_norms_random", static_argnums=(0,))
+def _jitted_re_bucket_grad_norms_random(
+    objective: GLMObjective,
+    features: Array,
+    labels: Array,
+    weights: Array,
+    sample_rows: Array,
+    entity_rows: Array,
+    matrix: Array,
+    full_offsets: Array,
+    table: Array,
+):
+    offsets = _bucket_offsets(sample_rows, full_offsets)
+    return _bucket_grad_norms(
+        objective, features, labels, weights, offsets,
+        table[entity_rows] @ matrix,
     )
 
 

@@ -207,10 +207,15 @@ class LaneScheduler:
     """
 
     def __init__(self, config: LaneSchedulerConfig, registry=None,
-                 mesh=None):
+                 mesh=None, warn_no_live_stop: bool = True):
+        #: set False when the probe IS the whole solve (the refresh policy:
+        #: probe budget == max_iterations, no rescue) — the "probe flags
+        #: rarely fire without a live function stop" warning only applies
+        #: when a rescue phase exists to waste
         self.config = config
         self.mesh = mesh
         self._registry = registry
+        self._warned_no_live_stop = not warn_no_live_stop
         self._host_blocks: list[dict[str, np.ndarray]] | None = None
         #: SPMD mode: (rank-local field slices, base row, owner map) per
         #: bucket — built lazily like the host cache
@@ -222,7 +227,6 @@ class LaneScheduler:
         self._carry: list[tuple[np.ndarray, np.ndarray]] | None = None
         self.total_stats = SchedulerStats()
         self.last_stats: SchedulerStats | None = None
-        self._warned_no_live_stop = False
         self._num_rows: int | None = None
 
     # -- SPMD (collective-safe) helpers --------------------------------------
@@ -264,6 +268,17 @@ class LaneScheduler:
 
             self._registry = default_registry()
         return self._registry
+
+    def freeze_rows(self, mask: np.ndarray) -> None:
+        """Pre-seed the active set: coefficient-table rows True in ``mask``
+        are FROZEN — a ``solve(final_sweep=False)`` skips their lanes
+        (compacting the rest) and never scatters into their rows, so they
+        carry over bitwise. This is the refresh-policy entry point
+        (algorithm/refresh.py): "retrain only what changed" is the
+        cross-sweep active set handed in from outside instead of grown
+        from per-sweep convergence — the freeze tolerances need not be
+        configured for a preset to take effect."""
+        self.frozen_rows = np.ascontiguousarray(mask, dtype=bool).copy()
 
     def _host_cache(self, blocks: Sequence[Mapping[str, Array]]):
         if self._host_blocks is None:
@@ -381,6 +396,16 @@ class LaneScheduler:
         frozen = self.frozen_rows
         if freezing and frozen is None:
             frozen = np.zeros(num_rows, dtype=bool)
+        # a preset active set (freeze_rows — the refresh policy) skips even
+        # when the per-sweep freeze tolerances are off; only the tolerance-
+        # driven active-set GROWTH below stays gated on cfg.freezes
+        skipping = freezing or frozen is not None
+        if frozen is not None and len(frozen) != num_rows:
+            raise ValueError(
+                f"frozen-row mask covers {len(frozen)} rows but the "
+                f"coefficient table has {num_rows} — freeze_rows() masks "
+                "must match the coordinate's table"
+            )
 
         # host lane bookkeeping (entity_rows only — cheap; the full host
         # bucket cache is built lazily, first time compaction is needed).
@@ -390,7 +415,7 @@ class LaneScheduler:
             for r in self._gather_np(tuple(b["entity_rows"] for b in blocks))
         ]
         valid_h = [(r >= 0) & (r < num_rows) for r in rows_h]
-        if freezing and not final_sweep and frozen.any():
+        if skipping and not final_sweep and frozen.any():
             skip_h = [
                 v & frozen[np.clip(r, 0, num_rows - 1)]
                 for r, v in zip(rows_h, valid_h)

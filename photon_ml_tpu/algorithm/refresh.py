@@ -1,0 +1,502 @@
+"""Incremental GAME retrain: re-solve only what changed, carry the rest.
+
+Reference parity: partial retraining via locked coordinates
+(photon-lib algorithm/CoordinateDescent.scala:44-49 — a locked coordinate
+contributes scores and never retrains) and warm-start between
+configurations (GameEstimator.scala:352-366). The reference's granularity
+stops at the COORDINATE; this module pushes it to the ENTITY: a daily
+refresh re-solves only the random-effect entities whose data changed or
+whose gradient at the resident solution exceeds tolerance, against frozen
+residuals from the resident model's scores, warm-started from the resident
+coefficients — so a refresh costs ~the changed entities' solve time, not a
+full GAME fit (the Snap ML keep-resident-state-hot discipline,
+arXiv:1803.06333).
+
+Mechanics:
+
+- **Selection** (:func:`select_refresh_entities`): entities DECLARED
+  changed (``RefreshPolicy.changed_entities`` — the ingest layer knows who
+  got new rows) union entities whose per-entity solve-space gradient norm
+  at the resident coefficients exceeds ``gradient_tolerance`` (one vmapped
+  gradient pass per bucket — catches undeclared drift; an entity whose
+  data is unchanged sits at rounding-scale gradient because the resident
+  solve left it there).
+- **Solve**: the lane scheduler's active-set freezing promoted to an
+  externally-chosen set (``LaneScheduler.freeze_rows``): unselected lanes
+  are frozen and skipped by compaction, selected lanes re-solve with the
+  full iteration budget warm-started from their resident rows, and
+  untouched table rows carry over BITWISE (the compacted scatter never
+  writes them).
+- **Frozen residuals**: each coordinate re-solves against the partial
+  score of the RESIDENT model (full score minus its own contribution) —
+  exactly the residual-offset mechanism of the CD loop
+  (CoordinateDescent.scala:198-255), evaluated once at the resident state.
+- **Resume**: a checkpointer commits after every coordinate through the
+  one gated write site (``io.checkpoint.commit_checkpoint``, lint check
+  10); a preempted refresh fast-forwards past completed coordinates and
+  finishes bitwise-identical to an uninterrupted run. Restores are
+  fingerprint-guarded: a checkpoint written under a different
+  layout/λ-grid fails fast naming the differing fields.
+
+Strictly opt-in: nothing here runs unless the driver passes
+``--incremental-refresh`` (or a caller invokes ``GameEstimator.refresh``);
+the full-fit path is untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.algorithm.coordinates import (
+    Coordinate,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.models.game import GameModel
+from photon_ml_tpu.telemetry import refresh_counters, tracing
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class RefreshPolicy:
+    """What a refresh re-solves.
+
+    gradient_tolerance: re-solve entities whose solve-space gradient norm
+        at the resident solution exceeds this (None disables screening —
+        only declared entities re-solve).
+    changed_entities: RE type -> entity keys that saw new data since the
+        resident fit (the ingest layer's knowledge; may be empty — the
+        gradient screen catches changed entities too, since new rows move
+        the gradient off rounding scale).
+    refresh_fixed_effects: also re-solve fixed-effect coordinates
+        (warm-started from the resident coefficients, against the refreshed
+        residuals). Off by default: the FE is the global slow-moving part
+        of the model and the expensive solve a daily refresh exists to
+        skip.
+    """
+
+    gradient_tolerance: float | None = 1e-4
+    changed_entities: Mapping[str, Sequence] = dataclasses.field(
+        default_factory=dict
+    )
+    refresh_fixed_effects: bool = False
+
+
+@dataclasses.dataclass
+class RefreshResult:
+    """One incremental refresh's outcome + its selection evidence."""
+
+    model: GameModel
+    coordinate_stats: dict
+    lanes_total: int = 0
+    lanes_solved: int = 0
+    lanes_changed: int = 0
+    lanes_gradient: int = 0
+
+
+class RefreshFingerprintError(ValueError):
+    """A refresh (or its checkpoint) was attempted against a resident
+    model trained under a different layout/λ-grid — raised fast, with the
+    differing fields named (io.checkpoint.fingerprint_mismatch format)."""
+
+
+def _shard_dim(shard) -> int:
+    return int(getattr(shard, "feature_dim", None) or np.shape(shard)[1])
+
+
+def _vocab_digest(keys) -> str:
+    """Content digest of an entity vocab: same-SIZE membership drift (one
+    entity churned out, one churned in) still re-sorts every later row, so
+    the fingerprint must pin the vocab's CONTENT, not just its length.
+    Keys normalize through str so a '<U3' dataset vocab and an int model
+    vocab with equal keys digest equal."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for k in np.asarray(keys).tolist():
+        h.update(str(k).encode())
+        h.update(b"\x00")
+    return h.hexdigest()[:12]
+
+
+def expected_fingerprint(dataset, coordinate_configs, sequence,
+                         reg_weights: Mapping[str, float] | None = None) -> dict:
+    """This run's side of the refresh agreement: per-coordinate kind,
+    feature-shard identity and width, entity-vocab size, and λ — computed
+    from the CURRENT configs + data, compared field-by-field against
+    :func:`model_fingerprint` of the resident model."""
+    from photon_ml_tpu.estimators import (
+        FixedEffectCoordinateConfig,
+        MatrixFactorizationCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+
+    fp: dict = {"sequence": ",".join(sequence)}
+    for cid in sequence:
+        cfg = coordinate_configs[cid]
+        if isinstance(cfg, FixedEffectCoordinateConfig):
+            fp[f"{cid}/kind"] = "fixed"
+            fp[f"{cid}/shard"] = cfg.feature_shard_id
+            fp[f"{cid}/dim"] = _shard_dim(
+                dataset.feature_shards[cfg.feature_shard_id]
+            )
+        elif isinstance(cfg, RandomEffectCoordinateConfig):
+            fp[f"{cid}/kind"] = "random"
+            fp[f"{cid}/shard"] = cfg.feature_shard_id
+            fp[f"{cid}/re_type"] = cfg.random_effect_type
+            fp[f"{cid}/dim"] = _shard_dim(
+                dataset.feature_shards[cfg.feature_shard_id]
+            )
+            fp[f"{cid}/entities"] = len(
+                dataset.entity_vocabs[cfg.random_effect_type]
+            )
+            fp[f"{cid}/vocab"] = _vocab_digest(
+                dataset.entity_vocabs[cfg.random_effect_type]
+            )
+        elif isinstance(cfg, MatrixFactorizationCoordinateConfig):
+            fp[f"{cid}/kind"] = "matrix_factorization"
+            fp[f"{cid}/re_type"] = (
+                f"{cfg.row_effect_type}x{cfg.col_effect_type}"
+            )
+        if reg_weights is not None and cid in reg_weights:
+            fp[f"{cid}/lambda"] = float(reg_weights[cid])
+    return fp
+
+
+def model_fingerprint(model: GameModel, sequence=None,
+                      reg_weights: Mapping[str, float] | None = None) -> dict:
+    """The resident model's side of the refresh agreement (same keys as
+    :func:`expected_fingerprint`); ``reg_weights`` comes from the saved
+    model's metadata (optimizationConfigurations.regWeights) when known."""
+    from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+    from photon_ml_tpu.models.matrix_factorization import (
+        MatrixFactorizationModel,
+    )
+
+    sequence = list(sequence if sequence is not None else model.models)
+    fp: dict = {"sequence": ",".join(sequence)}
+    for cid in sequence:
+        m = model.models.get(cid)
+        if m is None:
+            continue  # the missing key itself surfaces in the diff
+        if isinstance(m, FixedEffectModel):
+            fp[f"{cid}/kind"] = "fixed"
+            fp[f"{cid}/shard"] = m.feature_shard_id
+            fp[f"{cid}/dim"] = int(
+                np.shape(m.glm.coefficients.means)[0]
+            )
+        elif isinstance(m, RandomEffectModel):
+            fp[f"{cid}/kind"] = "random"
+            fp[f"{cid}/shard"] = m.feature_shard_id
+            fp[f"{cid}/re_type"] = m.random_effect_type
+            fp[f"{cid}/dim"] = int(
+                m.feature_dim if m.is_compact
+                else np.shape(m.coefficients)[1]
+            )
+            fp[f"{cid}/entities"] = int(np.shape(m.coefficients)[0])
+            fp[f"{cid}/vocab"] = _vocab_digest(m.entity_keys)
+        elif isinstance(m, MatrixFactorizationModel):
+            fp[f"{cid}/kind"] = "matrix_factorization"
+            fp[f"{cid}/re_type"] = (
+                f"{m.row_effect_type}x{m.col_effect_type}"
+            )
+        if reg_weights is not None and cid in reg_weights:
+            fp[f"{cid}/lambda"] = float(reg_weights[cid])
+    return fp
+
+
+def check_refresh_fingerprint(resident_fp: dict, expected_fp: dict) -> None:
+    """Fail fast — naming the differing fields — when the resident model
+    was trained under a different layout/λ-grid than this refresh run."""
+    from photon_ml_tpu.io.checkpoint import fingerprint_mismatch
+
+    mismatch = fingerprint_mismatch(resident_fp, expected_fp)
+    if mismatch is not None:
+        raise RefreshFingerprintError(
+            "resident model is incompatible with this refresh "
+            f"configuration ({mismatch}); refresh with the layout/λ-grid "
+            "it was trained under, or run a full fit"
+        )
+
+
+def select_refresh_entities(
+    coord: RandomEffectCoordinate,
+    model,
+    extra_offsets,
+    policy: RefreshPolicy,
+) -> tuple[np.ndarray, dict]:
+    """(bool [num_entities] selection, {"changed": n, "gradient": n}):
+    declared-changed entities union gradient-screened entities (see the
+    module docstring)."""
+    re_type = coord.re_dataset.random_effect_type
+    num = int(coord.re_dataset.num_entities)
+    changed = np.zeros(num, dtype=bool)
+    keys = policy.changed_entities.get(re_type)
+    if keys is not None and len(keys):
+        vocab = np.asarray(coord.dataset.entity_vocabs[re_type])
+        keys_arr = np.asarray(list(keys))
+        if vocab.dtype.kind in "iu" and keys_arr.dtype.kind in "US":
+            # CLI-declared keys are strings; an integer vocab compares
+            # after a loud numeric parse (never a silent no-match)
+            keys_arr = keys_arr.astype(vocab.dtype)
+        elif vocab.dtype.kind in "US" and keys_arr.dtype.kind in "iu":
+            keys_arr = keys_arr.astype(vocab.dtype)
+        changed = np.isin(vocab, keys_arr)
+        missing = np.unique(keys_arr[~np.isin(keys_arr, vocab)])
+        if len(missing):
+            # a typo'd or NEW entity has no table row to re-solve —
+            # vocab growth needs a full fit (ROADMAP rider); loud, never
+            # a silent no-match
+            logger.warning(
+                "refresh policy declares %d changed %r entit%s not in the "
+                "resident vocab (%s): nothing re-solves for them — a NEW "
+                "entity needs a full fit, a typo needs fixing",
+                len(missing), re_type,
+                "y" if len(missing) == 1 else "ies",
+                ", ".join(repr(str(k)) for k in missing[:5])
+                + (", ..." if len(missing) > 5 else ""),
+            )
+    graded = np.zeros(num, dtype=bool)
+    if policy.gradient_tolerance is not None:
+        norms = coord.refresh_gradient_norms(model, extra_offsets)
+        # NaN = entity in no bucket: nothing to re-solve, never selected
+        graded = np.nan_to_num(norms, nan=0.0) > policy.gradient_tolerance
+    return changed | graded, {
+        "changed": int(changed.sum()),
+        "gradient": int(graded.sum()),
+    }
+
+
+def run_incremental_refresh(
+    coordinates: Mapping[str, Coordinate],
+    sequence: Sequence[str],
+    resident_model: GameModel,
+    policy: RefreshPolicy,
+    *,
+    checkpointer=None,
+    resume: bool = True,
+    check_finite: bool = True,
+    telemetry=None,
+    fingerprint: dict | None = None,
+) -> RefreshResult:
+    """One incremental refresh pass over ``sequence`` (see module
+    docstring). ``fingerprint`` (optional) rides every checkpoint commit
+    and guards resume: a mid-refresh checkpoint written under a different
+    agreement fails fast naming the differing fields."""
+    from photon_ml_tpu.io.checkpoint import (
+        DivergenceError,
+        commit_checkpoint,
+        fingerprint_mismatch,
+        game_model_from_arrays,
+        game_model_to_arrays,
+    )
+    from photon_ml_tpu.telemetry import resilience_counters
+
+    sequence = list(sequence)
+    models: dict = {}
+    for cid in sequence:
+        if cid not in resident_model.models:
+            raise RefreshFingerprintError(
+                f"resident model has no coordinate '{cid}' — refresh runs "
+                "under the layout the model was trained with (coordinates: "
+                f"{list(resident_model.models)})"
+            )
+        models[cid] = resident_model.get(cid)
+
+    if policy.changed_entities:
+        consumed = {
+            coordinates[cid].re_dataset.random_effect_type
+            for cid in sequence
+            if isinstance(coordinates[cid], RandomEffectCoordinate)
+        }
+        unconsumed = sorted(set(policy.changed_entities) - consumed)
+        if unconsumed:
+            # a typo'd reType — or an MF effect type — would otherwise
+            # no-op silently while the summary reads "refreshed"
+            logger.warning(
+                "refresh policy declares changed entities for effect "
+                "type(s) %s, but no refreshable random-effect coordinate "
+                "consumes them — fixed-effect and MF coordinates carry "
+                "over (entity-granular MF refresh is a ROADMAP rider)",
+                unconsumed,
+            )
+
+    coordinate_stats: dict = {}
+    totals = {"lanes_total": 0, "lanes_solved": 0, "lanes_changed": 0,
+              "lanes_gradient": 0}
+    start_pos = 0
+    if checkpointer is not None and resume:
+        ckpt = checkpointer.restore()
+        if ckpt is not None:
+            if ckpt.meta.get("kind") != "incremental_refresh":
+                raise ValueError(
+                    f"checkpoint at {checkpointer.directory} is not an "
+                    f"incremental-refresh checkpoint "
+                    f"(kind={ckpt.meta.get('kind')!r}); use a fresh "
+                    "checkpoint directory"
+                )
+            saved = ckpt.meta.get("refresh", {})
+            if list(saved.get("sequence", [])) != sequence:
+                raise ValueError(
+                    "refresh checkpoint is incompatible with this run: it "
+                    f"covers coordinates {saved.get('sequence')} but the "
+                    f"update sequence is {sequence}; pass resume=False or "
+                    "a fresh checkpoint directory"
+                )
+            if fingerprint is not None:
+                mismatch = fingerprint_mismatch(
+                    saved.get("fingerprint"), fingerprint
+                )
+                if mismatch is not None:
+                    raise RefreshFingerprintError(
+                        f"refresh checkpoint at {checkpointer.directory} "
+                        f"was written under a different agreement "
+                        f"({mismatch}); resume with the original "
+                        "layout/λ-grid, or use a fresh checkpoint directory"
+                    )
+            restored = game_model_from_arrays(ckpt.arrays, ckpt.meta["model"])
+            models.update(restored.models)
+            coordinate_stats = dict(saved.get("stats", {}))
+            totals.update(saved.get("totals", {}))
+            start_pos = int(saved.get("position", 0))
+            resilience_counters.record_checkpoint_restore()
+            if start_pos >= len(sequence):
+                # a COMPLETED refresh checkpoint (e.g. yesterday's run in
+                # the same directory): every coordinate fast-forwards and
+                # the CHECKPOINTED model comes back untouched — correct
+                # for an idempotent re-run, wrong for new data. Loud, so
+                # a daily-refresh operator reaching for fresh data knows
+                # to pass resume=False or a fresh checkpoint directory.
+                logger.warning(
+                    "refresh checkpoint at %s already covers the whole "
+                    "update sequence — returning the checkpointed model "
+                    "WITHOUT re-reading today's data; pass resume=False "
+                    "(--no-resume) or a fresh checkpoint directory to "
+                    "refresh against new data",
+                    checkpointer.directory,
+                )
+            logger.info(
+                "Resuming incremental refresh from coordinate %d/%d",
+                start_pos, len(sequence),
+            )
+
+    scores = {cid: coordinates[cid].score(models[cid]) for cid in sequence}
+
+    def full_score():
+        it = iter(scores.values())
+        total = next(it).copy()
+        for s in it:
+            total = total + s
+        return total
+
+    def commit(position: int) -> None:
+        if checkpointer is None:
+            return
+        arrays, model_meta = game_model_to_arrays(
+            GameModel(models=dict(models))
+        )
+        meta = {
+            "kind": "incremental_refresh",
+            "model": model_meta,
+            "refresh": {
+                "fingerprint": fingerprint,
+                "position": position,
+                "sequence": sequence,
+                "stats": coordinate_stats,
+                "totals": totals,
+            },
+        }
+        # the ONE gated write site (lint check 10); refresh is
+        # single-process, so the rank gate is a pass-through
+        commit_checkpoint(checkpointer, position, arrays, meta)
+
+    for position, cid in enumerate(sequence):
+        if position < start_pos:
+            continue  # completed before the restored checkpoint
+        coord = coordinates[cid]
+        is_re = isinstance(coord, RandomEffectCoordinate)
+        with tracing.span("refresh/coordinate", cat="refresh",
+                          coordinate=cid, position=position):
+            if not is_re:
+                if (
+                    policy.refresh_fixed_effects
+                    and isinstance(coord, FixedEffectCoordinate)
+                ):
+                    partial = full_score() - scores[cid]
+                    model_new, _info = coord.update_model(models[cid], partial)
+                    models[cid] = model_new
+                    scores[cid] = coord.score(model_new)
+                    coordinate_stats[cid] = {"refreshed": True, "kind": "fe"}
+                else:
+                    # fixed effects / MF / locked coordinates carry over
+                    # untouched (their scores still anchor the residuals)
+                    refresh_counters.record_carried_coordinate()
+                    coordinate_stats[cid] = {"refreshed": False}
+                    commit(position + 1)
+                    continue
+            else:
+                partial = full_score() - scores[cid]
+                selection, sel_stats = select_refresh_entities(
+                    coord, models[cid], partial, policy
+                )
+                coord.set_refresh_selection(selection)
+                try:
+                    model_new, _info = coord.update_model(models[cid], partial)
+                finally:
+                    coord.set_refresh_selection(None)
+                models[cid] = model_new
+                scores[cid] = coord.score(model_new)
+                sched = coord.last_refresh_stats
+                stats = {
+                    "refreshed": True,
+                    "kind": "re",
+                    "lanes_total": int(sched.lanes_total),
+                    "lanes_solved": int(sched.lanes_probed),
+                    "lanes_changed": sel_stats["changed"],
+                    "lanes_gradient": sel_stats["gradient"],
+                }
+                coordinate_stats[cid] = stats
+                totals["lanes_total"] += stats["lanes_total"]
+                totals["lanes_solved"] += stats["lanes_solved"]
+                totals["lanes_changed"] += stats["lanes_changed"]
+                totals["lanes_gradient"] += stats["lanes_gradient"]
+                refresh_counters.record_selection(
+                    lanes_total=stats["lanes_total"],
+                    lanes_solved=stats["lanes_solved"],
+                    lanes_changed=stats["lanes_changed"],
+                    lanes_gradient=stats["lanes_gradient"],
+                )
+            if check_finite:
+                # reduce on device: only a scalar crosses to the host
+                if not bool(jnp.isfinite(jnp.asarray(scores[cid])).all()):
+                    raise DivergenceError(
+                        f"coordinate '{cid}' produced non-finite scores "
+                        "during incremental refresh"
+                        + (
+                            f"; last good checkpoint: step "
+                            f"{checkpointer.latest_step()} in "
+                            f"{checkpointer.directory}"
+                            if checkpointer is not None else ""
+                        )
+                    )
+            if telemetry is not None:
+                telemetry.heartbeat(
+                    "game_refresh", position=position + 1,
+                    num_coordinates=len(sequence),
+                )
+            commit(position + 1)
+
+    return RefreshResult(
+        model=GameModel(models=dict(models)),
+        coordinate_stats=coordinate_stats,
+        lanes_total=totals["lanes_total"],
+        lanes_solved=totals["lanes_solved"],
+        lanes_changed=totals["lanes_changed"],
+        lanes_gradient=totals["lanes_gradient"],
+    )

@@ -62,6 +62,7 @@ from photon_ml_tpu.projector.projectors import ProjectorType
 from photon_ml_tpu.telemetry import RunJournal, SolverTelemetry, default_registry
 from photon_ml_tpu.telemetry.layout import reset_layout_metrics
 from photon_ml_tpu.telemetry.probes import CompileMonitor, live_buffer_bytes
+from photon_ml_tpu.telemetry.refresh_counters import reset_refresh_metrics
 from photon_ml_tpu.telemetry.resilience_counters import reset_resilience_metrics
 from photon_ml_tpu.telemetry.solver_trace import reset_solver_metrics
 from photon_ml_tpu.types import TaskType
@@ -179,6 +180,24 @@ class GameTrainingParams:
     duhl_working_set: int = 0
     #: cold-tail chunks revisited per sweep under the DuHL schedule
     duhl_tail_chunks: int = 1
+    #: incremental retrain (ISSUE 14, algorithm/refresh.py): re-solve only
+    #: the random-effect entities that saw new data or whose gradient at
+    #: the resident solution exceeds tolerance, against frozen residuals
+    #: from the resident model's scores — a refresh costs ~the changed
+    #: entities' solve time, not a full GAME fit. Strictly opt-in: off is
+    #: the unchanged full-fit path. Needs a resident model
+    #: (--model-input-dir, or --checkpoint-dir warm-start re-entry).
+    incremental_refresh: bool = False
+    #: gradient screen: re-solve entities whose solve-space gradient norm
+    #: at the resident solution exceeds this (<= 0 disables the screen —
+    #: only declared entities re-solve)
+    refresh_gradient_tolerance: float = 1e-4
+    #: raw "reType=key1|key2" specs: entities DECLARED changed (the ingest
+    #: layer's knowledge); the gradient screen catches undeclared drift
+    refresh_changed_entities: tuple[str, ...] = ()
+    #: also re-solve fixed-effect coordinates (warm-started) — off by
+    #: default: the FE is the slow-moving global part a refresh skips
+    refresh_fixed_effects: bool = False
 
     def validate(self) -> None:
         """Cross-parameter checks (reference validateParams:196-298)."""
@@ -252,6 +271,14 @@ class GameTrainingParams:
             and not self.evaluators
         ):
             problems.append("hyperparameter tuning requires --evaluators")
+        if self.incremental_refresh:
+            self._validate_refresh(problems)
+        elif self.refresh_changed_entities or self.refresh_fixed_effects:
+            problems.append(
+                "--refresh-changed-entities/--refresh-fixed-effects tune "
+                "the incremental-refresh policy; pass --incremental-refresh "
+                "to opt into the refresh driver mode"
+            )
         if self.streaming_chunks > 0:
             self._validate_streaming(problems)
         elif self.duhl_working_set > 0:
@@ -261,6 +288,53 @@ class GameTrainingParams:
             )
         if problems:
             raise ValueError("invalid driver parameters: " + "; ".join(problems))
+
+    def _validate_refresh(self, problems: list) -> None:
+        """The incremental-refresh surface (ISSUE 14): the single-process
+        host CD path, one λ per coordinate, against a resident model.
+        Everything outside it fails fast with the composing alternative
+        named (lint check 8)."""
+        if not self.model_input_dir and not self.checkpoint_dir:
+            problems.append(
+                "--incremental-refresh needs a resident model: pass "
+                "--model-input-dir (a saved model directory) or "
+                "--checkpoint-dir (a training run's CD checkpoints — "
+                "warm-start re-entry)"
+            )
+        if self.distributed or self.mesh_shape or self.partitioned_io:
+            problems.append(
+                "--incremental-refresh is the single-process host path; "
+                "drop --distributed/--mesh/--partitioned-io (run the full "
+                "fused fit to retrain at mesh scale)"
+            )
+        if self.streaming_chunks > 0:
+            problems.append(
+                "--incremental-refresh reads the refresh data in-core; "
+                "drop --streaming-chunks (or run the streamed full fit)"
+            )
+        if self.hyperparameter_tuning != HyperparameterTuningMode.NONE:
+            problems.append(
+                "--incremental-refresh trains the resident λ; drop "
+                "--hyperparameter-tuning (tune on a full fit)"
+            )
+        if self.validation_data_path or self.evaluators:
+            problems.append(
+                "--incremental-refresh has no validation pass; drop "
+                "--validation-data-path/--evaluators and score with the "
+                "scoring driver"
+            )
+        if self.refresh_gradient_tolerance < 0:
+            problems.append("--refresh-gradient-tolerance must be >= 0")
+        for name, cfg in self.coordinates.items():
+            if len(cfg.reg_weights) != 1:
+                problems.append(
+                    f"coordinate '{name}': --incremental-refresh trains "
+                    "the resident λ; pass a single reg.weights value"
+                )
+        try:
+            _parse_changed_entities(self.refresh_changed_entities)
+        except ValueError as e:
+            problems.append(str(e))
 
     def _validate_streaming(self, problems: list) -> None:
         """The streamed-GAME surface (ISSUE 11): one dense primary FE +
@@ -381,6 +455,23 @@ class GameTrainingParams:
                 )
 
 
+def _parse_changed_entities(specs) -> dict:
+    """'reType=key1|key2' specs -> {reType: (keys...)} (repeatable,
+    same-type specs merge)."""
+    out: dict = {}
+    for spec in specs:
+        typ, sep, keys = str(spec).partition("=")
+        typ = typ.strip()
+        if not sep or not typ:
+            raise ValueError(
+                f"bad --refresh-changed-entities {spec!r}; expected "
+                "reType=key1|key2"
+            )
+        out.setdefault(typ, [])
+        out[typ] += [k for k in keys.split("|") if k]
+    return {k: tuple(v) for k, v in out.items()}
+
+
 def _trace_exchange():
     """Exchange for run-end trace publication + straggler merge: the
     coordination-service KV transport on multi-process runs (EVERY rank's
@@ -437,6 +528,7 @@ def run(params: GameTrainingParams) -> dict:
     reset_solver_metrics()
     reset_layout_metrics()
     reset_resilience_metrics()
+    reset_refresh_metrics()
     events.send(TrainingStartEvent(job_name="game-training"))
     job_log = PhotonLogger(os.path.join(out, "driver.log"))
     # rank-gated journal: inert on worker ranks, so telemetry calls below
@@ -536,6 +628,10 @@ def _run_inner(
     job_log: PhotonLogger,
     telemetry: SolverTelemetry | None = None,
 ) -> dict:
+    if params.incremental_refresh:
+        # the refresh mode reads the data in the RESIDENT model's feature
+        # space (its index maps + entity vocabs) — a separate pipeline
+        return _run_refresh(params, job_log, telemetry)
     if params.streaming_chunks > 0:
         # the out-of-core path does its own streaming scans — the full
         # read below would materialize exactly what it exists to avoid
@@ -1196,6 +1292,237 @@ def _run_streaming(
     return summary
 
 
+def _run_refresh(
+    params: GameTrainingParams,
+    job_log: PhotonLogger,
+    telemetry: SolverTelemetry | None = None,
+) -> dict:
+    """The --incremental-refresh pipeline (ISSUE 14, algorithm/refresh.py):
+    load the resident model (saved directory, or warm-start re-entry from
+    a training run's CD checkpoints), read the refresh data in ITS feature
+    space, fingerprint-guard the agreement (layout + λ — a mismatch fails
+    fast naming fields), then re-solve only the policy-selected
+    random-effect entities against frozen residuals, under
+    ``run_with_recovery`` with per-coordinate refresh checkpoints."""
+    import jax  # noqa: F401  (platform selection must already be done)
+
+    from photon_ml_tpu.algorithm.refresh import (
+        RefreshPolicy,
+        check_refresh_fingerprint,
+        expected_fingerprint,
+        model_fingerprint,
+    )
+    from photon_ml_tpu.cli.game_scoring_driver import _load_scoring_model
+    from photon_ml_tpu.io.checkpoint import (
+        TrainingCheckpointer,
+        latest_trained_model,
+    )
+    from photon_ml_tpu.resilience import default_io_policy, run_with_recovery
+
+    out = params.root_output_dir
+    reg_weights = {
+        name: cfg.reg_weights[0] for name, cfg in params.coordinates.items()
+    }
+
+    saved_reg_weights = None
+    if params.model_input_dir:
+        model, index_maps, feature_shards, entity_vocabs, re_columns = (
+            _load_scoring_model(
+                model_input_dir=params.model_input_dir,
+                index_maps_dir=params.index_maps_dir,
+                feature_shards=params.feature_shards,
+                compact_random_effect_threshold=(
+                    params.compact_random_effect_threshold
+                ),
+            )
+        )
+        meta_path = os.path.join(params.model_input_dir, "model-metadata.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                saved = (
+                    json.load(f).get("optimizationConfigurations") or {}
+                ).get("regWeights")
+            if isinstance(saved, dict):
+                saved_reg_weights = {k: float(v) for k, v in saved.items()}
+    else:
+        # warm-start re-entry from PR 8 checkpoint state: the training
+        # run's CD checkpoint directory IS the resident model
+        with Timed("restore resident model from checkpoint"):
+            restored = latest_trained_model(
+                TrainingCheckpointer(params.checkpoint_dir)
+            )
+        if restored is None:
+            raise ValueError(
+                f"--checkpoint-dir {params.checkpoint_dir!r} holds no "
+                "loadable checkpoint; pass --model-input-dir (a saved "
+                "model) instead"
+            )
+        model, step = restored
+        job_log.info("resident model restored from checkpoint step %d", step)
+        if not params.index_maps_dir:
+            raise ValueError(
+                "checkpoint warm-start re-entry needs --index-maps-dir "
+                "(the training run's saved stores) so the refresh data "
+                "reads in the resident model's feature space; or pass "
+                "--model-input-dir"
+            )
+        index_maps = IndexMap.load_directory(params.index_maps_dir)
+        feature_shards = params.feature_shards
+        entity_vocabs = {}
+        re_set = set()
+        from photon_ml_tpu.models.game import RandomEffectModel
+        from photon_ml_tpu.models.matrix_factorization import (
+            MatrixFactorizationModel,
+        )
+
+        for m in model.models.values():
+            if isinstance(m, RandomEffectModel):
+                entity_vocabs[m.random_effect_type] = np.asarray(m.entity_keys)
+                re_set.add(m.random_effect_type)
+            elif isinstance(m, MatrixFactorizationModel):
+                entity_vocabs[m.row_effect_type] = np.asarray(m.row_keys)
+                entity_vocabs[m.col_effect_type] = np.asarray(m.col_keys)
+                re_set.update((m.row_effect_type, m.col_effect_type))
+        re_columns = tuple(sorted(re_set))
+
+    with Timed("read refresh data"):
+        part = default_io_policy().call(
+            lambda: read_partitioned(
+                params.input_data_path,
+                feature_shards,
+                index_maps=index_maps or None,
+                random_effect_id_columns=re_columns,
+                evaluation_id_columns=(),
+                entity_vocabs=entity_vocabs,
+                fmt=params.input_format,
+                tag="refresh",
+                on_corrupt=params.on_corrupt,
+            ),
+            description="read refresh data",
+        )
+        dataset = part.result.dataset
+    job_log.info("read %d refresh samples", dataset.num_samples)
+
+    sequence = list(params.update_sequence or params.coordinates.keys())
+    coordinate_configs = estimator_coordinate_configs(
+        params.coordinates, reg_weights
+    )
+    # the agreement guard: layout + λ, both sides' differing fields named.
+    # λ only cross-checks when the saved model METADATA recorded it (the
+    # checkpoint re-entry path has no regWeights record).
+    expected = expected_fingerprint(
+        dataset, coordinate_configs, sequence,
+        reg_weights=reg_weights if saved_reg_weights is not None else None,
+    )
+    resident_fp = model_fingerprint(
+        model, sequence, reg_weights=saved_reg_weights
+    )
+    check_refresh_fingerprint(resident_fp, expected)
+
+    policy = RefreshPolicy(
+        gradient_tolerance=(
+            params.refresh_gradient_tolerance
+            if params.refresh_gradient_tolerance > 0 else None
+        ),
+        changed_entities=_parse_changed_entities(
+            params.refresh_changed_entities
+        ),
+        refresh_fixed_effects=params.refresh_fixed_effects,
+    )
+    refresh_ckpt = None
+    if params.checkpoint_dir:
+        import shutil
+
+        refresh_dir = os.path.join(params.checkpoint_dir, "refresh")
+        if not params.resume and os.path.isdir(refresh_dir):
+            # --no-resume: purge stale refresh progress NOW, so a
+            # mid-run transient restart (which always resumes — that's
+            # what the checkpoint is for) resumes THIS run's steps, never
+            # yesterday's completed refresh
+            shutil.rmtree(refresh_dir)
+        refresh_ckpt = TrainingCheckpointer(refresh_dir)
+    estimator = GameEstimator(
+        task=params.task_type,
+        coordinate_configs=coordinate_configs,
+        update_sequence=sequence,
+        normalization=params.normalization,
+        locked_coordinates=frozenset(params.partial_retrain_locked_coordinates),
+        intercept_indices=part.result.intercept_indices,
+        telemetry=telemetry,
+    )
+    if telemetry is not None and telemetry.journal is not None:
+        telemetry.journal.record(
+            "config",
+            task_type=params.task_type.name,
+            incremental_refresh=True,
+            update_sequence=sequence,
+            refresh_gradient_tolerance=params.refresh_gradient_tolerance,
+            refresh_changed_entities={
+                k: len(v) for k, v in policy.changed_entities.items()
+            },
+            refresh_fixed_effects=params.refresh_fixed_effects,
+        )
+
+    with Timed("incremental refresh"):
+        def attempt(restart: int):
+            return estimator.refresh(
+                dataset, model, policy,
+                checkpointer=refresh_ckpt,
+                fingerprint=expected,
+                # restarts must resume even under --no-resume (the whole
+                # point of the restart is the checkpoint)
+                resume=params.resume or restart > 0,
+            )
+
+        result = run_with_recovery(
+            attempt,
+            max_restarts=params.max_restarts,
+            checkpointer=refresh_ckpt,
+            journal=telemetry.journal if telemetry is not None else None,
+            description="incremental refresh",
+        )
+
+    if params.model_output_mode != ModelOutputMode.NONE:
+        save_game_model(
+            os.path.join(out, "best"), result.model, index_maps,
+            optimization_configurations={"regWeights": reg_weights},
+        )
+    summary: dict = {
+        "distributed": False,
+        # ONE source of truth: the RefreshResult (the refresh/* registry
+        # counters carry the same numbers into the journal snapshot)
+        "incremental_refresh": {
+            "lanes_total": result.lanes_total,
+            "lanes_solved": result.lanes_solved,
+            "lanes_changed": result.lanes_changed,
+            "lanes_gradient": result.lanes_gradient,
+            "coordinates": result.coordinate_stats,
+            "coordinates_refreshed": sum(
+                1 for s in result.coordinate_stats.values()
+                if s.get("refreshed")
+            ),
+            "coordinates_carried": sum(
+                1 for s in result.coordinate_stats.values()
+                if not s.get("refreshed")
+            ),
+        },
+        "num_configurations": 1,
+        "effective_coordinate_configurations": {
+            name: format_coordinate_config(cfg)
+            for name, cfg in params.coordinates.items()
+        },
+        "best_configuration_index": 0,
+        "best_reg_weights": reg_weights,
+        "best_metric": float("nan"),
+        "metric_history": [],
+    }
+    summary["timings"] = timing_summary()
+    with open(os.path.join(out, "training-summary.json"), "w") as f:
+        json.dump(_json_safe(summary), f, indent=2, default=float)
+    events.send(TrainingFinishEvent(job_name="game-training", succeeded=True))
+    return summary
+
+
 def _json_safe(obj):
     """NaN/Inf -> None so the summary is strict JSON."""
     if isinstance(obj, dict):
@@ -1312,6 +1639,23 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--duhl-tail-chunks", type=int, default=1,
                    help="cold-tail chunks revisited per sweep under "
                         "--duhl-working-set")
+    p.add_argument("--incremental-refresh", action="store_true",
+                   help="incremental retrain (ISSUE 14): re-solve only the "
+                        "RE entities that saw new data or whose gradient "
+                        "at the resident solution exceeds tolerance, "
+                        "against frozen residuals — needs --model-input-dir "
+                        "or --checkpoint-dir (the resident model)")
+    p.add_argument("--refresh-gradient-tolerance", type=float, default=1e-4,
+                   help="re-solve entities whose solve-space gradient norm "
+                        "at the resident solution exceeds this (0 disables "
+                        "the screen: only declared entities re-solve)")
+    p.add_argument("--refresh-changed-entities", action="append", default=[],
+                   help="reType=key1|key2 — entities DECLARED changed "
+                        "(repeatable; the gradient screen catches "
+                        "undeclared drift)")
+    p.add_argument("--refresh-fixed-effects", action="store_true",
+                   help="also re-solve fixed-effect coordinates "
+                        "(warm-started) during the refresh")
     return p
 
 
@@ -1371,6 +1715,10 @@ def parse_args(argv: Sequence[str] | None = None) -> GameTrainingParams:
         streaming_prefetch=not args.no_streaming_prefetch,
         duhl_working_set=args.duhl_working_set,
         duhl_tail_chunks=args.duhl_tail_chunks,
+        incremental_refresh=args.incremental_refresh,
+        refresh_gradient_tolerance=args.refresh_gradient_tolerance,
+        refresh_changed_entities=tuple(args.refresh_changed_entities),
+        refresh_fixed_effects=args.refresh_fixed_effects,
     )
 
 

@@ -61,6 +61,8 @@ def run(
     num_requests: int | None = None,
     bf16: bool = False,
     skip_unbatched_baseline: bool = False,
+    swap_model_dir: str | None = None,
+    swap_at_request: int | None = None,
     telemetry_dir: str | None = None,
     trace_dir: str | None = None,
 ) -> dict:
@@ -74,6 +76,14 @@ def run(
     features (not bitwise). skip_unbatched_baseline: drop the embedded
     one-request-per-dispatch comparison (it costs one dispatch per
     request — slow over a ~100 ms tunnel when the replay is long).
+
+    swap_model_dir: zero-downtime refresh rehearsal — a refreshed model
+    (e.g. the incremental-refresh driver's output) hot-swapped IN-PLACE
+    mid-replay through the guarded swap API while requests keep flowing;
+    the summary's ``swap`` block carries the evidence (zero dropped
+    requests, ledger-attributed score-program compiles across the swap ==
+    0 on a same-layout model). swap_at_request: the submit index the swap
+    fires before (default: halfway).
 
     telemetry_dir: rank-0 JSONL run journal (serve/* counters + latency
     histogram + phase timings) — written on the FAILURE path too.
@@ -125,6 +135,8 @@ def run(
             num_requests=num_requests,
             bf16=bf16,
             skip_unbatched_baseline=skip_unbatched_baseline,
+            swap_model_dir=swap_model_dir,
+            swap_at_request=swap_at_request,
         )
         succeeded = True
         if journal is not None:
@@ -177,6 +189,8 @@ def _run_inner(
     num_requests: int | None,
     bf16: bool,
     skip_unbatched_baseline: bool,
+    swap_model_dir: str | None = None,
+    swap_at_request: int | None = None,
 ) -> dict:
     import jax
 
@@ -251,6 +265,37 @@ def _run_inner(
     with Timed("warm compile"), CompileMonitor() as warm_compiles:
         scorer.warm(requests[0])
 
+    swap_model = None
+    if swap_model_dir:
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        with Timed("load swap model"):
+            # the SAME index maps as the resident model: an equal layout
+            # is the whole point of a hot swap (the guard rejects a
+            # mismatch typed, naming the differing leaves)
+            swap_model = load_game_model(
+                swap_model_dir, index_maps or None,
+                compact_random_effect_threshold=(
+                    compact_random_effect_threshold
+                ),
+            )
+        if len(requests) < 2:
+            raise ValueError(
+                f"the replay has {len(requests)} request(s) but the "
+                "mid-replay swap fires BETWEEN requests; raise "
+                "--num-requests / shrink --request-rows, or drop "
+                "--swap-model-dir"
+            )
+        if swap_at_request is None:
+            swap_at_request = max(1, len(requests) // 2)
+        # strict upper bound: the swap fires BEFORE submit index i, so
+        # len(requests) would silently never fire
+        if not 0 < swap_at_request < len(requests):
+            raise ValueError(
+                f"--swap-at-request {swap_at_request} is outside the "
+                f"replay (1..{len(requests) - 1})"
+            )
+
     unbatched_rate = None
     if not skip_unbatched_baseline:
         with Timed("unbatched baseline"):
@@ -277,6 +322,7 @@ def _run_inner(
         # phase stamp makes any program_compile row from here on
         # attributable to the replay, not the warm-up
         ledger.set_phase("replay")
+    swap_info = None
     with Timed("batched replay"), CompileMonitor() as replay_compiles:
         server = MicroBatchServer(
             scorer,
@@ -285,11 +331,34 @@ def _run_inner(
         )
         t0 = time.perf_counter()
         with server:
-            futures = [server.submit(r) for r in requests]
+            futures = []
+            for i, r in enumerate(requests):
+                if swap_model is not None and i == swap_at_request:
+                    # the zero-downtime seam: swap IN-PLACE while the
+                    # consumer keeps draining; a same-layout swap must
+                    # compile nothing (the ledger delta below proves it)
+                    pre = (
+                        ledger.snapshot()
+                        .get("serve/score", {}).get("compiles", 0)
+                        if ledger is not None else None
+                    )
+                    server.swap_model(swap_model)
+                    swap_info = {
+                        "performed": True,
+                        "at_request": i,
+                        "_compiles_before": pre,
+                    }
+                futures.append(server.submit(r))
             for f in futures:
                 f.result()
         batched_sec = time.perf_counter() - t0
     batched_rate = total_rows / max(batched_sec, 1e-9)
+    if swap_info is not None:
+        pre = swap_info.pop("_compiles_before")
+        swap_info["score_compiles_after_swap"] = (
+            None if pre is None else
+            ledger.snapshot().get("serve/score", {}).get("compiles", 0) - pre
+        )
 
     latency = serving_counters.latency_summary()
     summary = {
@@ -307,6 +376,9 @@ def _run_inner(
         "compiled_signatures": len(scorer.signatures),
         "warm_compiles": warm_compiles.count,
         "replay_compiles": replay_compiles.count,
+        # mid-replay hot-swap evidence (None without --swap-model-dir):
+        # every submitted request resolved above, so zero were dropped
+        "swap": swap_info,
         # per-label compile accounting from the program ledger (None when
         # --telemetry-dir is off): the count's attribution lives in the
         # journal's program_compile/program_recompile rows, phase-stamped
@@ -351,6 +423,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--skip-unbatched-baseline", action="store_true",
                    help="skip the embedded one-request-per-dispatch "
                         "baseline pass")
+    p.add_argument("--swap-model-dir",
+                   help="hot-swap this refreshed model in-place mid-replay "
+                        "(zero-downtime refresh rehearsal; same-layout "
+                        "models only — the guard rejects layout changes "
+                        "typed)")
+    p.add_argument("--swap-at-request", type=int, default=None,
+                   help="submit index the swap fires before (default: "
+                        "halfway through the replay)")
     p.add_argument("--telemetry-dir",
                    help="write a rank-0 JSONL run journal (serve/* "
                         "counters, latency histogram, phase timings) here "
@@ -385,6 +465,8 @@ def main(argv: Sequence[str] | None = None) -> dict:
         num_requests=args.num_requests,
         bf16=args.bf16,
         skip_unbatched_baseline=args.skip_unbatched_baseline,
+        swap_model_dir=args.swap_model_dir,
+        swap_at_request=args.swap_at_request,
         telemetry_dir=args.telemetry_dir,
         trace_dir=args.trace_dir,
     )

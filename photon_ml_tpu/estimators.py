@@ -220,8 +220,56 @@ class GameEstimator:
             )
         if self.mesh is not None:
             return self._fit_distributed(dataset, validation_dataset, initial_model)
-        sequence = list(self.update_sequence or self.coordinate_configs.keys())
+        sequence, coordinates = self._build_coordinates(dataset, initial_model)
 
+        train_eval_data = EvaluationData(
+            labels=np.asarray(dataset.labels),
+            offsets=np.asarray(dataset.offsets),
+            weights=np.asarray(dataset.weights),
+            ids=dataset.ids,
+        )
+        validation_scorer = None
+        validation_data = None
+        evaluators: list[Evaluator] = [parse_evaluator(s) for s in self.validation_evaluators]
+        if validation_dataset is not None and evaluators:
+            validation_data = EvaluationData(
+                labels=np.asarray(validation_dataset.labels),
+                offsets=np.asarray(validation_dataset.offsets),
+                weights=np.asarray(validation_dataset.weights),
+                ids=validation_dataset.ids,
+            )
+
+            def validation_scorer(model: GameModel):
+                return np.asarray(model.score_dataset(validation_dataset)) + np.asarray(
+                    validation_dataset.offsets
+                )
+
+        initial_models = dict(initial_model.models) if initial_model is not None else None
+        return run_coordinate_descent(
+            coordinates,
+            sequence,
+            self.num_iterations,
+            initial_models=initial_models,
+            locked_coordinates=self.locked_coordinates,
+            training_evaluator=default_evaluator_for_task(self.task),
+            training_data=train_eval_data,
+            validation_evaluators=evaluators,
+            validation_scorer=validation_scorer,
+            validation_data=validation_data,
+            checkpointer=self.checkpointer,
+            checkpoint_every=self.checkpoint_every,
+            resume=self.resume,
+            check_finite=self.check_finite,
+            telemetry=self.telemetry,
+        )
+
+    def _build_coordinates(
+        self, dataset: GameDataset, initial_model: GameModel | None
+    ):
+        """The host-loop CD path's coordinate construction, shared by
+        ``fit`` and ``refresh``: (sequence, coordinate map) with locked
+        coordinates wrapped as ModelCoordinates."""
+        sequence = list(self.update_sequence or self.coordinate_configs.keys())
         norms = self._prepare_normalization(dataset)
         coordinates: dict[str, Coordinate] = {}
         for cid in sequence:
@@ -292,46 +340,50 @@ class GameEstimator:
                     normalization=norms.get(cfg.feature_shard_id),
                     intercept_index=self.intercept_indices.get(cfg.feature_shard_id),
                 )
+        return sequence, coordinates
 
-        train_eval_data = EvaluationData(
-            labels=np.asarray(dataset.labels),
-            offsets=np.asarray(dataset.offsets),
-            weights=np.asarray(dataset.weights),
-            ids=dataset.ids,
+    def refresh(
+        self,
+        dataset: GameDataset,
+        resident_model: GameModel,
+        policy=None,
+        *,
+        checkpointer=None,
+        fingerprint: dict | None = None,
+        resume: bool | None = None,
+    ):
+        """Incremental retrain (algorithm/refresh.py): re-solve only the
+        random-effect entities the policy selects — declared-changed or
+        gradient-screened — against frozen residuals from
+        ``resident_model``'s scores, warm-started from its coefficients;
+        everything unselected carries over bitwise. Strictly opt-in: the
+        full-fit ``fit`` path is untouched. Host-loop path only (single
+        process, no mesh)."""
+        from photon_ml_tpu.algorithm.refresh import (
+            RefreshPolicy,
+            run_incremental_refresh,
         )
-        validation_scorer = None
-        validation_data = None
-        evaluators: list[Evaluator] = [parse_evaluator(s) for s in self.validation_evaluators]
-        if validation_dataset is not None and evaluators:
-            validation_data = EvaluationData(
-                labels=np.asarray(validation_dataset.labels),
-                offsets=np.asarray(validation_dataset.offsets),
-                weights=np.asarray(validation_dataset.weights),
-                ids=validation_dataset.ids,
+
+        if self.mesh is not None or self.partition is not None:
+            raise ValueError(
+                "incremental refresh is the single-process host path; "
+                "drop mesh/partition and refresh on one host, or run the "
+                "full fused fit to retrain at mesh scale"
             )
-
-            def validation_scorer(model: GameModel):
-                return np.asarray(model.score_dataset(validation_dataset)) + np.asarray(
-                    validation_dataset.offsets
-                )
-
-        initial_models = dict(initial_model.models) if initial_model is not None else None
-        return run_coordinate_descent(
+        sequence, coordinates = self._build_coordinates(
+            dataset, resident_model
+        )
+        return run_incremental_refresh(
             coordinates,
             sequence,
-            self.num_iterations,
-            initial_models=initial_models,
-            locked_coordinates=self.locked_coordinates,
-            training_evaluator=default_evaluator_for_task(self.task),
-            training_data=train_eval_data,
-            validation_evaluators=evaluators,
-            validation_scorer=validation_scorer,
-            validation_data=validation_data,
-            checkpointer=self.checkpointer,
-            checkpoint_every=self.checkpoint_every,
-            resume=self.resume,
+            resident_model,
+            policy if policy is not None else RefreshPolicy(),
+            checkpointer=checkpointer if checkpointer is not None
+            else self.checkpointer,
+            resume=self.resume if resume is None else resume,
             check_finite=self.check_finite,
             telemetry=self.telemetry,
+            fingerprint=fingerprint,
         )
 
     def _check_partition_supported(

@@ -430,6 +430,41 @@ def game_model_from_arrays(
     return GameModel(models=models)
 
 
+def latest_trained_model(checkpointer: TrainingCheckpointer) -> "tuple[GameModel, int] | None":
+    """(current GameModel, step) from the newest intact checkpoint under
+    ``checkpointer`` — the warm-start re-entry hook for incremental
+    refresh (algorithm/refresh.py): a daily-refresh driver can resume
+    straight from PR 8 training checkpoints without a saved model
+    directory. Handles both checkpoint layouts that carry a full model:
+    CD-state checkpoints (``pack_cd_state`` — the "model/" prefix) and
+    incremental-refresh checkpoints (bare ``game_model_to_arrays``
+    layout). Returns None when the directory holds no loadable step;
+    raises ValueError for a checkpoint kind that carries no model (e.g. a
+    streaming solver-progress checkpoint) — the operator must point at the
+    training run's CD checkpoints instead."""
+    ckpt = checkpointer.restore()
+    if ckpt is None:
+        return None
+    if ckpt.meta.get("kind") == "incremental_refresh":
+        return (
+            game_model_from_arrays(ckpt.arrays, ckpt.meta["model"]),
+            ckpt.step,
+        )
+    if "model" in ckpt.meta and any(
+        k.startswith("model/") for k in ckpt.arrays
+    ):
+        model = game_model_from_arrays(
+            _strip_prefix(ckpt.arrays, "model/"), ckpt.meta["model"]
+        )
+        return model, ckpt.step
+    raise ValueError(
+        f"checkpoint step {ckpt.step} at {checkpointer.directory} carries "
+        f"no GAME model (kind={ckpt.meta.get('kind')!r}); point the "
+        "refresh at the training run's coordinate-descent checkpoint "
+        "directory or pass a saved model directory"
+    )
+
+
 def fingerprint_mismatch(saved: dict | None, expected: dict) -> str | None:
     """None when the fingerprints agree; otherwise a human-readable
     clause NAMING the differing fields with both sides' values — the one

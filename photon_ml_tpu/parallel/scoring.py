@@ -29,6 +29,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.data.game_data import GameDataset, pad_game_dataset
 from photon_ml_tpu.data.sparse_batch import SparseShard
+from photon_ml_tpu.io.checkpoint import (
+    fingerprint_mismatch as _fingerprint_mismatch,
+)
 from photon_ml_tpu.models.game import (
     FixedEffectModel,
     GameModel,
@@ -84,6 +87,45 @@ def _assembly_xp():
     zero-copy; a jnp intermediate would cost a D2H per array), jnp
     otherwise (device-resident inputs reshard on-device)."""
     return np if jax.process_count() > 1 else jnp
+
+
+def params_layout_fingerprint(model: GameModel) -> dict:
+    """Per-coordinate layout signature of a model's score-program params:
+    kind, shard/effect identity, and every param leaf's (shape, dtype).
+    Two models with EQUAL fingerprints produce pytrees of identical
+    structure and avals, so swapping one for the other re-uses every
+    compiled score program (zero recompiles — the DrJAX one-traced-program
+    argument, arXiv:2403.07128); a differing fingerprint is exactly a
+    layout change, and the serving swap guard rejects it naming these
+    fields."""
+    fp: dict = {"coordinates": ",".join(model.models)}
+
+    def leaf(arr) -> str:
+        a = np.asarray(arr) if not hasattr(arr, "shape") else arr
+        return f"{tuple(int(s) for s in a.shape)}:{a.dtype}"
+
+    for cid, m in model.models.items():
+        if isinstance(m, FixedEffectModel):
+            fp[f"{cid}/kind"] = "fe"
+            fp[f"{cid}/shard"] = m.feature_shard_id
+            fp[f"{cid}/w"] = leaf(m.glm.coefficients.means)
+        elif isinstance(m, RandomEffectModel):
+            fp[f"{cid}/kind"] = "re_compact" if m.is_compact else "re"
+            fp[f"{cid}/shard"] = m.feature_shard_id
+            fp[f"{cid}/re_type"] = m.random_effect_type
+            fp[f"{cid}/table"] = leaf(m.coefficients)
+            if m.is_compact:
+                fp[f"{cid}/active_cols"] = leaf(m.active_cols)
+        elif isinstance(m, MatrixFactorizationModel):
+            fp[f"{cid}/kind"] = "mf"
+            fp[f"{cid}/re_type"] = (
+                f"{m.row_effect_type}x{m.col_effect_type}"
+            )
+            fp[f"{cid}/rows"] = leaf(m.row_factors)
+            fp[f"{cid}/cols"] = leaf(m.col_factors)
+        else:
+            fp[f"{cid}/kind"] = type(m).__name__
+    return fp
 
 
 def _model_kinds(model: GameModel) -> dict[str, str]:
@@ -249,15 +291,17 @@ class DistributedScorer:
             data["coords"][cid] = c
         return data, layouts
 
-    def _build_params_host(self, xp, layouts):
+    def _build_params_host(self, xp, layouts, model: GameModel | None = None):
         """The MODEL side of the score program's inputs, buildable without
         any dataset: FE coefficient vectors, RE tables (full [E, d] or
         compact [E, K] + active columns), MF factors. ``layouts`` (from
         :meth:`_build_data_host`) only decides the compact-RE form — the
         dense-shard form carries active_cols on device, the sparse-entries
-        form resolves positions host-side."""
+        form resolves positions host-side. ``model`` overrides the resident
+        model for the hot-swap rebuild (:meth:`swap_model_params`), which
+        must build the NEW params before committing the reference."""
         params: dict = {}
-        for cid, m in self.model.models.items():
+        for cid, m in (model or self.model).models.items():
             kind = self._kinds[cid]
             if kind == "fe":
                 w = xp.asarray(m.glm.coefficients.means)
@@ -295,14 +339,19 @@ class DistributedScorer:
         the per-coordinate layout map (typically one entry for a model's
         whole service lifetime)."""
         key = tuple(sorted(layouts.items()))
-        cached = self._params_cache.get(key)
+        # capture the cache OBJECT: swap_model_params commits a new model
+        # by replacing the reference, so a miss that started building
+        # before a swap inserts into the SUPERSEDED dict (never read
+        # again) instead of poisoning the rebuilt cache with old params
+        cache = self._params_cache
+        cached = cache.get(key)
         if cached is None:
             params = self._build_params_host(
                 xp if xp is not None else _assembly_xp(), layouts
             )
             if self.mesh is not None:
                 params = self._place_params(params)
-            self._params_cache[key] = cached = params
+            cache[key] = cached = params
             # resident-params accounting (the HBM-forecast input of the
             # program ledger): total bytes across every cached layout's
             # placed params — metadata only, no device work
@@ -321,6 +370,59 @@ class DistributedScorer:
             int(self._params_cache_bytes)
         )
         return cached
+
+    def swap_model_params(self, new_model: GameModel) -> None:
+        """In-place model refresh: rebuild + re-place the layout-keyed
+        params cache for ``new_model`` and swap the references — the
+        zero-downtime half of incremental retraining (algorithm/refresh.py)
+        riding the separable-placement split: the DATA half of the score
+        program is untouched, the compiled programs key on shapes/dtypes
+        only, and an EQUAL layout fingerprint guarantees those are
+        unchanged, so a swap costs zero recompiles.
+
+        A layout-changing model is rejected (ValueError naming the
+        differing fields) BEFORE any state mutates; the rebuild happens
+        fully off to the side and commits by reference assignment, so
+        concurrent scoring threads see either the old or the new params,
+        never a mix."""
+        mismatch = _fingerprint_mismatch(
+            params_layout_fingerprint(new_model),
+            params_layout_fingerprint(self.model),
+        )
+        if mismatch is not None:
+            # the ONE guard site; serving wraps this as ModelSwapError and
+            # records the swap_rejected counter (serving/resident.py)
+            raise ValueError(
+                f"the new model's params layout {mismatch}; a "
+                "layout-changing refresh must re-place from scratch "
+                "(build a fresh scorer) instead of hot-swapping"
+            )
+        from photon_ml_tpu.telemetry import serving_counters
+
+        rebuilt: dict = {}
+        # snapshot the keys: a concurrently scoring thread may lazily
+        # insert a new layout into the live cache mid-rebuild (its
+        # old-model params are superseded by the commit below either way)
+        for key in list(self._params_cache):
+            params = self._build_params_host(
+                _assembly_xp(), dict(key), model=new_model
+            )
+            if self.mesh is not None:
+                params = self._place_params(params)
+            rebuilt[key] = params
+        # commit: plain reference assignments (atomic under the GIL)
+        self.model = new_model
+        self._params_cache = rebuilt
+        self._params_cache_bytes = sum(
+            leaf.nbytes
+            for entry in rebuilt.values()
+            for leaf in jax.tree_util.tree_leaves(entry)
+            if hasattr(leaf, "nbytes")
+        )
+        # the HBM-forecast input must not keep reporting the stale model
+        serving_counters.set_resident_params_bytes(
+            int(self._params_cache_bytes)
+        )
 
     def _place_data(self, data):
         from photon_ml_tpu.parallel.multihost import default_put

@@ -7,12 +7,14 @@ from photon_ml_tpu.serving.batching import (
 )
 from photon_ml_tpu.serving.resident import (
     DEFAULT_MICROBATCH_SHAPES,
+    ModelSwapError,
     ResidentScorer,
 )
 
 __all__ = [
     "DEFAULT_MICROBATCH_SHAPES",
     "MicroBatchServer",
+    "ModelSwapError",
     "RequestError",
     "ResidentScorer",
     "ServeError",

@@ -202,6 +202,19 @@ class MicroBatchServer:
     def __exit__(self, exc_type, exc, tb) -> None:
         self.stop()
 
+    def swap_model(self, new_model) -> None:
+        """Zero-downtime model refresh against a LIVE serving loop:
+        delegates to the scorer's guarded swap API
+        (``ResidentScorer.swap_model`` — the one sanctioned resident-param
+        mutation site, lint check 14) while the consumer thread keeps
+        draining the queue. A same-layout swap is a reference assignment
+        the consumer picks up at its next micro-batch (requests in flight
+        score under whichever model is current at dispatch — both versions'
+        scores are correct GAME scores); a layout-changing swap raises
+        typed (``ModelSwapError`` naming the differing leaves) and the loop
+        keeps serving the resident model."""
+        self.scorer.swap_model(new_model)
+
     # -- producer side -------------------------------------------------------
 
     def submit(self, dataset: GameDataset,

@@ -62,6 +62,14 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, int(n - 1).bit_length())
 
 
+class ModelSwapError(ValueError):
+    """A hot swap was rejected by the layout fingerprint guard: the new
+    model's params layout differs from the resident one's (the message
+    names the differing leaves). The resident model keeps serving — a
+    layout change needs a fresh scorer (and a warm-up), never an in-place
+    swap."""
+
+
 class ResidentScorer:
     """A GameModel resident on device behind a bounded set of compiled
     micro-batch score programs.
@@ -141,6 +149,9 @@ class ResidentScorer:
             donate_argnums=(0,) if self.donate else (),
         )
         self._bf16_params_cache: dict = {}
+        #: bumped by swap_model: bf16 cache keys carry it, so entries a
+        #: racing reader computes from a superseded model are never read
+        self._model_version = 0
         self._signatures: set = set()
 
     # -- program inputs ------------------------------------------------------
@@ -169,10 +180,15 @@ class ResidentScorer:
         return jax.tree_util.tree_map(cast, tree)
 
     def _params(self, layouts):
+        # version read BEFORE the params fetch: a swap committing in
+        # between bumps the version, so whatever this thread caches below
+        # lands under the superseded key and is never read again (the
+        # other order would cache OLD params under the NEW version)
+        version = self._model_version
         params = self._scorer.params_for_layouts(layouts)
         if not self.bf16:
             return params
-        key = tuple(sorted(layouts.items()))
+        key = (version, tuple(sorted(layouts.items())))
         cached = self._bf16_params_cache.get(key)
         if cached is None:
             cached = self._bf16_params_cache[key] = self._cast_bf16(params)
@@ -208,6 +224,52 @@ class ResidentScorer:
                 )
                 nnz_sig.append((cid, target))
         return data, tuple(nnz_sig)
+
+    # -- zero-downtime model refresh ----------------------------------------
+
+    def swap_model(self, new_model: GameModel) -> None:
+        """In-place hot swap to a refreshed model while requests keep
+        flowing — the serving half of incremental retraining
+        (algorithm/refresh.py). Params are jit ARGUMENTS keyed by layout,
+        so an EQUAL-layout swap re-uses every compiled score program
+        (``xla/serve/score`` compile delta == 0, ledger-pinned by
+        tests/test_serving.py); a layout-changing model raises
+        :class:`ModelSwapError` naming the differing leaves BEFORE any
+        state mutates, and the resident model keeps serving.
+
+        This method is the ONE sanctioned resident-param mutation site in
+        the serving package (dev/lint_parity.py check 14): the new params
+        are built and placed fully off to the side, then committed by
+        reference assignment (atomic under the GIL), so a concurrent
+        micro-batch scores either the old or the new model — never a mix.
+        """
+        try:
+            # the layout fingerprint guard lives in the ONE inner API
+            # (parallel/scoring.py swap_model_params): validate-then-
+            # commit, nothing mutates on rejection. It also rebuilds +
+            # re-places the layout-keyed params cache and re-feeds
+            # serve/resident_params_bytes (the HBM-forecast input).
+            self._scorer.swap_model_params(new_model)
+        except ValueError as e:
+            serving_counters.record_swap_rejected()
+            raise ModelSwapError(
+                f"model swap rejected: {e} — build a fresh ResidentScorer "
+                "(and warm it) for a layout-changing refresh"
+            ) from e
+        self.model = new_model
+        # version-keyed bf16 cache: a scorer thread racing the swap may
+        # still INSERT an entry computed from the old model after this
+        # reset — the version bump makes stale entries unreachable
+        # instead of served
+        self._model_version += 1
+        self._bf16_params_cache = {}
+        serving_counters.record_model_swap()
+        ledger = program_ledger.current_ledger()
+        if ledger is not None:
+            # no compile fires on an equal-layout swap, so the per-label
+            # HBM forecast must be re-fed by hand or it keeps pricing the
+            # stale model's resident bytes (ISSUE 13 accounting)
+            ledger.refeed_resident_forecast("serve/score")
 
     # -- scoring -------------------------------------------------------------
 

@@ -57,6 +57,7 @@ _UNIT_PATTERNS: tuple[tuple[str, str, type], ...] = (
     ("off_ms", rf"OFF{_NUM}", float),
     ("overlap", rf"ovl{_NUM}", float),
     ("unbatched_rate", rf"1/dsp sr {_NUM}", float),
+    ("full_ms", rf"fullsr {_NUM}", float),
     ("p95_ms", rf"p95 {_NUM}ms", float),
     ("cal_fraction", rf"{_NUM}xcal", float),
     # descriptive fields
@@ -94,6 +95,11 @@ def parse_unit(metric: str, unit: str) -> dict:
     if m:
         out["sweeps_ordered"] = int(m.group(1))
         out["sweeps_uniform"] = int(m.group(2))
+    # refresh evidence pair: ln<solved>/<total> RE lane-solves
+    m = re.search(r"\bln(\d+)/(\d+)", unit)
+    if m:
+        out["lanes_solved"] = int(m.group(1))
+        out["lanes_total"] = int(m.group(2))
     return out
 
 

@@ -435,6 +435,33 @@ class ProgramLedger:
         out["device_bytes_limit"] = probes.device_memory_limit_bytes()
         return out
 
+    def refeed_resident_forecast(self, label: str) -> int | None:
+        """Recompute ``xla/<label>/hbm_forecast_bytes`` from the CURRENT
+        resident placed-params bytes plus the label's recorded peak — the
+        hot-swap hook (serving/resident.py): a same-layout model swap
+        triggers no compile, so without this the forecast gauge would keep
+        pricing the STALE model's resident bytes. Returns the new forecast,
+        or None when either input is unknown (no memory analysis ran, or
+        nothing feeds the resident gauge); journals a
+        ``program_forecast_refeed`` row when it changes."""
+        peak = self.registry.gauge(self._metric(label, "peak_bytes")).value
+        if peak is None:
+            peak = self.registry.gauge(self._metric(label, "temp_bytes")).value
+        resident = self._resident_bytes()
+        if peak is None or resident is None:
+            return None
+        forecast = int(resident) + int(peak)
+        self.registry.gauge(
+            self._metric(label, "hbm_forecast_bytes")
+        ).set(forecast)
+        if self.journal is not None:
+            self.journal.record(
+                "program_forecast_refeed", label=label, phase=self.phase,
+                resident_bytes=int(resident), peak_bytes=int(peak),
+                hbm_forecast_bytes=forecast,
+            )
+        return forecast
+
     def _resident_bytes(self) -> int | None:
         """Resident placed-params bytes: the layout-keyed cache's gauge
         when someone feeds it (parallel/scoring.py), else the live

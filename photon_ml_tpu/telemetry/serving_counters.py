@@ -49,6 +49,12 @@ BUCKET_SPLITS = "serve/bucket_splits"
 #: (parallel/scoring.py params_for_layouts) — the resident half of the
 #: program ledger's HBM-overcommit forecast (telemetry/program_ledger.py)
 RESIDENT_PARAMS_BYTES = "serve/resident_params_bytes"
+#: in-place model refreshes accepted by the guarded swap API
+#: (serving/resident.py swap_model — zero recompiles on a same-layout swap)
+MODEL_SWAPS = "serve/model_swaps"
+#: swaps REJECTED typed by the layout fingerprint guard — the serving loop
+#: keeps running on the resident model after each one
+SWAP_REJECTED = "serve/swap_rejected"
 
 
 def reset_serving_metrics(registry=None) -> None:
@@ -99,6 +105,14 @@ def set_compiled_signatures(n: int) -> None:
 
 def set_resident_params_bytes(n: int) -> None:
     default_registry().gauge(RESIDENT_PARAMS_BYTES).set(int(n))
+
+
+def record_model_swap() -> None:
+    default_registry().counter(MODEL_SWAPS).inc()
+
+
+def record_swap_rejected() -> None:
+    default_registry().counter(SWAP_REJECTED).inc()
 
 
 def record_bucket_split(n: int = 1) -> None:

@@ -372,6 +372,32 @@ def _judge_serve(row: BenchRow, art: BenchArtifact) -> Verdict:
     )
 
 
+@rule("refresh_incremental", name="refresh-beats-full-retrain",
+      higher_better=False,
+      doc="incremental refresh ms must beat the same-run full retrain "
+          "embedded in the unit, with STRICTLY fewer RE lane-solves "
+          "(ln<solved>/<total> pair) — a refresh that re-solves every "
+          "lane saved nothing (ISSUE 14)")
+def _judge_refresh(row: BenchRow, art: BenchArtifact) -> Verdict:
+    u = row.parsed_unit
+    v = _same_run_lower(
+        row, art, u.get("full_ms"),
+        rule_name="refresh-beats-full-retrain",
+        baseline_label="full retrain",
+    )
+    solved, total = u.get("lanes_solved"), u.get("lanes_total")
+    if solved is not None and total is not None:
+        v = dataclasses.replace(v, detail=v.detail + f", ln{solved}/{total}")
+        if solved >= total and v.status in (WIN, FLAT):
+            return dataclasses.replace(
+                v, status=REGRESSION,
+                detail=v.detail + " — the refresh re-solved every RE lane: "
+                "the selection policy saved nothing (check "
+                "gradient_tolerance / the declared changed-entity set)",
+            )
+    return v
+
+
 # -- judging entry points ----------------------------------------------------
 
 

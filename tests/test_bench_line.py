@@ -32,6 +32,7 @@ EXPECTED_METRICS = [
     "stream_fe_chunked",
     "stream_game_duhl",
     "serve_microbatch",
+    "refresh_incremental",
 ]
 
 

@@ -679,3 +679,69 @@ def test_lint_clean_on_real_bench_and_verdicts():
     finally:
         sys.path.pop(0)
     assert lint_parity.check_bench_verdict_rules(REPO_ROOT) == []
+
+
+def test_lint_catches_resident_param_mutation_outside_swap(tmp_path):
+    """Check 14 fires: an assignment to a resident-param attribute
+    (.model, the params caches) anywhere in serving/ outside the
+    class-qualified swap allowlist is flagged; the sanctioned
+    ResidentScorer.__init__ / swap_model scopes pass, as do same-named
+    attributes outside serving/."""
+    sys.path.insert(0, str(REPO_ROOT / "dev"))
+    try:
+        import lint_parity
+    finally:
+        sys.path.pop(0)
+
+    serving = tmp_path / "photon_ml_tpu" / "serving"
+    serving.mkdir(parents=True)
+    (serving / "resident.py").write_text(
+        '"""No reference analogue."""\n'
+        "class ResidentScorer:\n"
+        "    def __init__(self, model):\n"
+        "        self.model = model\n"  # allowlisted
+        "        self._params_cache = {}\n"  # allowlisted
+        "    def swap_model(self, new_model):\n"
+        "        self.model = new_model\n"  # allowlisted
+        "        self._bf16_params_cache = {}\n"  # allowlisted
+        "    def sneaky(self, new_model):\n"
+        "        self.model = new_model\n"  # line 10: banned
+        "        self._params_cache = {}\n"  # line 11: banned
+        "    def tuple_sneak(self, m, k):\n"
+        "        self.model, self._kinds = m, k\n"  # line 13: banned x2
+        "class Other:\n"
+        "    def swap_model(self, m):\n"
+        "        # same method NAME, wrong class: still banned\n"
+        "        self.model = m\n"  # line 15: banned
+    )
+    (serving / "batching.py").write_text(
+        '"""No reference analogue."""\n'
+        "class MicroBatchServer:\n"
+        "    def __init__(self, scorer):\n"
+        "        self.scorer = scorer\n"  # not a resident-param attr
+        "    def hijack(self, m):\n"
+        "        self.scorer.model = m\n"  # line 6: banned
+    )
+    outside = tmp_path / "photon_ml_tpu" / "parallel"
+    outside.mkdir(parents=True)
+    (outside / "scoring.py").write_text(
+        '"""No reference analogue."""\n'
+        "class DistributedScorer:\n"
+        "    def swap_model_params(self, m):\n"
+        "        self.model = m\n"  # outside serving/: out of scope
+    )
+    problems = lint_parity.check_resident_param_mutations(tmp_path)
+    assert any("resident.py:10" in p and "check 14" in p
+               for p in problems), problems
+    assert any("resident.py:11" in p for p in problems)
+    # tuple unpacking must not slip the ban (both attrs flagged)
+    assert sum("resident.py:13" in p for p in problems) == 2, problems
+    assert any("resident.py:17" in p for p in problems)
+    assert any("batching.py:6" in p for p in problems)
+    assert not any("resident.py:4" in p or "resident.py:5" in p
+                   or "resident.py:7" in p or "resident.py:8" in p
+                   for p in problems)
+    assert not any("batching.py:4" in p for p in problems)
+    assert not any("scoring.py" in p for p in problems)
+    # the real serving package is clean under the real allowlist
+    assert lint_parity.check_resident_param_mutations(REPO_ROOT) == []

@@ -1821,3 +1821,164 @@ class TestJournalCrashDurability:
         # carries the stamped fields
         for r in records:
             assert {"kind", "seq", "ts", "elapsed_ms"} <= set(r)
+
+
+# ---------------------------------------------------------------------------
+# incremental refresh + zero-downtime swap (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def _refresh_fixture(rng, n_users=8, n_items=6, per_ent=6):
+    """Two-RE GAME fixture for mid-refresh preemption: the refresh walks
+    [fixed(carried), per-user, per-item], so a preemption after the first
+    RE update lands MID-refresh with a checkpoint behind it."""
+    from photon_ml_tpu.data.game_data import build_game_dataset
+
+    n = n_users * per_ent
+    users = np.repeat(np.arange(n_users), per_ent)
+    items = rng.integers(0, n_items, size=n)
+    xg = rng.normal(size=(n, 3))
+    xu = rng.normal(size=(n, 2))
+    xi = rng.normal(size=(n, 2))
+    wu = rng.normal(size=(n_users, 2))
+    wi = rng.normal(size=(n_items, 2))
+    noise = 0.05 * rng.normal(size=n)
+
+    def dataset(wu_tab, wi_tab):
+        y = (
+            xg @ np.array([1.0, -0.5, 0.25])
+            + np.einsum("nd,nd->n", xu, wu_tab[users])
+            + np.einsum("nd,nd->n", xi, wi_tab[items])
+            + noise
+        )
+        return build_game_dataset(
+            labels=y,
+            feature_shards={"global": xg, "per_user": xu, "per_item": xi},
+            entity_keys={"userId": users, "itemId": items},
+            dtype=np.float64,
+        )
+
+    wu2, wi2 = wu.copy(), wi.copy()
+    wu2[1] *= -2.0
+    wi2[2] *= -2.0
+    return dataset(wu, wi), dataset(wu2, wi2)
+
+
+def _refresh_estimator(ckpt=None, resume=True):
+    from photon_ml_tpu.algorithm.coordinates import (
+        CoordinateOptimizationConfig,
+    )
+    from photon_ml_tpu.estimators import (
+        FixedEffectCoordinateConfig,
+        GameEstimator,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig
+    from photon_ml_tpu.types import TaskType
+
+    opt = CoordinateOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=25), l2_weight=0.1
+    )
+    return GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig("global", opt),
+            "per-user": RandomEffectCoordinateConfig(
+                "userId", "per_user", opt
+            ),
+            "per-item": RandomEffectCoordinateConfig(
+                "itemId", "per_item", opt
+            ),
+        },
+        # enough sweeps that the resident model sits near the JOINT
+        # optimum — the gradient screen then sees only real change
+        num_iterations=4,
+        checkpointer=ckpt,
+        resume=resume,
+    )
+
+
+class TestRefreshChaos:
+    def test_preemption_mid_refresh_resumes_bitwise(self, rng, tmp_path):
+        """A pool preemption between the two RE coordinate updates
+        restarts via run_with_recovery; the resumed refresh fast-forwards
+        past the checkpointed coordinate and finishes BITWISE identical to
+        an uninterrupted refresh (lossless npz round-trip + deterministic
+        compacted solves)."""
+        from photon_ml_tpu.algorithm.coordinates import (
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.algorithm.refresh import RefreshPolicy
+        from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+
+        ds0, ds1 = _refresh_fixture(rng)
+        resident = _refresh_estimator().fit(ds0).model
+        policy = RefreshPolicy(gradient_tolerance=5e-2)
+        baseline = _refresh_estimator().refresh(ds1, resident, policy)
+        assert 0 < baseline.lanes_solved < baseline.lanes_total
+
+        restores0, retries0 = rc.checkpoint_restores(), rc.retries()
+        ck = TrainingCheckpointer(tmp_path / "refresh-ck")
+
+        def attempt(restart):
+            return _refresh_estimator().refresh(
+                ds1, resident, policy, checkpointer=ck
+            )
+
+        with faultinject.preempt_after_calls(
+            RandomEffectCoordinate, "update_model", 1
+        ):
+            result = run_with_recovery(
+                attempt,
+                max_restarts=2,
+                checkpointer=ck,
+                description="refresh chaos",
+            )
+        for cid in ("per-user", "per-item"):
+            np.testing.assert_array_equal(
+                np.asarray(result.model.models[cid].coefficients),
+                np.asarray(baseline.model.models[cid].coefficients),
+            )
+        np.testing.assert_array_equal(
+            np.asarray(result.model.models["fixed"].glm.coefficients.means),
+            np.asarray(baseline.model.models["fixed"].glm.coefficients.means),
+        )
+        assert result.lanes_solved == baseline.lanes_solved
+        assert rc.checkpoint_restores() - restores0 >= 1
+        assert rc.retries() - retries0 >= 1
+
+    def test_layout_changing_swap_live_server_keeps_serving(self, rng):
+        """A layout-changing swap against a LIVE MicroBatchServer is
+        rejected typed (the differing leaves named) and the loop keeps
+        serving afterwards — counter-asserted on both sides."""
+        from photon_ml_tpu.data.game_data import slice_game_dataset
+        from photon_ml_tpu.serving import (
+            MicroBatchServer,
+            ModelSwapError,
+            ResidentScorer,
+        )
+        from photon_ml_tpu.telemetry import serving_counters
+        from photon_ml_tpu.telemetry.registry import default_registry
+
+        ds0, ds1 = _refresh_fixture(rng)
+        resident = _refresh_estimator().fit(ds0).model
+        # a layout-changing "refresh": drop a coordinate entirely
+        from photon_ml_tpu.models.game import GameModel
+
+        wrong = GameModel(models={
+            cid: m for cid, m in resident.models.items() if cid != "per-item"
+        })
+        serving_counters.reset_serving_metrics()
+        reg = default_registry()
+        scorer = ResidentScorer(resident, shapes=(16,))
+        with MicroBatchServer(scorer, max_wait_ms=5) as server:
+            before = server.submit(slice_game_dataset(ds0, 0, 4)).result(30)
+            with pytest.raises(ModelSwapError, match="per-item"):
+                server.swap_model(wrong)
+            # the loop is still serving the resident model, bitwise
+            after = server.submit(slice_game_dataset(ds0, 0, 4)).result(30)
+        np.testing.assert_array_equal(before, after)
+        assert reg.counter(serving_counters.SWAP_REJECTED).value == 1
+        assert reg.counter(serving_counters.MODEL_SWAPS).value == 0
+        assert reg.counter(serving_counters.REQUESTS).value == 2
+        assert reg.counter(serving_counters.REQUEST_FAILURES).value == 0
