@@ -23,7 +23,11 @@ sidecar, JSONL run journals (``run-journal.jsonl`` and friends — with
   exact signature leaves that changed), plus heartbeat staleness and
   hbm/compile drift so a wedged run is distinguishable from a slow one;
 - the straggler table from the per-rank trace files (dev/trace_summary.py
-  machinery — online and offline reports share one implementation).
+  machinery — online and offline reports share one implementation);
+- the cross-rank coordinated-recovery table (ISSUE 15): per-rank
+  restarts/aborts/generations merged over EVERY rank's journal, the
+  restart-storm pathology naming a flapping culprit rank, and (with
+  ``--live``) the last abort marker seen.
 
 Exit status: nonzero iff the CURRENT round (the sidecar when present, else
 the highest BENCH round) contains a row that LOST its registered win
@@ -75,8 +79,8 @@ def _find_journals(directory: str, live: bool) -> list[str]:
     return paths
 
 
-def _journal_section(path: str, live: bool) -> tuple[list, list[str]]:
-    """(findings, report lines) for one journal file."""
+def _journal_section(path: str, live: bool) -> tuple[list, list[str], list]:
+    """(findings, report lines, parsed records) for one journal file."""
     records = read_journal(path, tolerant=True)
     lines = [f"-- {os.path.basename(path)}: {len(records)} row(s)"]
     findings = verdicts.journal_findings(records)
@@ -107,7 +111,7 @@ def _journal_section(path: str, live: bool) -> tuple[list, list[str]]:
                 if drift:
                     lines.append(f"   heartbeat drift: {drift}")
     lines.extend(_ledger_table(records))
-    return findings, lines
+    return findings, lines, records
 
 
 def _heartbeat_drift(heartbeats: list) -> str:
@@ -275,19 +279,42 @@ def run_doctor(
         lines.append("(no BENCH_r*/MULTICHIP_r* artifacts or sidecar here)")
 
     journal_paths = _find_journals(directory, live)
+    merged_records: list = []
     if journal_paths:
         lines.append("")
         lines.append("== run journals ==")
         for path in journal_paths:
             try:
-                jf, jl = _journal_section(path, live)
+                jf, jl, records = _journal_section(path, live)
             except OSError as e:
                 lines.append(f"-- {path}: unreadable ({e})")
                 continue
+            merged_records.extend(records)
             findings.extend(jf)
             lines.extend(jl)
             for v in jf:
                 lines.append(v.line())
+
+    # coordinated recovery is a CROSS-journal story (ISSUE 15): the
+    # per-rank restart table and the restart-storm attribution only make
+    # sense over every rank's journal merged
+    coord = verdicts.coordination_findings(merged_records)
+    if coord:
+        lines.append("")
+        lines.append("== coordinated recovery ==")
+        findings.extend(coord)
+        for v in coord:
+            lines.append(v.line())
+    if live:
+        marker = verdicts.last_abort_marker(merged_records)
+        if marker is not None:
+            lines.append(
+                "   last abort marker: "
+                f"kind={marker.get('kind')} rank={marker.get('rank')} "
+                f"origin_rank={marker.get('origin_rank', marker.get('rank'))} "
+                f"generation={marker.get('generation')} "
+                f"cause={marker.get('origin_cause', marker.get('cause'))}"
+            )
 
     trace_lines = _trace_section(directory)
     if trace_lines:

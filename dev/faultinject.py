@@ -16,6 +16,13 @@ ISSUE 3 names — each maps to a recovery path the chaos tests
   files (exercises restore's newest-intact-step fallback).
 - :class:`WithholdingExchange` — a MetadataExchange wrapper whose rank
   never publishes selected tags (exercises ExchangeTimeout attribution).
+- :func:`die_at_barrier` / :class:`BarrierKiller` — a rank-targeted kill:
+  withhold the matching exchange op, then raise a classified-transient
+  preemption in THAT rank only (exercises peer-abort attribution +
+  coordinated rollback; ISSUE 15). ``times=None`` makes the rank FLAP
+  (dies every attempt — exercises shared-budget exhaustion).
+- :func:`abort_marker_corruptor` — garbles every abort marker a rank
+  posts (exercises the unattributed-but-bounded PeerAbort path).
 - :func:`poison_coordinate_updates` — NaN-poisons the first K model
   updates of one coordinate class (exercises DivergenceError +
   checkpoint-restore recovery).
@@ -247,6 +254,109 @@ class WithholdingExchange:
                 f"rank {self.rank} withheld barrier {tag!r}"
             )
         return self._inner.barrier(tag)
+
+
+# ---------------------------------------------------------------------------
+# Rank-targeted kills + abort-marker damage (ISSUE 15 coordinated recovery)
+# ---------------------------------------------------------------------------
+
+
+class BarrierKiller:
+    """Wraps a MetadataExchange: when THIS wrapper's rank is the targeted
+    rank and an exchange op's tag contains ``tag``, the op is WITHHELD
+    (never reaches the transport — the rank's key/barrier arrival simply
+    never happens) and ``exc_factory()`` is raised in that rank — the
+    withhold-then-raise-preemption shape of a pool reclaiming one worker
+    mid-protocol. Other ranks and other tags pass through untouched.
+
+    ``times=1`` (default) fires once, so the coordinated rollback's next
+    attempt heals — the resume-bitwise assertion is then meaningful.
+    ``times=None`` makes the rank FLAP (dies at the same tag every
+    attempt) — the shared-restart-budget exhaustion fixture.
+
+    The coordinator-facing surface (``set_generation`` / ``post_abort`` /
+    ``pending_abort`` / ``generation``) passes through, so a killed rank's
+    ``run_with_recovery(coordinator=...)`` path works unmodified.
+    """
+
+    def __init__(self, inner, tag: str, rank: int, *, times: "int | None" = 1,
+                 exc_factory: Callable[[], BaseException] = None):
+        self._inner = inner
+        self._tag = str(tag)
+        self._target = int(rank)
+        self._times = times
+        self._exc_factory = exc_factory or device_loss_error
+        self.state = {"fired": 0}
+
+    @property
+    def rank(self) -> int:
+        return self._inner.rank
+
+    @property
+    def num_ranks(self) -> int:
+        return self._inner.num_ranks
+
+    @property
+    def generation(self):
+        return self._inner.generation
+
+    def set_generation(self, generation: int) -> None:
+        self._inner.set_generation(generation)
+
+    def post_abort(self, info) -> None:
+        self._inner.post_abort(info)
+
+    def pending_abort(self):
+        return self._inner.pending_abort()
+
+    def _maybe_die(self, tag: str) -> None:
+        if (
+            self._inner.rank == self._target
+            and self._tag in tag
+            and (self._times is None or self.state["fired"] < self._times)
+        ):
+            self.state["fired"] += 1
+            raise self._exc_factory()
+
+    def allgather(self, tag: str, payload) -> list:
+        self._maybe_die(tag)
+        return self._inner.allgather(tag, payload)
+
+    def barrier(self, tag: str) -> None:
+        self._maybe_die(tag)
+        return self._inner.barrier(tag)
+
+
+def die_at_barrier(exchange, tag: str, rank: int, *,
+                   times: "int | None" = 1,
+                   exc_factory=None) -> BarrierKiller:
+    """Kill ``rank`` at its next exchange op whose tag contains ``tag``:
+    the op is withheld and a classified-transient preemption raised in
+    that rank only (see :class:`BarrierKiller`). Pass ``times=None`` for
+    a flapping rank."""
+    return BarrierKiller(exchange, tag, rank, times=times,
+                         exc_factory=exc_factory)
+
+
+@contextlib.contextmanager
+def abort_marker_corruptor(exchange):
+    """Patch ``exchange.post_abort`` so every marker this rank writes is
+    garbled bytes-of-a-string instead of the attributed dict — the torn-
+    write shape. Peers must STILL fail bounded and typed (a PeerAbort
+    with ``origin_rank=None`` naming the unparseable marker), never hang
+    out the deadline. Yields a counter dict (``posted``)."""
+    real = exchange.post_abort
+    state = {"posted": 0}
+
+    def corrupted(info):
+        state["posted"] += 1
+        real("\xff\x00 corrupt abort marker (injected)")
+
+    exchange.post_abort = corrupted
+    try:
+        yield state
+    finally:
+        exchange.post_abort = real
 
 
 # ---------------------------------------------------------------------------

@@ -365,6 +365,12 @@ BROAD_EXCEPT_ALLOWED = {
     (f"{PACKAGE}/util/timed.py", "__enter__"),
     (f"{PACKAGE}/util/events.py", "send"),
     (f"{PACKAGE}/cli/game_training_driver.py", "validate"),
+    # the serve driver's swap-poller daemon thread: a garbled published
+    # model dir can raise beyond the obvious types, the thread has no
+    # caller to re-raise to, and one bad publish must never stop all
+    # future refreshes — every failure is journaled as a typed
+    # `model_swap` rejection and classified for log severity
+    (f"{PACKAGE}/cli/serve_driver.py", "scan_once"),
     # the serving micro-batch loop: a batch-level scoring failure routes
     # through classify_exception and falls back to per-request isolation
     # (_isolate), where each request's own failure is classified and

@@ -1054,8 +1054,8 @@ class StreamingGameProgram:
             **sched,
         }
 
-    def _restore(self, checkpointer, fingerprint):
-        ckpt = checkpointer.restore()
+    def _restore(self, checkpointer, fingerprint, step=None):
+        ckpt = checkpointer.restore(step=step)
         if ckpt is None:
             return None
         if ckpt.meta.get("kind") != "game_streaming":
@@ -1095,6 +1095,7 @@ class StreamingGameProgram:
         checkpointer=None,
         checkpoint_every: int = 1,
         resume: bool = True,
+        resume_step: "int | None" = None,
         on_sweep=None,
     ) -> StreamingGameResult:
         """Run up to ``num_sweeps`` streamed CD sweeps.
@@ -1110,15 +1111,22 @@ class StreamingGameProgram:
         ``io.checkpoint.TrainingCheckpointer`` — sweep-granular commits
         through the exchange-consistent helper; a restored run recomputes
         its scores from the saved tables through the same jitted steps
-        that produced them and continues bitwise.
+        that produced them and continues bitwise. ``resume_step`` pins
+        the restore to ONE published step (ISSUE 15's coordinated
+        rollback; 0 = restart from scratch, None = newest intact).
         """
         if self.schedule is None:
             self.schedule = UniformChunkSchedule(self.source.num_chunks)
         fingerprint = self._fingerprint()
         start_sweep = 0
         losses: list[float] = []
+        if resume_step == 0:
+            resume = False
         if checkpointer is not None and resume and state is None:
-            restored = self._restore(checkpointer, fingerprint)
+            restored = self._restore(
+                checkpointer, fingerprint,
+                step=resume_step if resume_step else None,
+            )
             if restored is not None:
                 ckpt, state = restored
                 start_sweep = min(int(ckpt.step), num_sweeps)

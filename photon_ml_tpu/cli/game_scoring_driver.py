@@ -133,6 +133,22 @@ def run(
         from photon_ml_tpu.telemetry.tracing import Tracer, install_tracer
 
         tracer = install_tracer(Tracer())
+    exchange = None
+    coordinator = None
+    if partitioned:
+        from photon_ml_tpu.parallel.multihost import default_exchange
+        from photon_ml_tpu.resilience import CoordinatedRecovery
+
+        exchange = default_exchange()
+        # scoring has no restart loop, but the coordinator still buys
+        # ATTRIBUTION (ISSUE 15): the run's exchange is generation-fenced,
+        # and a rank dying of a classified-transient failure posts an
+        # abort marker below, so its peers fail fast with a PeerAbort
+        # naming it instead of burning the full exchange deadline
+        coordinator = CoordinatedRecovery(
+            exchange, max_restarts=0, journal=journal,
+            description="partitioned scoring",
+        )
     succeeded = False
     try:
         summary = _run_inner(
@@ -151,11 +167,18 @@ def run(
             partitioned=partitioned,
             on_corrupt=on_corrupt,
             journal=journal,
+            exchange=exchange,
         )
         succeeded = True
         if journal is not None:
             journal.record("scoring_summary", **summary)
         return summary
+    except Exception as e:  # attributed, then re-raised — never swallowed
+        from photon_ml_tpu.resilience import is_transient
+
+        if coordinator is not None and is_transient(e):
+            coordinator.post_abort(e)
+        raise
     finally:
         # traces flush FIRST (before the failure journal rows) so a dead
         # run still leaves a readable per-rank timeline; the straggler
@@ -170,10 +193,15 @@ def run(
 
             try:
                 # best-effort: a publication error never masks the run's
-                # own outcome or skips the journal rows below
+                # own outcome or skips the journal rows below. The run's
+                # (possibly fenced) exchange is reused so the merge rides
+                # the same key namespace as the run itself.
                 flush_trace_best_effort(
                     tracer, trace_dir,
-                    exchange=default_exchange() if succeeded else None,
+                    exchange=(
+                        (exchange or default_exchange()) if succeeded
+                        else None
+                    ),
                     gather=succeeded,
                     journal=journal,
                 )
@@ -292,6 +320,7 @@ def _run_inner(
     partitioned: bool,
     on_corrupt: str,
     journal=None,
+    exchange=None,
 ) -> dict:
     import jax
     if partitioned and evaluators:
@@ -307,7 +336,8 @@ def _run_inner(
     )
     if not paths:
         raise ValueError("input_data_path names no datasets")
-    exchange = default_exchange() if partitioned else None
+    if exchange is None:
+        exchange = default_exchange() if partitioned else None
     if not partitioned or jax.process_index() == 0:
         os.makedirs(output_dir, exist_ok=True)
     if exchange is not None:

@@ -693,6 +693,7 @@ def _run_inner(
     # trivial single-rank values unless --partitioned-io on a multi-process
     # run, so the single-process path reads byte-identically to before.
     exchange = None
+    coordinator = None
     pad_multiple = 1
     if params.partitioned_io and jax.process_count() > 1:
         from photon_ml_tpu.parallel.multihost import default_exchange
@@ -703,6 +704,22 @@ def _run_inner(
                 "partitioned blocks feed a mesh's addressable shards)"
             )
         exchange = default_exchange()
+        # coordinated multi-rank recovery (ISSUE 15): fence the run's ONE
+        # exchange into restart generations and attach the coordinator to
+        # every run_with_recovery below — a preempted rank then becomes an
+        # attributed all-rank rollback to the last barrier-committed
+        # checkpoint instead of a whole-job ExchangeTimeout death. The
+        # budget is SHARED across ranks AND grid configs (one job, one
+        # budget). Host-side KV only: no device collective is added,
+        # skipped, or reordered.
+        from photon_ml_tpu.resilience import CoordinatedRecovery
+
+        coordinator = CoordinatedRecovery(
+            exchange,
+            max_restarts=params.max_restarts,
+            journal=telemetry.journal if telemetry is not None else None,
+            description="partitioned game train",
+        )
         data_axis = int(mesh.shape["data"])
         if data_axis % exchange.num_ranks:
             raise ValueError(
@@ -844,7 +861,7 @@ def _run_inner(
         )
 
     def make_estimator(
-        reg_weights, checkpointer=None, resume=None
+        reg_weights, checkpointer=None, resume=None, resume_step=None
     ) -> GameEstimator:
         return GameEstimator(
             task=params.task_type,
@@ -860,6 +877,7 @@ def _run_inner(
             checkpointer=checkpointer,
             checkpoint_every=params.checkpoint_every,
             resume=params.resume if resume is None else resume,
+            resume_step=resume_step,
             mesh=mesh,
             fe_feature_sharded=model_axis > 1,
             telemetry=telemetry,
@@ -929,6 +947,12 @@ def _run_inner(
                     # restarts must resume even under --no-resume (the
                     # whole point of the restart is the checkpoint)
                     resume=params.resume or restart > 0,
+                    # a coordinated restart restores the PUBLISHED step on
+                    # every rank, never each rank's own local newest
+                    resume_step=(
+                        coordinator.resume_step
+                        if coordinator is not None else None
+                    ),
                 )
                 return est.fit(
                     train.dataset,
@@ -938,12 +962,19 @@ def _run_inner(
                     initial_model=_init,
                 )
 
+            if coordinator is not None:
+                # the rollback step is resolved against THIS config's
+                # checkpoint directory (per-config dirs are content-keyed);
+                # rebind also clears any resume step published for the
+                # PREVIOUS config's rollback
+                coordinator.rebind(ckpt)
             result = run_with_recovery(
                 attempt,
                 max_restarts=params.max_restarts,
                 checkpointer=ckpt,
                 journal=telemetry.journal if telemetry is not None else None,
                 description=f"train config {i}",
+                coordinator=coordinator,
             )
         # warm start the next grid point (reference GameEstimator.fit:352-366)
         warm_model = result.model

@@ -306,6 +306,14 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
         checkpointer = SolverCheckpointer(
             params.checkpoint_dir, save_every=params.checkpoint_every
         )
+    # NO coordinator here (ISSUE 15): coordinated recovery requires the
+    # run's hot path to ride a fenced MetadataExchange — the GLM streaming
+    # path performs no exchange ops, so peers would never observe an abort
+    # marker and a rank-local transient failure (which the detached
+    # restart below genuinely recovers) would instead deadline out at the
+    # restart rendezvous and kill the job. Attach one when a multi-rank
+    # streamed-GLM surface (exchange-coordinated) lands.
+    coordinator = None
     # span tracing is opt-in via --trace-dir; installed IMMEDIATELY before
     # the try whose finally uninstalls it (an exception in between would
     # leak the process-global tracer into the next run), early enough that
@@ -325,6 +333,7 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
                 checkpointer=checkpointer,
                 journal=journal,
                 description="glm training",
+                coordinator=coordinator,
             )
         events.send(TrainingFinishEvent(job_name="glm-training", succeeded=True))
         return result

@@ -22,6 +22,7 @@ import argparse
 import json
 import logging
 import os
+import threading
 import time
 from typing import Sequence
 
@@ -33,6 +34,102 @@ from photon_ml_tpu.util import Timed
 logger = logging.getLogger(__name__)
 
 DEFAULT_SHAPES = "64,256,1024"
+
+
+class _SwapPoller(threading.Thread):
+    """Continuous zero-downtime refresh (ROADMAP item 2 rider): watch a
+    directory for ATOMICALLY-RENAMED model subdirectories and hot-swap
+    each through the guarded ``MicroBatchServer.swap_model`` API while the
+    serving loop keeps draining. Appearance == completeness (publishers
+    stage under a ``tmp.*``/dot-prefixed sibling and ``os.rename`` into
+    place — the checkpoint discipline), so a half-written model is never
+    loaded. A rejected swap (``ModelSwapError``: layout change) or an
+    unloadable dir journals a typed ``model_swap`` row and serving
+    CONTINUES on the resident model — one bad publish never takes the
+    service down."""
+
+    def __init__(self, server, watch_dir: str, poll_s: float, *,
+                 index_maps, compact_threshold: int, journal=None):
+        super().__init__(name="serve-swap-poller", daemon=True)
+        self._server = server
+        self._watch_dir = watch_dir
+        self._poll_s = max(poll_s, 1e-3)
+        self._index_maps = index_maps
+        self._compact_threshold = compact_threshold
+        self._journal = journal
+        self._stop_event = threading.Event()
+        self._seen: set[str] = set()
+        self.polls = 0
+        self.applied: list[str] = []
+        self.rejected: list[dict] = []
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        self.join(timeout=10.0)
+
+    def run(self) -> None:
+        while not self._stop_event.is_set():
+            self.scan_once()
+            self._stop_event.wait(self._poll_s)
+        # one final scan so a model published just before the replay
+        # drained is not silently skipped
+        self.scan_once()
+
+    def scan_once(self) -> None:
+        from photon_ml_tpu.io.model_io import load_game_model
+        from photon_ml_tpu.serving import ModelSwapError
+
+        self.polls += 1
+        try:
+            names = sorted(os.listdir(self._watch_dir))
+        except OSError:
+            return  # the watch dir may not exist yet — keep serving
+        for name in names:
+            # staged (not yet renamed) publishes are invisible by contract
+            if name in self._seen or name.startswith((".", "tmp.")):
+                continue
+            path = os.path.join(self._watch_dir, name)
+            if not os.path.isdir(path):
+                continue
+            self._seen.add(name)
+            try:
+                model = load_game_model(
+                    path, self._index_maps,
+                    compact_random_effect_threshold=self._compact_threshold,
+                )
+                self._server.swap_model(model)
+            except Exception as e:  # noqa: BLE001 — thread boundary (below)
+                # a bad PUBLISH must never take the poller (and with it,
+                # every future refresh) down: a garbled model dir can
+                # raise beyond the obvious types (struct/zlib/EOF damage
+                # inside an intact-looking dir), and this daemon thread
+                # has no caller to re-raise to — so this is a reviewed
+                # host-boundary catch (lint check 5 allowlist): every
+                # failure is journaled typed and serving continues on the
+                # resident model. A FATAL classification (programming
+                # error) is additionally logged loudly with the class
+                # named, so a systematic bug is not mistaken for bad
+                # publishes.
+                from photon_ml_tpu.resilience import is_transient
+
+                self.rejected.append({"dir": name, "error": repr(e)})
+                log = (
+                    logger.warning
+                    if isinstance(e, (ModelSwapError, OSError, ValueError,
+                                      KeyError)) or is_transient(e)
+                    else logger.error
+                )
+                log("rejected hot swap of %s: %r", path, e)
+                if self._journal is not None:
+                    self._journal.record(
+                        "model_swap", dir=name, applied=False,
+                        error=repr(e),
+                    )
+                continue
+            self.applied.append(name)
+            logger.info("hot-swapped model from %s", path)
+            if self._journal is not None:
+                self._journal.record("model_swap", dir=name, applied=True)
 
 
 def _parse_shapes(spec: str) -> tuple[int, ...]:
@@ -63,6 +160,7 @@ def run(
     skip_unbatched_baseline: bool = False,
     swap_model_dir: str | None = None,
     swap_at_request: int | None = None,
+    swap_poll_ms: float = 0.0,
     telemetry_dir: str | None = None,
     trace_dir: str | None = None,
 ) -> dict:
@@ -85,6 +183,16 @@ def run(
     0 on a same-layout model). swap_at_request: the submit index the swap
     fires before (default: halfway).
 
+    swap_poll_ms > 0 switches ``swap_model_dir`` into CONTINUOUS mode
+    (ROADMAP item 2 rider): the directory is WATCHED — every
+    atomically-renamed model subdirectory that appears during the replay
+    is loaded and hot-swapped in arrival order through the same guarded
+    ``swap_model`` API (appearance == completeness: publishers must write
+    to a ``tmp.*``/dot-prefixed sibling and ``os.rename`` into place, the
+    checkpoint discipline). A rejected swap (layout change) journals a
+    typed ``model_swap`` row and the loop KEEPS SERVING the resident
+    model; the summary's ``swap`` block carries applied/rejected counts.
+
     telemetry_dir: rank-0 JSONL run journal (serve/* counters + latency
     histogram + phase timings) — written on the FAILURE path too.
     trace_dir: per-rank Chrome-trace span timelines; ``serve/`` spans
@@ -97,6 +205,15 @@ def run(
     from photon_ml_tpu.telemetry.serving_counters import reset_serving_metrics
     from photon_ml_tpu.util.timed import reset_timings, timing_summary
 
+    # knowable before any load/warm work is paid: the two swap modes take
+    # mutually exclusive knobs
+    if swap_poll_ms > 0 and swap_at_request is not None:
+        raise ValueError(
+            "--swap-at-request names a submit index for the ONE-SHOT "
+            "rehearsal swap, but --swap-poll-ms selects continuous mode, "
+            "where swaps fire when a model dir APPEARS in "
+            "--swap-model-dir; drop one of the two flags"
+        )
     reset_timings()
     reset_resilience_metrics()
     reset_serving_metrics()
@@ -137,6 +254,8 @@ def run(
             skip_unbatched_baseline=skip_unbatched_baseline,
             swap_model_dir=swap_model_dir,
             swap_at_request=swap_at_request,
+            swap_poll_ms=swap_poll_ms,
+            journal=journal,
         )
         succeeded = True
         if journal is not None:
@@ -191,6 +310,8 @@ def _run_inner(
     skip_unbatched_baseline: bool,
     swap_model_dir: str | None = None,
     swap_at_request: int | None = None,
+    swap_poll_ms: float = 0.0,
+    journal=None,
 ) -> dict:
     import jax
 
@@ -266,7 +387,7 @@ def _run_inner(
         scorer.warm(requests[0])
 
     swap_model = None
-    if swap_model_dir:
+    if swap_model_dir and swap_poll_ms <= 0:
         from photon_ml_tpu.io.model_io import load_game_model
 
         with Timed("load swap model"):
@@ -329,31 +450,57 @@ def _run_inner(
             max_wait_ms=max_wait_ms,
             queue_depth=queue_depth,
         )
+        poller = None
+        if swap_model_dir and swap_poll_ms > 0:
+            poller = _SwapPoller(
+                server, swap_model_dir, swap_poll_ms / 1e3,
+                index_maps=index_maps or None,
+                compact_threshold=compact_random_effect_threshold,
+                journal=journal,
+            )
         t0 = time.perf_counter()
         with server:
-            futures = []
-            for i, r in enumerate(requests):
-                if swap_model is not None and i == swap_at_request:
-                    # the zero-downtime seam: swap IN-PLACE while the
-                    # consumer keeps draining; a same-layout swap must
-                    # compile nothing (the ledger delta below proves it)
-                    pre = (
-                        ledger.snapshot()
-                        .get("serve/score", {}).get("compiles", 0)
-                        if ledger is not None else None
-                    )
-                    server.swap_model(swap_model)
-                    swap_info = {
-                        "performed": True,
-                        "at_request": i,
-                        "_compiles_before": pre,
-                    }
-                futures.append(server.submit(r))
-            for f in futures:
-                f.result()
+            if poller is not None:
+                poller.start()
+            try:
+                futures = []
+                for i, r in enumerate(requests):
+                    if swap_model is not None and i == swap_at_request:
+                        # the zero-downtime seam: swap IN-PLACE while the
+                        # consumer keeps draining; a same-layout swap must
+                        # compile nothing (the ledger delta below proves it)
+                        pre = (
+                            ledger.snapshot()
+                            .get("serve/score", {}).get("compiles", 0)
+                            if ledger is not None else None
+                        )
+                        server.swap_model(swap_model)
+                        swap_info = {
+                            "performed": True,
+                            "at_request": i,
+                            "_compiles_before": pre,
+                        }
+                    futures.append(server.submit(r))
+                for f in futures:
+                    f.result()
+            finally:
+                if poller is not None:
+                    # stop INSIDE the server context — the final scan's
+                    # swap still targets a live loop — and on the failure
+                    # path too, so the thread never outlives the server
+                    # or writes to a finalized journal
+                    poller.stop()
         batched_sec = time.perf_counter() - t0
     batched_rate = total_rows / max(batched_sec, 1e-9)
-    if swap_info is not None:
+    if poller is not None:
+        swap_info = {
+            "mode": "poll",
+            "poll_ms": swap_poll_ms,
+            "polls": poller.polls,
+            "applied": list(poller.applied),
+            "rejected": list(poller.rejected),
+        }
+    if swap_info is not None and "mode" not in swap_info:
         pre = swap_info.pop("_compiles_before")
         swap_info["score_compiles_after_swap"] = (
             None if pre is None else
@@ -431,6 +578,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--swap-at-request", type=int, default=None,
                    help="submit index the swap fires before (default: "
                         "halfway through the replay)")
+    p.add_argument("--swap-poll-ms", type=float, default=0.0,
+                   help="poll --swap-model-dir every this many ms for "
+                        "atomically-renamed model subdirectories and "
+                        "hot-swap each continuously through the guarded "
+                        "swap API (rejected swaps journal typed and keep "
+                        "serving); 0 = the one rehearsed mid-replay swap")
     p.add_argument("--telemetry-dir",
                    help="write a rank-0 JSONL run journal (serve/* "
                         "counters, latency histogram, phase timings) here "
@@ -467,6 +620,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
         skip_unbatched_baseline=args.skip_unbatched_baseline,
         swap_model_dir=args.swap_model_dir,
         swap_at_request=args.swap_at_request,
+        swap_poll_ms=args.swap_poll_ms,
         telemetry_dir=args.telemetry_dir,
         trace_dir=args.trace_dir,
     )

@@ -175,6 +175,11 @@ class GameEstimator:
     checkpoint_every: int = 1
     #: set False to ignore an existing checkpoint directory (fresh fit)
     resume: bool = True
+    #: pin the partitioned restore to ONE published checkpoint step
+    #: (ISSUE 15 coordinated rollback: every rank must restore the step
+    #: rank 0 resolved, never its own local newest; 0 = from scratch).
+    #: None keeps the newest-intact-step behavior.
+    resume_step: int | None = None
     #: raise DivergenceError on non-finite coordinate updates
     check_finite: bool = True
     #: jax.sharding.Mesh ("data", "model") — when set, fit() trains through
@@ -842,6 +847,7 @@ class GameEstimator:
                 checkpointer=self.checkpointer,
                 checkpoint_every=self.checkpoint_every,
                 resume=self.resume,
+                resume_step=self.resume_step,
                 # the ingest exchange also gates the checkpoint commit
                 # barriers (exchange-consistent: a checkpoint exists only
                 # for sweeps every rank completed)

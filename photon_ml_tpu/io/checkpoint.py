@@ -138,6 +138,17 @@ class TrainingCheckpointer:
         steps = self.steps()
         return steps[-1] if steps else None
 
+    def newest_loadable_step(self) -> int | None:
+        """Newest step that passes the cheap integrity probe — what
+        coordinated rollback (resilience/coordinated.py) resolves on rank
+        0 and publishes to every rank: barrier-committed saves are the
+        only writers here, so the newest INTACT step is by construction a
+        step every rank completed. None when no step would load."""
+        for step in reversed(self.steps()):
+            if self._loadable(step):
+                return step
+        return None
+
     #: everything a truncated/garbled step file can raise during load:
     #: zip directory damage (BadZipFile), npz entry damage (zlib via
     #: ValueError/OSError), meta damage (JSONDecodeError is a ValueError)
@@ -552,6 +563,11 @@ class SolverCheckpointer:
         """Duck-compatible with TrainingCheckpointer for
         resilience.recovery.run_with_recovery's has-a-checkpoint test."""
         return self._inner.latest_step()
+
+    def newest_loadable_step(self) -> int | None:
+        """Duck-compatible with TrainingCheckpointer for coordinated
+        rollback's rank-0 step resolution."""
+        return self._inner.newest_loadable_step()
 
     def save_progress(
         self,

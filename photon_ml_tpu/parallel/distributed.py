@@ -2517,6 +2517,7 @@ def train_partitioned(
     checkpoint_every: int = 1,
     resume: bool = True,
     exchange=None,
+    resume_step: "int | None" = None,
 ) -> DistributedTrainResult:
     """``train_distributed`` over partitioned ingest blocks: each rank
     contributes only its local slice of the data/bucket arrays (every rank
@@ -2545,10 +2546,19 @@ def train_partitioned(
     silently training restored rows against a re-mapped block. An
     explicitly-passed ``state`` (warm start) takes precedence over resume,
     as in ``train_distributed``. ``checkpointer=None`` is bitwise the
-    un-checkpointed path."""
+    un-checkpointed path.
+
+    resume_step: pin the restore to ONE published step (ISSUE 15's
+    coordinated rollback: every rank must restore the step rank 0
+    resolved and published, never its own local newest) — a missing pinned
+    step fails fast instead of silently resolving to a different one; 0
+    means "restart from scratch" (the rollback found no checkpoint).
+    None (default) keeps the newest-intact-step behavior."""
     fingerprint = None
     start_sweep = 0
     prior_losses: list[float] = []
+    if resume_step == 0:
+        resume = False
     if checkpointer is not None:
         freezing = sorted(
             k for k, sch in (schedulers or {}).items()
@@ -2568,7 +2578,9 @@ def train_partitioned(
             )
         fingerprint = _partition_fingerprint(program, parts, num_ranks)
         if resume and state is None:
-            ckpt = checkpointer.restore()
+            ckpt = checkpointer.restore(
+                step=resume_step if resume_step else None
+            )
             if ckpt is not None:
                 from photon_ml_tpu.io.checkpoint import fingerprint_mismatch
 

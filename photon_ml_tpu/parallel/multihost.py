@@ -216,10 +216,38 @@ class MetadataExchange:
     unbounded hang (ISSUE 3). Retry does NOT belong here: re-waiting one
     rank's exchange while the others do not desynchronizes the SPMD call
     sequence (resilience/policy.py module doc).
+
+    GENERATION FENCING (ISSUE 15, used by resilience/coordinated.py):
+    ``set_generation(g)`` moves every subsequent key/barrier id into a
+    generation-``g`` namespace AND resets the per-instance call sequence,
+    so a restarted attempt (whose ranks died at different points of the
+    SPMD sequence, leaving their counters desynchronized) resynchronizes
+    at seq 0 of the new generation — and a dead attempt's stale keys,
+    living in the old generation's namespace, can never satisfy a new
+    generation's get. ``generation=None`` (the default) is the legacy
+    unfenced keyspace, byte-identical to pre-ISSUE-15 behavior.
+
+    ABORT MARKERS: ``post_abort(info)`` best-effort-publishes a rank- and
+    cause-attributed marker for the CURRENT generation; a fenced wait that
+    observes a peer's marker raises a typed
+    ``resilience.errors.PeerAbort`` naming the culprit instead of burning
+    the full deadline. Markers are written ONLY on the failure path and
+    checked only inside waits that are already blocked — a healthy run
+    performs ZERO additional exchange operations.
     """
 
     rank: int = 0
     num_ranks: int = 1
+    #: current fence generation (None = unfenced legacy keyspace)
+    generation: "int | None" = None
+    #: fence EPOCH: distinguishes successive fencing sessions over one
+    #: transport (e.g. a driver ``run()`` called twice in one process, each
+    #: attaching its own coordinator) — a new session's generation-0 keys
+    #: must never collide with a previous session's. Incremented whenever a
+    #: NEW fence starts (first ``set_generation``, or a non-increasing
+    #: generation); SPMD-consistent because every rank fences at the same
+    #: logical points.
+    fence_epoch: int = 0
 
     def allgather(self, tag: str, payload) -> list:
         """All ranks' ``payload``s (JSON-able), ordered by rank."""
@@ -228,6 +256,55 @@ class MetadataExchange:
     def barrier(self, tag: str) -> None:
         """Block until every rank reaches this barrier."""
         raise NotImplementedError
+
+    def set_generation(self, generation: int) -> None:
+        """Adopt the generation-``generation`` key namespace and reset the
+        per-instance call sequence (every rank calls at the same logical
+        point — the coordinator's restart rendezvous — so sequences agree
+        again even after a mid-sequence death). A non-increasing generation
+        starts a new fence EPOCH (see ``fence_epoch``)."""
+        generation = int(generation)
+        if self.generation is None or generation <= self.generation:
+            self.fence_epoch += 1
+        self.generation = generation
+
+    def post_abort(self, info: dict) -> None:
+        """Best-effort: publish an abort marker for the current generation
+        (``info`` carries at least ``rank`` and ``cause``). Default: no-op
+        (no peers to warn)."""
+
+    def pending_abort(self) -> "dict | None":
+        """A PEER's abort marker for the current generation, or None.
+        Markers this rank posted itself are never returned (the culprit is
+        already restarting; it must not abort on its own marker)."""
+        return None
+
+    def _shape_marker(self, marker) -> "dict | None":
+        """Normalize a raw abort marker for ``pending_abort``: a corrupt
+        (non-dict) payload still ends the wait typed and bounded — just
+        unattributed (dev/faultinject.abort_marker_corruptor pins this);
+        this rank's own marker is invisible."""
+        if marker is None:
+            return None
+        if not isinstance(marker, dict):
+            return {"rank": None, "cause": f"unparseable marker {marker!r}"}
+        if marker.get("rank") == self.rank:
+            return None
+        return marker
+
+    def _raise_abort(self, tag: str, marker: dict):
+        """Raise the typed, culprit-attributed PeerAbort for ``marker``
+        (one construction site for every transport)."""
+        from photon_ml_tpu.resilience.errors import PeerAbort
+
+        origin = marker.get("rank")
+        raise PeerAbort(
+            tag,
+            origin_rank=None if origin is None else int(origin),
+            cause=str(marker.get("cause", "")),
+            generation=self.generation,
+            rank=self.rank,
+        )
 
 
 class SingleProcessExchange(MetadataExchange):
@@ -272,22 +349,60 @@ class InProcessExchange(MetadataExchange):
         return [cls(store, r, num_ranks, timeout=timeout)
                 for r in range(num_ranks)]
 
+    def set_generation(self, generation: int) -> None:
+        super().set_generation(generation)
+        # resync: every rank adopts the new namespace at the same logical
+        # point (the coordinator's restart rendezvous), so resetting the
+        # per-instance counter re-agrees the sequences even though the
+        # ranks died at different points of the old one
+        self._seq = 0
+
+    def post_abort(self, info: dict) -> None:
+        cond = self._store["cond"]
+        with cond:
+            # first writer wins per (epoch, generation): the marker
+            # attributes the FIRST failure; a second rank failing in the
+            # same window is a casualty, not a new culprit
+            self._store.setdefault("aborts", {}).setdefault(
+                (self.fence_epoch, self.generation),
+                dict(info) if isinstance(info, dict) else info,
+            )
+            # wake every rank blocked in a wait_for — their predicates
+            # consult pending_abort() below
+            cond.notify_all()
+
+    def pending_abort(self) -> "dict | None":
+        return self._shape_marker(
+            self._store.get("aborts", {}).get(
+                (self.fence_epoch, self.generation)
+            )
+        )
+
     def allgather(self, tag: str, payload) -> list:
         from photon_ml_tpu.resilience.errors import ExchangeTimeout
 
-        key = (self._seq, tag)
+        key = (self.fence_epoch, self.generation, self._seq, tag)
         self._seq += 1
         cond, slot = self._store["cond"], self._store["gather"]
         # the span OBSERVES the blocking wait (tag + seq + rank for the
         # straggler tables); it never gates or reorders the exchange
         with tracing.span("exchange/allgather", cat=tracing.EXCHANGE_CAT,
-                          tag=tag, seq=key[0], rank=self.rank), cond:
+                          tag=tag, seq=key[2], rank=self.rank), cond:
             entry = slot.setdefault(key, {})
             entry[self.rank] = payload
             cond.notify_all()
-            cond.wait_for(lambda: len(slot[key]) == self.num_ranks,
-                          timeout=self.timeout)
+            cond.wait_for(
+                lambda: len(slot[key]) == self.num_ranks
+                or self.pending_abort() is not None,
+                timeout=self.timeout,
+            )
             if len(slot[key]) != self.num_ranks:
+                marker = self.pending_abort()
+                if marker is not None:
+                    # a peer declared the attempt dead: fail fast
+                    # attributed instead of burning the rest of the
+                    # deadline on a rank that is already restarting
+                    self._raise_abort(tag, marker)
                 missing = [r for r in range(self.num_ranks)
                            if r not in slot[key]]
                 raise ExchangeTimeout(
@@ -368,9 +483,91 @@ class DistributedKVExchange(MetadataExchange):
 
             retry = default_kv_policy()
         self._retry = retry
+        #: per-instance sequence, used only in FENCED mode (generation set):
+        #: within a generation every rank makes the same call sequence from
+        #: the same reset point, so instance counters agree — and the
+        #: (session nonce, generation) prefix keeps a restarted attempt —
+        #: or a whole later fencing session — out of any dead keyspace.
+        #: Unfenced mode keeps the process-global ``_kv_seq`` (two exchange
+        #: instances in one process must not collide); fenced sessions get
+        #: the same guarantee from the ``_fence_nonce`` drawn off that
+        #: counter at fence time. ONE active fenced exchange per process,
+        #: which the coordinator owns.
+        self._gen_seq = 0
+        self._fence_nonce = 0
+
+    #: slice width for fenced blocking waits: between slices the wait
+    #: checks the generation's abort key, so a peer's abort surfaces in
+    #: ~this long instead of the full deadline. Only expired slices pay
+    #: the extra read — a healthy (promptly-published) exchange performs
+    #: zero additional operations.
+    ABORT_POLL_MS = 500
+
+    def set_generation(self, generation: int) -> None:
+        new_fence = self.generation is None or int(
+            generation
+        ) <= self.generation
+        super().set_generation(generation)
+        if new_fence:
+            # the coordination-service namespace is PROCESS-wide and its
+            # barrier ids are single-use, so a second fencing session in
+            # one process (driver run() called twice) must not reuse the
+            # first session's e/g keyspace: draw the session nonce from
+            # the process-global counter. SPMD-consistent — every rank
+            # fences at the same logical point, so the draws agree.
+            self._fence_nonce = _kv_seq()
+        self._gen_seq = 0
+
+    def _namespace(self) -> str:
+        return f"e{self._fence_nonce}g{self.generation}"
+
+    def _abort_key(self) -> str:
+        return f"photon/abort/{self._namespace()}"
+
+    def post_abort(self, info: dict) -> None:
+        try:
+            self._client.key_value_set(self._abort_key(), json.dumps(info))
+        except RuntimeError as e:
+            if "already_exists" in str(e).lower().replace(" ", "_"):
+                return  # first writer wins per generation
+            # best-effort by contract: the culprit is restarting either
+            # way; peers fall back to their deadline (ExchangeTimeout)
+            logger.warning("abort-marker write failed: %s", e)
+
+    def pending_abort(self) -> "dict | None":
+        if self.generation is None:
+            return None
+        try_get = getattr(self._client, "key_value_try_get", None)
+        try:
+            if try_get is not None:
+                raw = try_get(self._abort_key())
+            else:
+                raw = self._client.blocking_key_value_get(
+                    self._abort_key(), 1
+                )
+        except RuntimeError:
+            return None  # absent key surfaces as an error: no marker
+        try:
+            marker = json.loads(raw)
+        except (TypeError, ValueError):
+            marker = raw  # corrupt payload: shaped unattributed below
+        return self._shape_marker(marker)
+
+    def _next_seq(self) -> int:
+        if self.generation is None:
+            return _kv_seq()
+        seq, self._gen_seq = self._gen_seq, self._gen_seq + 1
+        return seq
 
     def _key(self, tag: str, seq: int, rank: int) -> str:
+        if self.generation is not None:
+            return f"photon/xchg/{self._namespace()}/{seq}/{tag}/{rank}"
         return f"photon/xchg/{seq}/{tag}/{rank}"
+
+    def _barrier_id(self, name: str) -> str:
+        if self.generation is not None:
+            return f"photon/bar/{self._namespace()}/{name}"
+        return f"photon/bar/{name}"
 
     def _kv_set(self, key: str, value: str) -> None:
         def attempt():
@@ -390,22 +587,45 @@ class DistributedKVExchange(MetadataExchange):
     def _kv_get(self, key: str, tag: str, expected_rank: int) -> str:
         from photon_ml_tpu.resilience.errors import ExchangeTimeout
 
+        def timeout_error(e):
+            return ExchangeTimeout(
+                tag,
+                key=key,
+                missing_ranks=(expected_rank,),
+                rank=self.rank,
+                timeout=self._timeout_ms / 1000.0,
+                detail=str(e),
+            )
+
         def attempt():
-            try:
-                return self._client.blocking_key_value_get(
-                    key, self._timeout_ms
-                )
-            except RuntimeError as e:
-                if _KV_DEADLINE_RE.search(str(e)):
-                    raise ExchangeTimeout(
-                        tag,
-                        key=key,
-                        missing_ranks=(expected_rank,),
-                        rank=self.rank,
-                        timeout=self._timeout_ms / 1000.0,
-                        detail=str(e),
-                    ) from e
-                raise
+            if self.generation is None:
+                try:
+                    return self._client.blocking_key_value_get(
+                        key, self._timeout_ms
+                    )
+                except RuntimeError as e:
+                    if _KV_DEADLINE_RE.search(str(e)):
+                        raise timeout_error(e) from e
+                    raise
+            # fenced mode: slice the deadline so a peer's abort marker
+            # surfaces within ~ABORT_POLL_MS instead of the full wait.
+            # Only an EXPIRED slice pays the marker read — a promptly-
+            # published key costs exactly one get, as before.
+            remaining = int(self._timeout_ms)
+            last = None
+            while remaining > 0:
+                chunk = min(self.ABORT_POLL_MS, remaining)
+                try:
+                    return self._client.blocking_key_value_get(key, chunk)
+                except RuntimeError as e:
+                    if not _KV_DEADLINE_RE.search(str(e)):
+                        raise
+                    last = e
+                remaining -= chunk
+                marker = self.pending_abort()
+                if marker is not None:
+                    self._raise_abort(tag, marker)
+            raise timeout_error(last) from last
 
         with tracing.span("exchange/kv_get", cat=tracing.EXCHANGE_IO_CAT,
                           key=key, tag=tag, rank=self.rank):
@@ -418,6 +638,12 @@ class DistributedKVExchange(MetadataExchange):
             self._client.wait_at_barrier(barrier_id, self._timeout_ms)
         except RuntimeError as e:
             if _KV_DEADLINE_RE.search(str(e)):
+                # barrier ids are single-use, so the wait cannot be
+                # sliced like a get: check the abort marker once at the
+                # deadline so the failure is at least attributed
+                marker = self.pending_abort()
+                if marker is not None:
+                    self._raise_abort(tag, marker)
                 raise ExchangeTimeout(
                     tag,
                     key=barrier_id,
@@ -428,7 +654,7 @@ class DistributedKVExchange(MetadataExchange):
             raise
 
     def allgather(self, tag: str, payload) -> list:
-        seq = _kv_seq()
+        seq = self._next_seq()
         # one wait span per allgather (tag + seq + rank) — the kv_get/
         # kv_set sub-spans nest inside it; the straggler tables read only
         # this outer wait. Observes, never gates.
@@ -443,7 +669,7 @@ class DistributedKVExchange(MetadataExchange):
             # coordinator's KV store does not retain one payload per
             # exchange for the process lifetime (feature-key lists can be
             # MBs)
-            self._wait_barrier(f"photon/bar/xchg-read/{seq}", tag)
+            self._wait_barrier(self._barrier_id(f"xchg-read/{seq}"), tag)
             try:
                 self._client.key_value_delete(
                     self._key(tag, seq, self.rank)
@@ -458,7 +684,9 @@ class DistributedKVExchange(MetadataExchange):
     def barrier(self, tag: str) -> None:
         with tracing.span("exchange/barrier", cat=tracing.EXCHANGE_CAT,
                           tag=tag, rank=self.rank):
-            self._wait_barrier(f"photon/bar/{_kv_seq()}/{tag}", tag)
+            self._wait_barrier(
+                self._barrier_id(f"{self._next_seq()}/{tag}"), tag
+            )
 
 
 def default_exchange() -> MetadataExchange:

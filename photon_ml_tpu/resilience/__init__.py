@@ -7,10 +7,15 @@ explicit TPU-native replacement, wired through every host-side boundary;
 see each submodule's docstring for its slice.
 """
 
+from photon_ml_tpu.resilience.coordinated import (
+    CoordinatedRecovery,
+    RestartDecision,
+)
 from photon_ml_tpu.resilience.errors import (
     FATAL_HINTS,
     TRANSIENT_ERRNOS,
     ExchangeTimeout,
+    PeerAbort,
     Transience,
     TransientError,
     classify_exception,
@@ -29,7 +34,10 @@ from photon_ml_tpu.resilience.recovery import run_with_recovery
 __all__ = [
     "FATAL_HINTS",
     "TRANSIENT_ERRNOS",
+    "CoordinatedRecovery",
     "ExchangeTimeout",
+    "PeerAbort",
+    "RestartDecision",
     "Transience",
     "TransientError",
     "classify_exception",

@@ -1,0 +1,310 @@
+"""Coordinated multi-rank recovery: generation fencing, peer-abort
+attribution, all-rank rollback to the last barrier-committed checkpoint.
+
+No reference analogue as code: the reference survives executor loss
+through Spark's substrate — the driver re-runs lost tasks against lineage
+(SURVEY.md §5; spark-submit/YARN, not a photon-ml source file). The SPMD
+rebuild has no driver: every rank runs the same program, so ONE rank's
+preemption must become a survivable, rank-attributed event for ALL ranks
+(ISSUE 15) — Snap ML (arXiv:1803.06333) treats the cluster as a memory
+hierarchy to re-enter, DrJAX (arXiv:2403.07128) makes the program, not
+the process, the durable unit. Before this module, a healthy rank's
+bounded exchange wait on a preempted peer ended the whole job: its
+``ExchangeTimeout`` classifies always-fatal (resilience/errors.py) and
+its per-process ``run_with_recovery`` budget could not restart an attempt
+whose PEERS were not restarting with it.
+
+:class:`CoordinatedRecovery` layers three pieces over the run's existing
+``MetadataExchange`` (host-side KV only — it never adds, skips, or
+reorders a DEVICE collective, so healthy-path runs with a coordinator
+attached stay bitwise-identical to detached runs):
+
+1. **Generation fencing** — the coordinator moves the exchange into a
+   restart-generation keyspace (``MetadataExchange.set_generation``):
+   every key and barrier id carries the generation, and the per-instance
+   call sequence resets when the generation bumps, so a restarted
+   attempt's ranks resynchronize at seq 0 and a dead attempt's stale keys
+   can never satisfy a new attempt's get (pre-ISSUE-15, the
+   process-global KV sequence desynced across restarts — ranks died at
+   different points of the SPMD call sequence).
+2. **Peer-abort markers** — a rank whose failure classifies
+   transient/preemption best-effort-writes a rank- and cause-attributed
+   abort marker before restarting; peers blocked in any fenced wait fail
+   fast with a typed ``resilience.errors.PeerAbort`` naming the culprit
+   instead of burning the full deadline. Markers are written ONLY on the
+   failure path; a healthy run performs zero additional exchange ops.
+3. **Coordinated rollback** — every rank's recovery path calls
+   :meth:`CoordinatedRecovery.coordinated_restart`: the generation bumps,
+   all ranks rendezvous on a new-generation restart exchange, rank 0
+   resolves the newest intact BARRIER-COMMITTED checkpoint
+   (``TrainingCheckpointer.newest_loadable_step`` — ``commit_checkpoint``
+   guarantees such a step exists only for sweeps EVERY rank completed)
+   and publishes ``(step, generation, restarts_used)``; every rank
+   verifies its local view matches and resumes from that step. The
+   restart budget is the GENERATION — shared by construction, so a
+   flapping rank exhausts the JOB's budget, never an asymmetric
+   per-process one.
+
+``run_with_recovery(coordinator=...)`` (resilience/recovery.py) is the
+driver-facing entry: with a coordinator attached, ``ExchangeTimeout`` and
+``PeerAbort`` become recoverable-via-coordination; without one, the
+pre-existing always-fatal contract is untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from photon_ml_tpu.resilience.errors import (
+    ExchangeTimeout,
+    PeerAbort,
+    is_preemption,
+)
+from photon_ml_tpu.telemetry import resilience_counters
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class RestartDecision:
+    """The all-rank agreement one coordinated restart produces.
+
+    generation:    the NEW restart generation every rank adopted (== the
+                   job's restarts used so far — the shared budget).
+    step:          the barrier-committed checkpoint step rank 0 resolved
+                   and published (0 = no checkpoint: restart from
+                   scratch).
+    restarts_used: == generation; spelled out for journals.
+    exhausted:     generation exceeded the job budget — every rank gives
+                   up, attributed identically.
+    origin_rank:   the rank whose failure started this restart (None when
+                   the marker was corrupt/absent — e.g. a hard-killed
+                   rank that never wrote one).
+    origin_cause:  the originating failure, as the culprit described it.
+    """
+
+    generation: int
+    step: int
+    restarts_used: int
+    exhausted: bool
+    origin_rank: "int | None"
+    origin_cause: str
+
+
+class CoordinatedRecovery:
+    """Per-rank coordinator over the run's ``MetadataExchange``.
+
+    Construct ONE per rank over the SAME exchange instance the run's
+    partitioned I/O and checkpoint commits ride (SPMD discipline: every
+    rank constructs it at the same point). Construction fences the
+    exchange into generation 0 — a pure key-namespace change; the
+    exchange op sequence of a healthy run is identical to a detached
+    run's.
+
+    checkpointer: the run's shared-directory checkpointer (rank 0 resolves
+    the rollback step from it; other ranks verify against the published
+    step). None = rollback restarts from scratch (step 0).
+    journal: optional per-rank ``telemetry.RunJournal`` — ``peer_abort``
+    and ``coordinated_restart`` rows carry the attribution every rank's
+    journal must agree on.
+    """
+
+    #: exchange tags of the restart protocol (generation-fenced like all
+    #: fenced tags, so a dead attempt's rendezvous can never be consumed
+    #: by a newer one)
+    RESTART_TAG = "coordinated/restart"
+    ROLLBACK_TAG = "coordinated/rollback"
+
+    def __init__(
+        self,
+        exchange,
+        *,
+        max_restarts: int = 2,
+        checkpointer=None,
+        journal=None,
+        description: str = "training",
+    ):
+        self.exchange = exchange
+        self.max_restarts = int(max_restarts)
+        self.checkpointer = checkpointer
+        self.journal = journal
+        self.description = description
+        #: the last decision's checkpoint step — drivers may thread it
+        #: into ``train_partitioned(resume_step=...)`` /
+        #: ``StreamingGameProgram.train(resume_step=...)`` to pin the
+        #: restore to the PUBLISHED step rather than "newest local"
+        self.resume_step: "int | None" = None
+        exchange.set_generation(0)
+
+    def rebind(self, checkpointer) -> None:
+        """Point the coordinator at a NEW unit of work's checkpointer
+        (e.g. the next grid config, whose checkpoint directory is its
+        own) and clear the published resume step — a step published for
+        the PREVIOUS unit's rollback must never pin a later unit's
+        restore (it may not even exist in the new directory)."""
+        self.checkpointer = checkpointer
+        self.resume_step = None
+
+    @property
+    def rank(self) -> int:
+        return self.exchange.rank
+
+    @property
+    def generation(self) -> int:
+        return int(self.exchange.generation or 0)
+
+    # -- failure path ---------------------------------------------------------
+
+    def post_abort(self, exc: BaseException) -> None:
+        """Best-effort: attribute this rank's recoverable failure to its
+        peers before restarting (the marker is what turns their full-
+        deadline ``ExchangeTimeout`` into an immediate ``PeerAbort``
+        naming this rank). Never raises — the culprit restarts either
+        way; peers fall back to their deadlines."""
+        info = {
+            "rank": self.rank,
+            "cause": repr(exc)[:500],
+            "kind": (
+                "preemption" if is_preemption(exc) else type(exc).__name__
+            ),
+            "generation": self.generation,
+        }
+        try:
+            self.exchange.post_abort(info)
+        except (RuntimeError, OSError) as e:
+            logger.warning("abort-marker write failed (best-effort): %s", e)
+        if self.journal is not None:
+            self.journal.record(
+                "abort_written",
+                rank=self.rank,
+                cause=info["cause"],
+                failure_kind=info["kind"],
+                generation=info["generation"],
+            )
+
+    def _origin(self, cause: BaseException) -> "tuple[int | None, str]":
+        """(origin_rank, origin_cause) as THIS rank observed it: a
+        PeerAbort carries the culprit; a marker left on the board names
+        it; otherwise this rank is itself the origin."""
+        if isinstance(cause, PeerAbort):
+            return cause.origin_rank, cause.cause or repr(cause)
+        marker = None
+        try:
+            marker = self.exchange.pending_abort()
+        except (RuntimeError, OSError):  # marker read is best-effort too
+            marker = None
+        if marker is not None:
+            origin = marker.get("rank")
+            return (
+                None if origin is None else int(origin),
+                str(marker.get("cause", "")),
+            )
+        if isinstance(cause, ExchangeTimeout):
+            # no marker: the peer died without writing one (hard kill) —
+            # the timeout's own attribution (missing ranks) is the best
+            # available
+            missing = getattr(cause, "missing_ranks", ())
+            return (missing[0] if missing else None), repr(cause)
+        return self.rank, repr(cause)
+
+    def coordinated_restart(self, cause: BaseException) -> RestartDecision:
+        """The all-rank restart protocol — EVERY rank's recovery path
+        calls this (the rendezvous is exchange-collective); returns the
+        published :class:`RestartDecision`. Raises ``ExchangeTimeout``
+        when a rank never reaches the rendezvous (it is truly gone, not
+        restarting — the job then fails attributed, as before)."""
+        origin_rank, origin_cause = self._origin(cause)
+        if isinstance(cause, PeerAbort):
+            resilience_counters.record_peer_abort()
+            if self.journal is not None:
+                self.journal.record(
+                    "peer_abort",
+                    rank=self.rank,
+                    origin_rank=origin_rank,
+                    origin_cause=origin_cause,
+                    generation=self.generation,
+                    tag=getattr(cause, "tag", None),
+                )
+        generation = self.generation + 1
+        self.exchange.set_generation(generation)
+        # rendezvous: every restarting rank checks in with its local view
+        # of the origin; the JOB-level attribution prefers a rank that
+        # blames ITSELF (the actual culprit's own report) over hearsay
+        views = self.exchange.allgather(
+            self.RESTART_TAG,
+            {"rank": self.rank, "origin_rank": origin_rank,
+             "origin_cause": origin_cause},
+        )
+        for v in views:
+            if v.get("origin_rank") is not None and (
+                v.get("origin_rank") == v.get("rank")
+            ):
+                origin_rank = int(v["origin_rank"])
+                origin_cause = str(v.get("origin_cause", origin_cause))
+                break
+        else:
+            named = [v for v in views if v.get("origin_rank") is not None]
+            if named:
+                origin_rank = int(named[0]["origin_rank"])
+                origin_cause = str(named[0].get("origin_cause",
+                                                origin_cause))
+        exhausted = generation > self.max_restarts
+        step = 0
+        if not exhausted:
+            # rank 0 resolves the newest intact barrier-committed step and
+            # publishes; every rank restores THAT step (commit_checkpoint
+            # guarantees it exists only for sweeps every rank completed)
+            local = (
+                self.checkpointer.newest_loadable_step()
+                if self.checkpointer is not None else None
+            )
+            published = self.exchange.allgather(
+                self.ROLLBACK_TAG,
+                {"step": local} if self.rank == 0 else None,
+            )[0]
+            step = int(published.get("step") or 0)
+            if (
+                self.rank != 0
+                and self.checkpointer is not None
+                and (local or 0) != step
+            ):
+                raise ValueError(
+                    f"coordinated rollback: rank {self.rank} resolves "
+                    f"checkpoint step {local or 0} but rank 0 published "
+                    f"step {step} — the ranks disagree on the shared "
+                    "checkpoint directory's contents; every rank must "
+                    "mount the SAME barrier-committed checkpoint "
+                    "directory"
+                )
+            self.resume_step = step
+            resilience_counters.record_coordinated_restart()
+        if self.journal is not None:
+            self.journal.record(
+                "coordinated_restart",
+                rank=self.rank,
+                generation=generation,
+                restarts_used=generation,
+                max_restarts=self.max_restarts,
+                step=step,
+                exhausted=exhausted,
+                origin_rank=origin_rank,
+                origin_cause=origin_cause,
+            )
+        logger.warning(
+            "coordinated restart: rank %d enters generation %d "
+            "(origin rank %s: %s)%s",
+            self.rank, generation, origin_rank, origin_cause,
+            (
+                " — JOB restart budget exhausted" if exhausted
+                else f", rolling back to checkpoint step {step}"
+            ),
+        )
+        return RestartDecision(
+            generation=generation,
+            step=step,
+            restarts_used=generation,
+            exhausted=exhausted,
+            origin_rank=origin_rank,
+            origin_cause=origin_cause,
+        )

@@ -146,7 +146,12 @@ class ExchangeTimeout(TimeoutError):
     never published partitioned_read/train" instead of an anonymous hang
     (the failure mode ISSUE 3 exists to kill). Classified FATAL: the
     deadline already waited; what is needed is the named rank's logs, not
-    another identical wait.
+    another identical wait. One exception to "fatal ends the job": a run
+    with a ``resilience.coordinated.CoordinatedRecovery`` attached treats
+    it (like :class:`PeerAbort`) as recoverable-VIA-COORDINATION — the
+    coordinator rendezvouses every rank on an all-rank rollback instead of
+    retrying the wait (ISSUE 15); without a coordinator the original
+    contract stands.
     """
 
     def __init__(
@@ -181,11 +186,58 @@ class ExchangeTimeout(TimeoutError):
         super().__init__(" ".join(parts))
 
 
+class PeerAbort(RuntimeError):
+    """ANOTHER rank aborted the attempt — attributed to the culprit.
+
+    Raised by a generation-fenced exchange wait when a peer rank posts an
+    abort marker (its own failure classified transient/preemption) instead
+    of publishing its key: the healthy ranks fail FAST with the culprit
+    rank and cause named, rather than burning the full exchange deadline
+    on a rank that already knows it is restarting. Classified FATAL for
+    the same reason as :class:`ExchangeTimeout` — already attributed, and
+    blindly re-waiting would desynchronize the SPMD call sequence — but
+    recoverable VIA COORDINATION: ``run_with_recovery(coordinator=...)``
+    turns it into an all-rank rollback to the last barrier-committed
+    checkpoint (resilience/coordinated.py).
+    """
+
+    def __init__(
+        self,
+        tag: str,
+        *,
+        origin_rank: "int | None" = None,
+        cause: str = "",
+        generation: int | None = None,
+        rank: int | None = None,
+    ):
+        self.tag = tag
+        self.origin_rank = origin_rank
+        self.cause = cause
+        self.generation = generation
+        self.rank = rank
+        parts = [f"exchange {tag!r} aborted"]
+        if origin_rank is not None:
+            parts.append(f"by rank {origin_rank}")
+        else:
+            parts.append("by an unattributed peer (corrupt abort marker?)")
+        if generation is not None:
+            parts.append(f"in generation {generation}")
+        if cause:
+            parts.append(f"cause: {cause}")
+        if rank is not None:
+            parts.append(f"(observed on rank {rank})")
+        super().__init__(" ".join(parts))
+
+
 def classify_exception(exc: BaseException) -> Transience:
     """The ONE transient-vs-fatal rule (precedence in the module docstring)."""
     if isinstance(exc, TransientError):
         return Transience.TRANSIENT
-    if isinstance(exc, ExchangeTimeout):
+    if isinstance(exc, (ExchangeTimeout, PeerAbort)):
+        # already-attributed coordination failures: the cause STRING may
+        # smell transient ("preempted"), but re-waiting/retrying locally
+        # would desync the SPMD sequence — only the coordinator path
+        # (resilience/coordinated.py) may recover these
         return Transience.FATAL
     message = f"{type(exc).__name__}: {exc}"
     if _FATAL_PATTERNS.search(message):

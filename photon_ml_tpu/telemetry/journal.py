@@ -36,6 +36,7 @@ import json
 import math
 import os
 import tempfile
+import threading
 import time
 
 JOURNAL_FILENAME = "run-journal.jsonl"
@@ -146,6 +147,12 @@ class RunJournal:
         self._seq = 0
         self._spool = None
         self._closed = False
+        #: journals now have legitimate second writer threads (the serve
+        #: driver's swap poller, the micro-batch consumer's ledger rows):
+        #: the seq stamp + buffered write/flush/fsync must be one atomic
+        #: unit or concurrent rows tear mid-file (read_journal only
+        #: forgives a torn FINAL line)
+        self._lock = threading.Lock()
         self._hb_counters: dict[str, int] = {}
         # monotonic anchor: rows carry elapsed_ms since journal open so
         # they order correctly across host clock steps and correlate with
@@ -190,25 +197,29 @@ class RunJournal:
     def record(self, kind: str, **fields) -> None:
         if not self.active:
             return
-        row = {
-            "kind": kind,
-            "seq": self._seq,
-            # ts is the ONE sanctioned absolute wall-clock stamp (lint
-            # check 11 allowlist); durations/ordering ride elapsed_ms
-            "ts": time.time(),
-            "elapsed_ms": round(
-                (time.perf_counter() - self._t0) * 1e3, 3
-            ),
-        }
-        row.update(json_safe(fields))
-        self._seq += 1
-        self._spool.write(json.dumps(row, allow_nan=False) + "\n")
-        self._spool.flush()
-        if self.durable:
-            # append-fsync per row: a SIGKILL between rows loses at most
-            # the row being written, never the file (journals are low-rate
-            # — tens of rows plus heartbeats per run)
-            os.fsync(self._spool.fileno())
+        payload = json_safe(fields)
+        with self._lock:
+            if not self.active:  # closed while we serialized
+                return
+            row = {
+                "kind": kind,
+                "seq": self._seq,
+                # ts is the ONE sanctioned absolute wall-clock stamp (lint
+                # check 11 allowlist); durations/ordering ride elapsed_ms
+                "ts": time.time(),
+                "elapsed_ms": round(
+                    (time.perf_counter() - self._t0) * 1e3, 3
+                ),
+            }
+            row.update(payload)
+            self._seq += 1
+            self._spool.write(json.dumps(row, allow_nan=False) + "\n")
+            self._spool.flush()
+            if self.durable:
+                # append-fsync per row: a SIGKILL between rows loses at
+                # most the row being written, never the file (journals are
+                # low-rate — tens of rows plus heartbeats per run)
+                os.fsync(self._spool.fileno())
 
     def record_timings(self, timings: dict[str, dict[str, float]]) -> None:
         """One ``phase_timing`` row per named phase — the shape
@@ -269,10 +280,14 @@ class RunJournal:
             self._closed = True
             return
         self.record("journal_close", records=self._seq)
-        self._closed = True
-        self._spool.flush()
-        os.fsync(self._spool.fileno())
-        self._spool.close()
+        with self._lock:
+            # a concurrent writer thread (swap poller) blocked on the lock
+            # re-checks `active` after acquiring it, so nothing writes to
+            # the spool once it is closed here
+            self._closed = True
+            self._spool.flush()
+            os.fsync(self._spool.fileno())
+            self._spool.close()
         if self.durable:
             # the spool IS the stage file in the destination directory:
             # publish is one atomic rename

@@ -43,6 +43,13 @@ PREEMPTIONS = "resilience/preemptions"
 #: (streaming λ-grid epochs, partitioned/distributed sweeps) — the
 #: counter that prices what the checkpoint cadence actually saved
 EPOCHS_RESUMED = "resilience/epochs_resumed"
+#: typed PeerAbort failures observed (a peer's abort marker ended this
+#: rank's exchange wait early, attributed) — ISSUE 15
+PEER_ABORTS = "resilience/peer_aborts"
+#: all-rank coordinated rollback restarts this rank participated in
+#: (one per restart generation; the SHARED budget consumes these) —
+#: ISSUE 15
+COORDINATED_RESTARTS = "resilience/coordinated_restarts"
 
 #: bounded forensic ring: quarantine spans awaiting journaling (a corrupt
 #: input could hold thousands of bad blocks; the counter stays exact while
@@ -73,16 +80,27 @@ def record_epochs_resumed(n: int) -> None:
     default_registry().counter(EPOCHS_RESUMED).inc(int(n))
 
 
+def record_peer_abort(n: int = 1) -> None:
+    default_registry().counter(PEER_ABORTS).inc(int(n))
+
+
+def record_coordinated_restart(n: int = 1) -> None:
+    default_registry().counter(COORDINATED_RESTARTS).inc(int(n))
+
+
 def reset_resilience_metrics(registry=None) -> None:
-    """Drop the PER-RUN recovery counters (preemptions, epochs_resumed) —
-    drivers call this at run start next to ``reset_solver_metrics`` so a
-    sweep invoking ``run()`` repeatedly journals per-run tallies. The
-    ISSUE-3 counters (retries/giveups/quarantined_blocks/
-    checkpoint_restores) keep their original process-lifetime semantics:
-    existing consumers assert cumulative values across runs."""
+    """Drop the PER-RUN recovery counters (preemptions, epochs_resumed,
+    peer_aborts, coordinated_restarts) — drivers call this at run start
+    next to ``reset_solver_metrics`` so a sweep invoking ``run()``
+    repeatedly journals per-run tallies. The ISSUE-3 counters
+    (retries/giveups/quarantined_blocks/checkpoint_restores) keep their
+    original process-lifetime semantics: existing consumers assert
+    cumulative values across runs."""
     reg = registry or default_registry()
     reg.remove_prefix(PREEMPTIONS)
     reg.remove_prefix(EPOCHS_RESUMED)
+    reg.remove_prefix(PEER_ABORTS)
+    reg.remove_prefix(COORDINATED_RESTARTS)
 
 
 def record_quarantined_block(
@@ -129,6 +147,14 @@ def checkpoint_restores() -> int:
 
 def preemptions() -> int:
     return int(default_registry().counter(PREEMPTIONS).value)
+
+
+def peer_aborts() -> int:
+    return int(default_registry().counter(PEER_ABORTS).value)
+
+
+def coordinated_restarts() -> int:
+    return int(default_registry().counter(COORDINATED_RESTARTS).value)
 
 
 def epochs_resumed() -> int:

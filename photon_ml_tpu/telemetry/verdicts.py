@@ -744,5 +744,108 @@ def _ledger_findings(records: list, counters: dict, gauges: dict,
     return findings
 
 
+def coordination_findings(records: list) -> list:
+    """Cross-rank coordinated-recovery findings (ISSUE 15) over the
+    MERGED journal rows of every rank's journal in a run directory: the
+    per-rank restart table (restarts / aborts observed / aborts written /
+    generations, from ``coordinated_restart`` / ``peer_abort`` /
+    ``abort_written`` rows) and the RESTART-STORM pathology — the job's
+    shared budget exhausted with the SAME culprit rank attributed every
+    time, which names the rank to drain/replace instead of a generic
+    "budget ran out"."""
+    findings: list[Verdict] = []
+    per_rank: dict[int, dict] = {}
+
+    def ent(rank) -> dict | None:
+        if rank is None:
+            return None
+        return per_rank.setdefault(int(rank), {
+            "restarts": 0, "aborts_observed": 0, "aborts_written": 0,
+            "blamed": 0, "max_generation": 0,
+        })
+
+    origins: list = []
+    origin_generations: set = set()
+    exhausted_rows: list[dict] = []
+    for row in records:
+        kind = row.get("kind")
+        if kind == "coordinated_restart":
+            e = ent(row.get("rank"))
+            if e is not None:
+                e["restarts"] += 1
+                e["max_generation"] = max(
+                    e["max_generation"], int(row.get("generation") or 0)
+                )
+            if row.get("origin_rank") is not None:
+                origins.append(int(row["origin_rank"]))
+                # every rank journals the SAME restart: distinct
+                # generations count actual restarts, not rank-rows
+                origin_generations.add(int(row.get("generation") or 0))
+                blamed = ent(row["origin_rank"])
+                blamed["blamed"] += 1
+            if row.get("exhausted"):
+                exhausted_rows.append(row)
+        elif kind == "peer_abort":
+            e = ent(row.get("rank"))
+            if e is not None:
+                e["aborts_observed"] += 1
+        elif kind == "abort_written":
+            e = ent(row.get("rank"))
+            if e is not None:
+                e["aborts_written"] += 1
+        elif kind == "run_failure" and row.get("origin_rank") is not None:
+            if row.get("restarts_used") is not None and row.get(
+                "max_restarts"
+            ) is not None and int(row["restarts_used"]) >= int(
+                row["max_restarts"]
+            ):
+                exhausted_rows.append(row)
+    if not per_rank:
+        return findings
+    table = "; ".join(
+        f"rank {r}: restarts={e['restarts']} "
+        f"aborts_observed={e['aborts_observed']} "
+        f"aborts_written={e['aborts_written']} blamed={e['blamed']} "
+        f"max_gen={e['max_generation']}"
+        for r, e in sorted(per_rank.items())
+    )
+    findings.append(Verdict(
+        "coordination", "cross-rank-restart-table", INFO,
+        f"coordinated recovery over {len(per_rank)} rank(s): {table}",
+    ))
+    if exhausted_rows and origins and len(set(origins)) == 1:
+        culprit = origins[0]
+        findings.append(Verdict(
+            "coordination", "restart-storm", PATHOLOGY,
+            f"restart budget exhausted with rank {culprit} attributed as "
+            f"the origin of every coordinated restart "
+            f"({len(origin_generations)} restart generation(s)) — one "
+            "flapping rank is burning the JOB's shared budget; "
+            "drain/replace that worker before re-running",
+        ))
+    return findings
+
+
+def last_abort_marker(records: list) -> dict | None:
+    """The newest abort attribution seen in the merged journal rows — a
+    ``peer_abort`` (observer side) or ``abort_written`` (culprit side)
+    row. Newest by (generation, wall clock), NOT by file-concatenation
+    order: the merge walks per-rank journals one at a time, so the last
+    row read can be a stale rank's. What ``doctor --live`` prints while a
+    run is wedged mid-restart."""
+    last = None
+    last_key = None
+    for row in records:
+        if row.get("kind") not in ("peer_abort", "abort_written"):
+            continue
+        key = (
+            int(row.get("generation") or -1),
+            float(row.get("ts") or 0.0),
+        )
+        if last_key is None or key >= last_key:
+            last, last_key = row, key
+    return last
+
+
 def regressions(verdicts: list) -> list:
     return [v for v in verdicts if v.status == REGRESSION]

@@ -1,0 +1,777 @@
+"""Coordinated multi-rank recovery chaos suite (ISSUE 15): one rank's
+preemption becomes a survivable, rank-attributed, all-rank rollback —
+never a whole-job death, never a hang, never a stale-key resume.
+
+Drives resilience/coordinated.py end to end on virtual ranks (threads +
+InProcessExchange) over the REAL composed production path
+(partitioned read x hybrid layout x scheduled RE solves,
+test_composed_path fixtures) and the streamed-GAME sweep-checkpoint path:
+
+- generation fencing: a generation-g key can never satisfy a g+1 get,
+  and desynchronized per-rank call sequences resynchronize at the
+  generation bump;
+- peer-abort markers: a healthy rank blocked on a dead peer fails in
+  milliseconds with a typed PeerAbort naming the culprit, not after the
+  full exchange deadline — and a CORRUPT marker still fails bounded and
+  typed, just unattributed;
+- coordinated rollback: every rank rendezvouses, rank 0 publishes the
+  newest barrier-committed checkpoint step, and the resumed run finishes
+  BITWISE equal to the uninterrupted one;
+- inertness: a coordinator attached to a healthy run is bitwise-identical
+  to a detached run with ZERO additional exchange ops (abort keys are
+  written only on the failure path);
+- shared budget: a flapping rank exhausts the JOB's budget — every rank
+  gives up with the culprit attributed identically in its journal.
+
+No pytest-timeout in this container: boundedness rides the exchanges' own
+sub-second deadlines plus bounded thread joins (test_resilience.py rule).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from dev import faultinject
+from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
+from photon_ml_tpu.parallel.multihost import (
+    DistributedKVExchange,
+    InProcessExchange,
+    make_hybrid_mesh,
+)
+from photon_ml_tpu.resilience import (
+    CoordinatedRecovery,
+    ExchangeTimeout,
+    PeerAbort,
+    Transience,
+    classify_exception,
+    run_with_recovery,
+)
+from photon_ml_tpu.telemetry import RunJournal
+from photon_ml_tpu.telemetry import resilience_counters as rc
+
+pytestmark = pytest.mark.chaos
+
+NUM_RANKS = 2
+
+
+def _join_all(threads, timeout=90.0):
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    assert not any(t.is_alive() for t in threads), (
+        "a coordinated-recovery path exceeded its bounded deadline (hang)"
+    )
+
+
+def _read_rows(directory):
+    rows = []
+    for name in sorted(os.listdir(directory)):
+        if name.endswith((".jsonl", ".jsonl.partial")):
+            with open(os.path.join(directory, name)) as fh:
+                rows += [json.loads(line) for line in fh if line.strip()]
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# generation fencing
+# ---------------------------------------------------------------------------
+
+
+class TestGenerationFencing:
+    def test_stale_generation_key_never_satisfies_newer_get(self):
+        """THE fencing pin: rank 0 publishes in generation 0 (its peer
+        never arrives — the dead attempt), both ranks bump to generation
+        1, and the SAME tag's allgather must resolve only generation-1
+        payloads — the stale generation-0 key is invisible."""
+        group = InProcessExchange.create_group(NUM_RANKS, timeout=0.3)
+        for ex in group:
+            ex.set_generation(0)
+        stale_error = {}
+
+        def dead_attempt():
+            try:
+                group[0].allgather("layout", {"v": "stale"})
+            except Exception as e:  # asserted below
+                stale_error["e"] = e
+
+        t = threading.Thread(target=dead_attempt, daemon=True)
+        t.start()
+        t.join(5.0)
+        assert not t.is_alive()
+        assert isinstance(stale_error["e"], ExchangeTimeout)
+
+        for ex in group:
+            ex.set_generation(1)
+        results = [None] * NUM_RANKS
+
+        def fresh(r):
+            results[r] = group[r].allgather("layout", {"v": f"fresh{r}"})
+
+        _join_all([threading.Thread(target=fresh, args=(r,), daemon=True)
+                   for r in range(NUM_RANKS)], timeout=5.0)
+        assert results[0] == results[1] == [
+            {"v": "fresh0"}, {"v": "fresh1"}
+        ]
+
+    def test_desynced_sequences_resync_at_generation_bump(self):
+        """The pre-ISSUE-15 death spiral: ranks die at DIFFERENT points of
+        the SPMD call sequence, so their per-instance counters disagree —
+        set_generation resets both to seq 0, and the next exchange
+        matches again."""
+        group = InProcessExchange.create_group(NUM_RANKS, timeout=0.3)
+        # rank 0 got one op further than rank 1 before the attempt died
+        # (its wait timed out; rank 1 never called) — counters now differ
+        t = threading.Thread(
+            target=lambda: self._swallow(group[0].allgather, "ahead", 1),
+            daemon=True,
+        )
+        t.start()
+        t.join(5.0)
+        assert group[0]._seq != group[1]._seq
+
+        for ex in group:
+            ex.set_generation(1)
+        results = [None] * NUM_RANKS
+
+        def go(r):
+            results[r] = group[r].allgather("resynced", r)
+
+        _join_all([threading.Thread(target=go, args=(r,), daemon=True)
+                   for r in range(NUM_RANKS)], timeout=5.0)
+        assert results[0] == results[1] == [0, 1]
+
+    @staticmethod
+    def _swallow(fn, *args):
+        try:
+            fn(*args)
+        except ExchangeTimeout:
+            pass
+
+    def test_kv_exchange_generation_prefixes_keys_and_resets_seq(self):
+        """The coordination-service transport: fenced keys carry the
+        (session nonce, generation) namespace, the per-instance sequence
+        resets at the bump, and a SECOND fencing session in the same
+        process (driver run() called twice) gets a fresh nonce — its
+        generation-0 keys can never collide with the first session's
+        (barrier ids are single-use process-wide)."""
+        client = _FakeKVClient()
+        ex = DistributedKVExchange(
+            timeout_ms=300, client=client, rank=0, num_ranks=1,
+            retry=_no_sleep_policy(),
+        )
+        ex.set_generation(0)
+        ns0 = ex._namespace()
+        assert ex.allgather("meta", {"x": 1}) == [{"x": 1}]
+        assert any(
+            k.startswith(f"photon/xchg/{ns0}/0/meta/") for k in client.writes
+        )
+        ex.set_generation(1)
+        ns1 = ex._namespace()
+        assert ns1.endswith("g1") and ns1.startswith(ns0[:ns0.index("g")])
+        assert ex.allgather("meta", {"x": 2}) == [{"x": 2}]
+        assert any(
+            k.startswith(f"photon/xchg/{ns1}/0/meta/") for k in client.writes
+        )
+        # a new fencing session (set_generation back to 0) draws a fresh
+        # nonce: same generation, DIFFERENT keyspace
+        ex.set_generation(0)
+        assert ex._namespace() != ns0 and ex._namespace().endswith("g0")
+
+    def test_kv_fenced_wait_surfaces_peer_abort_between_slices(self):
+        """The sliced fenced wait: a peer's abort marker ends the blocked
+        get typed and attributed well before the full deadline."""
+        client = _FakeKVClient()
+        ex = DistributedKVExchange(
+            timeout_ms=5_000, client=client, rank=0, num_ranks=2,
+            retry=_no_sleep_policy(),
+        )
+        ex.ABORT_POLL_MS = 20
+        ex.set_generation(0)
+        client.store[ex._abort_key()] = json.dumps(
+            {"rank": 1, "cause": "RuntimeError('worker preempted')"}
+        )
+        import time
+
+        t0 = time.perf_counter()
+        with pytest.raises(PeerAbort) as ei:
+            ex.allgather("meta", {"x": 1})
+        assert time.perf_counter() - t0 < 2.0  # not the 5 s deadline
+        assert ei.value.origin_rank == 1
+        assert "preempted" in ei.value.cause
+
+
+class _FakeKVClient:
+    """The minimal coordination-service client surface the fenced
+    exchange touches (the test_resilience FakeClient shape + try_get)."""
+
+    def __init__(self):
+        self.store = {}
+        self.writes = []
+
+    def key_value_set(self, k, v):
+        self.store[k] = v
+        self.writes.append(k)
+
+    def blocking_key_value_get(self, k, timeout_ms):
+        if k in self.store:
+            return self.store[k]
+        raise RuntimeError("DEADLINE_EXCEEDED: timed out")
+
+    def key_value_try_get(self, k):
+        if k in self.store:
+            return self.store[k]
+        raise RuntimeError("NOT_FOUND: no such key")
+
+    def wait_at_barrier(self, bid, timeout_ms):
+        return None
+
+    def key_value_delete(self, k):
+        self.store.pop(k, None)
+
+
+def _no_sleep_policy():
+    from photon_ml_tpu.resilience import RetryPolicy
+
+    return RetryPolicy(max_attempts=2, sleep=lambda _: None)
+
+
+# ---------------------------------------------------------------------------
+# peer aborts
+# ---------------------------------------------------------------------------
+
+
+class TestPeerAbort:
+    def test_abort_wakes_waiter_fast_and_names_culprit(self):
+        import time
+
+        group = InProcessExchange.create_group(NUM_RANKS, timeout=5.0)
+        for ex in group:
+            ex.set_generation(0)
+        box = {}
+
+        def waiter():
+            t0 = time.perf_counter()
+            try:
+                group[0].allgather("sweep", 1)
+            except Exception as e:  # asserted below
+                box["e"], box["dt"] = e, time.perf_counter() - t0
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        group[1].post_abort(
+            {"rank": 1, "cause": "RuntimeError('pool preempted worker 1')"}
+        )
+        t.join(5.0)
+        assert not t.is_alive()
+        assert isinstance(box["e"], PeerAbort)
+        assert box["e"].origin_rank == 1
+        assert "preempted" in box["e"].cause
+        assert box["dt"] < 2.0, "the abort should beat the 5 s deadline"
+        # attributed coordination failures stay FATAL without a
+        # coordinator, even though the cause string smells transient
+        assert classify_exception(box["e"]) is Transience.FATAL
+
+    def test_corrupt_abort_marker_still_bounded_and_typed(self):
+        """dev/faultinject.abort_marker_corruptor: a garbled marker must
+        still end the wait typed (PeerAbort, unattributed) — never a hang,
+        never an unhandled parse error."""
+        group = InProcessExchange.create_group(NUM_RANKS, timeout=5.0)
+        for ex in group:
+            ex.set_generation(0)
+        box = {}
+
+        def waiter():
+            try:
+                group[0].allgather("sweep", 1)
+            except Exception as e:  # asserted below
+                box["e"] = e
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        with faultinject.abort_marker_corruptor(group[1]) as state:
+            group[1].post_abort({"rank": 1, "cause": "real cause"})
+        t.join(5.0)
+        assert not t.is_alive()
+        assert state["posted"] == 1
+        assert isinstance(box["e"], PeerAbort)
+        assert box["e"].origin_rank is None
+        assert "unparseable" in box["e"].cause
+        assert "unattributed" in str(box["e"])
+
+    def test_own_marker_never_aborts_self(self):
+        group = InProcessExchange.create_group(1, timeout=0.5)
+        group[0].set_generation(0)
+        group[0].post_abort({"rank": 0, "cause": "mine"})
+        # a single-rank allgather completes despite this rank's own marker
+        assert group[0].allgather("t", "x") == ["x"]
+
+
+# ---------------------------------------------------------------------------
+# coordinated rollback on the composed production path
+# ---------------------------------------------------------------------------
+
+
+def _composed_fixture(tmp_path):
+    from test_composed_path import _read_ranks, _shard_configs, _write_input
+
+    os.makedirs(tmp_path, exist_ok=True)
+    path = _write_input(tmp_path, num_files=2, rows_per_file=20,
+                        tail="uniform")
+    configs = _shard_configs()
+    parts, exchanges, errors = _read_ranks(path, configs)
+    assert not errors, errors
+    from test_composed_path import _build_re_ranks
+
+    re_parts = _build_re_ranks(parts, exchanges)
+    return parts, re_parts
+
+
+def _run_composed_per_rank(parts, re_parts, mesh, exchanges, checkpointers,
+                           coordinators, journals, num_iterations=3):
+    """Each virtual rank runs the SAME composed train_partitioned under
+    run_with_recovery(coordinator=...) — the per-process shape a real pod
+    takes, with the commit barriers synchronizing sweeps across ranks."""
+    from photon_ml_tpu.algorithm.lane_scheduler import make_schedulers
+    from photon_ml_tpu.parallel.distributed import train_partitioned
+    from test_composed_path import _program
+
+    n = len(exchanges)
+    results, errors = [None] * n, [None] * n
+
+    def work(r):
+        def attempt(restart):
+            prog = _program()
+            scheds = make_schedulers(prog.re_specs, mesh=mesh)
+            return train_partitioned(
+                prog,
+                {k: (parts[k].result.dataset, re_parts[k])
+                 for k in range(len(parts))},
+                mesh, len(parts),
+                num_iterations=num_iterations,
+                schedulers=scheds or None,
+                checkpointer=checkpointers[r],
+                exchange=exchanges[r],
+                resume_step=(
+                    coordinators[r].resume_step
+                    if coordinators[r] is not None else None
+                ),
+            )
+
+        try:
+            results[r] = run_with_recovery(
+                attempt,
+                checkpointer=checkpointers[r],
+                journal=journals[r] if journals else None,
+                description=f"composed rank {r}",
+                coordinator=coordinators[r],
+            )
+        except Exception as e:  # surfaced to the asserting test
+            errors[r] = e
+
+    _join_all([threading.Thread(target=work, args=(r,), daemon=True)
+               for r in range(n)], timeout=300.0)
+    return results, errors
+
+
+class TestCoordinatedComposedRollback:
+    """THE acceptance chaos claim: the composed virtual-rank run
+    (partitioned x hybrid x scheduler) with rank 1 preempted mid-sweep
+    coordinates a rollback and finishes BITWISE == the uninterrupted run,
+    with PeerAbort naming rank 1 in every healthy rank's journal."""
+
+    def test_rank_kill_mid_sweep_resumes_bitwise_attributed(self, tmp_path):
+        parts, re_parts = _composed_fixture(tmp_path / "data")
+        mesh = make_hybrid_mesh(data=4, model=2)
+
+        # uninterrupted reference: same composed path, no chaos attached
+        ref_group = InProcessExchange.create_group(NUM_RANKS, timeout=5.0)
+        ref_cks = [TrainingCheckpointer(tmp_path / "refck")
+                   for _ in range(NUM_RANKS)]
+        ref_res, ref_err = _run_composed_per_rank(
+            parts, re_parts, mesh, ref_group, ref_cks,
+            [None] * NUM_RANKS, None,
+        )
+        assert ref_err == [None, None], ref_err
+
+        # chaos run: rank 1 is preempted at the sweep-2 commit barrier
+        group = InProcessExchange.create_group(NUM_RANKS, timeout=5.0)
+        killer = faultinject.die_at_barrier(
+            group[1], "checkpoint_commit/2", rank=1
+        )
+        exchanges = [group[0], killer]
+        cks = [TrainingCheckpointer(tmp_path / "ck")
+               for _ in range(NUM_RANKS)]
+        journals = [
+            RunJournal(tmp_path / f"journal-r{r}", rank=0)
+            for r in range(NUM_RANKS)
+        ]
+        coords = [
+            CoordinatedRecovery(
+                exchanges[r], max_restarts=2, checkpointer=cks[r],
+                journal=journals[r], description=f"composed rank {r}",
+            )
+            for r in range(NUM_RANKS)
+        ]
+        before = (rc.peer_aborts(), rc.coordinated_restarts())
+        results, errors = _run_composed_per_rank(
+            parts, re_parts, mesh, exchanges, cks, coords, journals,
+        )
+        for j in journals:
+            j.close()
+        assert killer.state["fired"] == 1, "the injected kill never fired"
+        assert errors == [None, None], errors
+
+        # every rank's recovered result is BITWISE the uninterrupted run's
+        for r in range(NUM_RANKS):
+            np.testing.assert_array_equal(
+                np.asarray(results[r].state.fe_coefficients),
+                np.asarray(ref_res[0].state.fe_coefficients),
+            )
+            np.testing.assert_array_equal(
+                np.asarray(results[r].state.re_tables["userId"]),
+                np.asarray(ref_res[0].state.re_tables["userId"]),
+            )
+            np.testing.assert_array_equal(
+                results[r].losses, ref_res[0].losses
+            )
+        assert rc.peer_aborts() > before[0]
+        assert rc.coordinated_restarts() > before[1]
+
+        # attribution: every HEALTHY rank's journal carries a peer_abort
+        # row naming rank 1, and every rank a coordinated_restart row
+        # agreeing on (generation, step, origin)
+        rows0 = _read_rows(tmp_path / "journal-r0")
+        aborts0 = [r for r in rows0 if r.get("kind") == "peer_abort"]
+        assert aborts0 and all(a["origin_rank"] == 1 for a in aborts0)
+        restarts0 = [r for r in rows0
+                     if r.get("kind") == "coordinated_restart"]
+        assert restarts0 and restarts0[0]["origin_rank"] == 1
+        assert restarts0[0]["generation"] == 1
+        assert restarts0[0]["step"] == 1  # rolled back to sweep-1 commit
+
+        rows1 = _read_rows(tmp_path / "journal-r1")
+        written1 = [r for r in rows1 if r.get("kind") == "abort_written"]
+        assert written1 and written1[0]["kind"] == "abort_written"
+        restarts1 = [r for r in rows1
+                     if r.get("kind") == "coordinated_restart"]
+        assert restarts1 and restarts1[0]["origin_rank"] == 1
+        assert restarts1[0]["step"] == restarts0[0]["step"]
+
+    def test_coordinator_attached_healthy_run_inert(self, tmp_path):
+        """Inertness pin: coordinator attached but no failure -> bitwise
+        == the detached run, with ZERO additional exchange ops on the
+        sweep hot path and no abort key ever written."""
+        parts, re_parts = _composed_fixture(tmp_path / "data")
+        mesh = make_hybrid_mesh(data=4, model=2)
+
+        class CountingExchange:
+            def __init__(self, inner):
+                self._inner = inner
+                self.ops = 0
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def allgather(self, tag, payload):
+                self.ops += 1
+                return self._inner.allgather(tag, payload)
+
+            def barrier(self, tag):
+                self.ops += 1
+                return self._inner.barrier(tag)
+
+            def set_generation(self, g):
+                self._inner.set_generation(g)
+
+        def run_once(attach):
+            group = InProcessExchange.create_group(NUM_RANKS, timeout=5.0)
+            counted = [CountingExchange(g) for g in group]
+            cks = [
+                TrainingCheckpointer(
+                    tmp_path / f"ck-{'on' if attach else 'off'}"
+                )
+                for _ in range(NUM_RANKS)
+            ]
+            coords = [
+                CoordinatedRecovery(counted[r], max_restarts=2,
+                                    checkpointer=cks[r])
+                if attach else None
+                for r in range(NUM_RANKS)
+            ]
+            results, errors = _run_composed_per_rank(
+                parts, re_parts, mesh, counted, cks, coords, None,
+            )
+            assert errors == [None, None], errors
+            return results, [c.ops for c in counted], group
+
+        res_off, ops_off, _ = run_once(attach=False)
+        res_on, ops_on, group_on = run_once(attach=True)
+        np.testing.assert_array_equal(
+            np.asarray(res_on[0].state.fe_coefficients),
+            np.asarray(res_off[0].state.fe_coefficients),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_on[0].state.re_tables["userId"]),
+            np.asarray(res_off[0].state.re_tables["userId"]),
+        )
+        np.testing.assert_array_equal(res_on[0].losses, res_off[0].losses)
+        assert ops_on == ops_off, (
+            "a coordinator on a healthy run must add ZERO exchange ops"
+        )
+        # abort keys are written only on the failure path
+        assert not group_on[0]._store.get("aborts")
+
+
+class TestCoordinatedStreamedGameRollback:
+    """The streamed-GAME sweep-checkpoint path, covered the same way: a
+    rank-1 kill at the sweep-2 commit coordinates a rollback and the
+    resumed per-rank runs finish BITWISE == the uninterrupted one."""
+
+    SWEEPS = 3
+
+    def _run_per_rank(self, exchanges, checkpointers, coordinators,
+                      journals):
+        from test_resilience import _streamed_game_program
+
+        n = len(exchanges)
+        results, errors = [None] * n, [None] * n
+
+        def work(r):
+            def attempt(restart):
+                program = _streamed_game_program()
+                program.exchange = exchanges[r]
+                return program.train(
+                    num_sweeps=self.SWEEPS,
+                    checkpointer=checkpointers[r],
+                    resume_step=(
+                        coordinators[r].resume_step
+                        if coordinators[r] is not None else None
+                    ),
+                )
+
+            try:
+                results[r] = run_with_recovery(
+                    attempt,
+                    checkpointer=checkpointers[r],
+                    journal=journals[r] if journals else None,
+                    description=f"streamed rank {r}",
+                    coordinator=coordinators[r],
+                )
+            except Exception as e:  # surfaced to the asserting test
+                errors[r] = e
+
+        _join_all([threading.Thread(target=work, args=(r,), daemon=True)
+                   for r in range(n)], timeout=300.0)
+        return results, errors
+
+    def test_rank_kill_mid_sweep_resumes_bitwise(self, tmp_path):
+        from test_resilience import _streamed_game_program
+
+        ref = _streamed_game_program().train(num_sweeps=self.SWEEPS)
+
+        group = InProcessExchange.create_group(NUM_RANKS, timeout=5.0)
+        killer = faultinject.die_at_barrier(
+            group[1], "checkpoint_commit/2", rank=1
+        )
+        exchanges = [group[0], killer]
+        cks = [TrainingCheckpointer(tmp_path / "sgck")
+               for _ in range(NUM_RANKS)]
+        journals = [
+            RunJournal(tmp_path / f"sg-journal-r{r}", rank=0)
+            for r in range(NUM_RANKS)
+        ]
+        coords = [
+            CoordinatedRecovery(
+                exchanges[r], max_restarts=2, checkpointer=cks[r],
+                journal=journals[r],
+            )
+            for r in range(NUM_RANKS)
+        ]
+        results, errors = self._run_per_rank(exchanges, cks, coords,
+                                             journals)
+        for j in journals:
+            j.close()
+        assert killer.state["fired"] == 1
+        assert errors == [None, None], errors
+        for r in range(NUM_RANKS):
+            np.testing.assert_array_equal(
+                np.asarray(results[r].state.fe_coefficients),
+                np.asarray(ref.state.fe_coefficients),
+            )
+            np.testing.assert_array_equal(
+                np.asarray(results[r].state.re_tables["user"]),
+                np.asarray(ref.state.re_tables["user"]),
+            )
+            np.testing.assert_array_equal(results[r].losses, ref.losses)
+        rows0 = _read_rows(tmp_path / "sg-journal-r0")
+        aborts0 = [r for r in rows0 if r.get("kind") == "peer_abort"]
+        assert aborts0 and aborts0[0]["origin_rank"] == 1
+
+
+# ---------------------------------------------------------------------------
+# shared restart budget
+# ---------------------------------------------------------------------------
+
+
+class TestSharedRestartBudget:
+    def test_flapping_rank_exhausts_job_budget_every_rank_attributed(
+            self, tmp_path):
+        """A rank that dies EVERY attempt burns the JOB's shared budget
+        (the agreed restart generation), not a per-process one: rank 0
+        never fails locally yet gives up at the same generation, and BOTH
+        ranks' run_failure journal rows name rank 1 + its cause."""
+        group = InProcessExchange.create_group(NUM_RANKS, timeout=5.0)
+        killer = faultinject.die_at_barrier(
+            group[1], "sweep", rank=1, times=None,  # flapping: every attempt
+        )
+        exchanges = [group[0], killer]
+        journals = [
+            RunJournal(tmp_path / f"journal-r{r}", rank=0)
+            for r in range(NUM_RANKS)
+        ]
+        coords = [
+            CoordinatedRecovery(exchanges[r], max_restarts=1,
+                                journal=journals[r])
+            for r in range(NUM_RANKS)
+        ]
+        attempts = [0, 0]
+        errors = [None, None]
+        before_giveups = rc.giveups()
+
+        def work(r):
+            def attempt(restart):
+                attempts[r] += 1
+                exchanges[r].barrier("sweep")  # rank 1 dies here, always
+                return "done"
+
+            try:
+                run_with_recovery(
+                    attempt, journal=journals[r], coordinator=coords[r],
+                    description=f"budget rank {r}",
+                )
+            except Exception as e:  # asserted below
+                errors[r] = e
+
+        _join_all([threading.Thread(target=work, args=(r,), daemon=True)
+                   for r in range(NUM_RANKS)], timeout=60.0)
+        for j in journals:
+            j.close()
+        # budget 1: attempt 0 fails -> one coordinated restart -> attempt
+        # 1 fails -> generation 2 > budget -> every rank gives up
+        assert attempts == [2, 2]
+        assert killer.state["fired"] == 2
+        assert isinstance(errors[0], PeerAbort)
+        assert errors[0].origin_rank == 1
+        assert errors[1] is not None and "preempted" in str(errors[1])
+        assert rc.giveups() >= before_giveups + 2
+        # the blamed rank is attributed IDENTICALLY from every journal
+        for r in range(NUM_RANKS):
+            rows = _read_rows(tmp_path / f"journal-r{r}")
+            failures = [x for x in rows if x.get("kind") == "run_failure"]
+            assert failures, f"rank {r} journaled no run_failure"
+            assert failures[-1]["origin_rank"] == 1
+            assert failures[-1]["origin_cause"]
+            assert failures[-1]["restarts_used"] == 2
+            assert failures[-1]["max_restarts"] == 1
+
+    def test_rendezvous_timeout_gives_up_attributed(self, tmp_path):
+        """A peer that is truly GONE (never restarts, never rendezvouses)
+        must end the job within two bounded deadlines — the healthy
+        rank's coordinated restart fails with an ExchangeTimeout, never a
+        hang."""
+        group = InProcessExchange.create_group(NUM_RANKS, timeout=0.3)
+        coord = CoordinatedRecovery(group[0], max_restarts=2)
+        error = {}
+
+        def work():
+            def attempt(restart):
+                group[0].barrier("sweep")  # rank 1 never arrives at all
+                return "done"
+
+            try:
+                run_with_recovery(attempt, coordinator=coord,
+                                  description="gone-peer")
+            except Exception as e:  # asserted below
+                error["e"] = e
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        t.join(10.0)
+        assert not t.is_alive(), "gone-peer recovery must stay bounded"
+        assert isinstance(error["e"], ExchangeTimeout)
+
+
+# ---------------------------------------------------------------------------
+# doctor / verdicts coverage
+# ---------------------------------------------------------------------------
+
+
+class TestDoctorCoordination:
+    def _write_journal(self, path, rows):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+
+    def test_cross_rank_table_and_restart_storm_named(self, tmp_path):
+        from dev.doctor import run_doctor
+
+        self._write_journal(str(tmp_path / "run-journal-r0.jsonl"), [
+            {"kind": "journal_open", "seq": 0, "ts": 1.0, "rank": 0},
+            {"kind": "peer_abort", "rank": 0, "origin_rank": 1,
+             "origin_cause": "RuntimeError('preempted')", "generation": 0},
+            {"kind": "coordinated_restart", "rank": 0, "generation": 1,
+             "restarts_used": 1, "max_restarts": 1, "step": 2,
+             "exhausted": False, "origin_rank": 1,
+             "origin_cause": "RuntimeError('preempted')"},
+            {"kind": "coordinated_restart", "rank": 0, "generation": 2,
+             "restarts_used": 2, "max_restarts": 1, "step": 0,
+             "exhausted": True, "origin_rank": 1,
+             "origin_cause": "RuntimeError('preempted')"},
+            {"kind": "run_failure", "origin_rank": 1,
+             "origin_cause": "RuntimeError('preempted')",
+             "restarts_used": 2, "max_restarts": 1, "error": "PeerAbort"},
+            {"kind": "journal_close"},
+        ])
+        self._write_journal(str(tmp_path / "run-journal-r1.jsonl"), [
+            {"kind": "journal_open", "seq": 0, "ts": 1.0, "rank": 1},
+            {"kind": "abort_written", "rank": 1, "generation": 0,
+             "cause": "RuntimeError('preempted')", "kind_": "preemption"},
+            {"kind": "coordinated_restart", "rank": 1, "generation": 1,
+             "restarts_used": 1, "max_restarts": 1, "step": 2,
+             "exhausted": False, "origin_rank": 1,
+             "origin_cause": "RuntimeError('preempted')"},
+            {"kind": "journal_close"},
+        ])
+        code, findings, text = run_doctor(str(tmp_path))
+        assert "coordinated recovery" in text
+        assert "rank 0" in text and "rank 1" in text
+        storm = [f for f in findings if f.rule == "restart-storm"]
+        assert storm, text
+        assert "rank 1" in storm[0].detail
+        table = [f for f in findings
+                 if f.rule == "cross-rank-restart-table"]
+        assert table and "restarts=2" in table[0].detail
+
+    def test_live_prints_last_abort_marker(self, tmp_path):
+        from dev.doctor import run_doctor
+
+        self._write_journal(
+            str(tmp_path / "run-journal.jsonl.partial"), [
+                {"kind": "journal_open", "seq": 0, "ts": 1.0, "rank": 0},
+                {"kind": "peer_abort", "rank": 0, "origin_rank": 1,
+                 "origin_cause": "RuntimeError('preempted')",
+                 "generation": 3},
+            ],
+        )
+        code, findings, text = run_doctor(str(tmp_path), live=True)
+        assert "last abort marker" in text
+        assert "origin_rank=1" in text
+        assert "generation=3" in text
+        # a finalized-journal pass does NOT print it
+        code2, _, text2 = run_doctor(str(tmp_path), live=False)
+        assert "last abort marker" not in text2

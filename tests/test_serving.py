@@ -418,6 +418,56 @@ class TestServeDriver:
             got = np.concatenate([f.result(30) for f in futures])
         assert np.array_equal(got, ref)
 
+    def test_swap_poll_continuous_applies_and_rejects_typed(
+            self, trained, tmp_path):
+        """ROADMAP item 2 rider (ISSUE 15 satellite): --swap-poll-ms
+        watches --swap-model-dir for atomically-renamed model dirs and
+        hot-swaps each continuously through the guarded swap API; an
+        unloadable publish is rejected TYPED (model_swap journal row) and
+        the replay keeps serving — zero dropped requests either way."""
+        import json
+        import os
+        import shutil
+
+        from photon_ml_tpu.cli import serve_driver
+
+        watch = tmp_path / "watch"
+        os.makedirs(watch)
+        # the atomic-rename publish discipline: stage under tmp.*, rename
+        staged = watch / "tmp.m1"
+        shutil.copytree(trained / "out" / "best", staged)
+        os.rename(staged, watch / "model-0001")
+        # a bad publish (no model files) — must reject typed, keep serving
+        os.makedirs(watch / "model-0002")
+        out = tmp_path / "serve"
+        s = serve_driver.run(
+            requests_avro=str(trained / "req"),
+            model_input_dir=str(trained / "out" / "best"),
+            output_dir=str(out),
+            microbatch_shapes="32,128",
+            request_rows=4,
+            max_wait_ms=5,
+            skip_unbatched_baseline=True,
+            swap_model_dir=str(watch),
+            swap_poll_ms=5,
+            telemetry_dir=str(out / "telemetry"),
+        )
+        assert s["num_rows"] == 120  # every request served
+        assert s["swap"]["mode"] == "poll"
+        assert "model-0001" in s["swap"]["applied"]
+        rejected = {r["dir"] for r in s["swap"]["rejected"]}
+        assert "model-0002" in rejected
+        rows = []
+        for f in os.listdir(out / "telemetry"):
+            if f.endswith(".jsonl"):
+                with open(out / "telemetry" / f) as fh:
+                    rows += [json.loads(line) for line in fh]
+        swaps = [r for r in rows if r.get("kind") == "model_swap"]
+        assert {(r["dir"], r["applied"]) for r in swaps} >= {
+            ("model-0001", True), ("model-0002", False)
+        }
+        assert all("error" in r for r in swaps if not r["applied"])
+
     def test_rejects_bad_shapes_and_rows(self, trained, tmp_path):
         from photon_ml_tpu.cli import serve_driver
 
