@@ -134,46 +134,43 @@ def write_sidecar(report: dict, directory: str, *, config: dict | None = None):
 
 
 def _unit_primary(lane_iters: int, grid_sec: float) -> str:
-    return (
-        f"ex*it/s {GRID}lam n=2^18 d={D} "
-        f"{lane_iters}it {grid_sec:.0f}s"
-    )
+    # config prose (n, d, grid seconds) lives in the sidecar config and
+    # BASELINE.md — the line budget spends on the lane-iteration count
+    del grid_sec
+    return f"ex*it/s {GRID}lam {lane_iters}it"
 
 
 def _unit_stream() -> str:
-    # "sr" = same-run throughout the unit grammar
-    return f"sr cal roof{HBM_ROOFLINE_GBPS:.0f}"
+    # same-run calibration probe; the row key names it, roof = v5e roofline
+    return f"roof{HBM_ROOFLINE_GBPS:.0f}"
 
 
 def _unit_hot_loop(note: str, frac: float) -> str:
-    # ms/eval is derivable: value is GB/s over the known [n, d] pass
-    return f"{note} {frac:.2f}xcal"
+    # the metric key already names the variant (the HOT_LOOP_NOTES prose
+    # lives in BASELINE.md); ms/eval is derivable from GB/s over [n, d]
+    del note
+    return f"{frac:.2f}xcal"
 
 
 def _unit_sweep(newton: bool) -> str:
-    if newton:
-        return "ms/sw Newt REs"
-    return "ms/sw FE 2REs 10it"
+    return "ms/sw Newt" if newton else "ms/sw FE"
 
 
 def _unit_sweep_scheduled() -> str:
     # compare against fused_game_sweep_ms from the SAME run only (the
     # calibration discipline); includes the scheduler's host reads
-    return "ms/sw sched ftol1e-6"
+    return "ms/sw sched"
 
 
 def _unit_sweep_composed(ell_ms: float, cov: float) -> str:
     # compare against the embedded same-run ELL+unscheduled sweep only
     # (the calibration discipline); one Zipfian dataset, two configs
-    return (
-        f"ms/sw zipf hot256 cov{cov:.2f} "
-        f"ELLunsr {ell_ms:.0f}"
-    )
+    return f"ms/sw cov{cov:.2f} ELLunsr {ell_ms:.0f}"
 
 
 def _unit_sparse_1e7(ms_per_iter: float) -> str:
     return (
-        f"nnz*it/s d=1e7 ELL {ms_per_iter:.1f}ms/it"
+        f"nnz*it/s d=1e7 {ms_per_iter:.1f}ms/it"
     )
 
 
@@ -181,16 +178,14 @@ def _unit_sparse_hybrid(ell_ms: float, cov: float, k_hot: int) -> str:
     # compare against the embedded same-run ELL ms/it only (the calibration
     # discipline): same Zipfian data, same process, fractional comparison
     return (
-        f"ms/it zipf hot{k_hot} "
+        f"ms/it hot{k_hot} "
         f"cov{cov:.2f} ELLsr {ell_ms:.0f}"
     )
 
 
 def _unit_sparse_1e8(entry_iters_m: float) -> str:
-    return (
-        f"ms/TRON-it d=1e8 hyb hot512 "
-        f"{entry_iters_m:.1f}M eit/s"
-    )
+    del entry_iters_m  # derivable from the row value; budget-trimmed
+    return "ms/TRON-it d=1e8 hot512"
 
 
 def _unit_stream_game(visits_d: int, visits_u: int, sweeps_d: int,
@@ -202,6 +197,16 @@ def _unit_stream_game(visits_d: int, visits_u: int, sweeps_d: int,
         f"ms/sw v{visits_d}/{visits_u} "
         f"sw{sweeps_d}/{sweeps_u} OFF{off_ms:.0f}"
     )
+
+
+def _unit_stream_game_ranks(rank_mb: float, input_mb: float,
+                            one_rank_ms: float) -> str:
+    # compare against the embedded same-run single-rank sweep ms only (the
+    # calibration discipline); rb = max per-rank decoded bytes / global
+    # input bytes — the partitioned-read evidence (each rank must decode
+    # STRICTLY less than the whole input; wall-clock on virtual ranks is
+    # thread-serialized and never the win criterion)
+    return f"ms/sw rb{rank_mb:.2f}/{input_mb:.2f}MB 1rk{one_rank_ms:.0f}"
 
 
 def _unit_refresh(lanes_solved: int, lanes_total: int, full_ms: float) -> str:
@@ -251,7 +256,9 @@ def sample_report() -> dict:
     streaming ms rows 1e4 (10 s/epoch vs ~3 s worst observed), serving
     rows 1e6 sc/s / 1e4 ms p95 (three decades above the tunnel's
     dispatch-bound reality), refresh lane pairs 4 digits (the bench
-    fixture has 256 entities)."""
+    fixture has 256 entities), partitioned-read MB pairs 99.99 (the ranks
+    fixture is a fixed ~0.2 MB synthetic — byte counts are deterministic,
+    not chip-lottery-scaled)."""
     rate, rate_sp = 999999999.9, [999999999.9, 999999999.9]
     gbps, gbps_sp = 9999.9, [9999.9, 9999.9]
     ms, ms_sp = 9999.9, [9999.9, 9999.9]
@@ -282,6 +289,8 @@ def sample_report() -> dict:
              _unit_stream_chunked(9999, 9.99, 99)),
         _row("stream_game_duhl", ms, ms_sp,
              _unit_stream_game(9999, 9999, 99, 99, 9999.4)),
+        _row("stream_game_ranks", ms, ms_sp,
+             _unit_stream_game_ranks(99.99, 99.99, 9999.4)),
         _row("serve_microbatch", sc, sc_sp,
              _unit_serve(9999.4, 999999.9)),
         _row("refresh_incremental", ms, ms_sp,
@@ -1147,6 +1156,145 @@ def bench_stream_game_duhl() -> dict:
     )
 
 
+def bench_stream_game_ranks() -> dict:
+    """Multi-rank partitioned streamed GAME (ISSUE 17): two virtual ranks
+    (threads + InProcessExchange) agree one entity-granular chunk plan over
+    the exchange, then run the composed per-rank sweep — FE partial sums
+    combined in rank order, rank-local RE bucket solves, post-sweep table
+    sync. Row value is the two-rank wall ms/sweep, but on virtual ranks the
+    threads serialize on one host so wall-clock is NOT the win criterion:
+    the unit embeds the deterministic partitioned-read evidence — max
+    per-rank decoded payload bytes vs the global input bytes (rb pair;
+    each rank must decode STRICTLY less than the whole input) — plus the
+    same-run single-rank streamed sweep ms for scale."""
+    import tempfile
+    import threading
+
+    from photon_ml_tpu.algorithm.streaming_game import StreamingGameProgram
+    from photon_ml_tpu.io import avro as avro_io
+    from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
+    from photon_ml_tpu.io.stream_reader import (
+        GameAvroChunkSource,
+        plan_partitioned_game_stream,
+        scan_game_stream,
+    )
+    from photon_ml_tpu.optim.optimizer import OptimizerConfig
+    from photon_ml_tpu.parallel.distributed import (
+        FixedEffectStepSpec,
+        RandomEffectStepSpec,
+    )
+    from photon_ml_tpu.parallel.multihost import InProcessExchange
+    from photon_ml_tpu.types import TaskType
+
+    num_ranks, chunk_records, sweeps = 2, 64, 2
+    rng = np.random.default_rng(29)
+    n, d, n_users = 512, 8, 16
+    users = np.sort(rng.integers(0, n_users, size=n))
+    schema = {
+        "type": "record", "name": "TrainingExampleAvro",
+        "fields": [
+            {"name": "label", "type": "double"},
+            {"name": "userId", "type": ["string", "null"], "default": None},
+            {"name": "features", "type": {"type": "array", "items": {
+                "type": "record", "name": "FeatureAvro", "fields": [
+                    {"name": "name", "type": "string"},
+                    {"name": "term", "type": ["string", "null"],
+                     "default": None},
+                    {"name": "value", "type": "double"},
+                ]}}},
+        ],
+    }
+    records = []
+    for i in range(n):
+        x = rng.normal(size=d)
+        records.append({
+            "label": float(x.sum() + 0.1 * rng.normal()),
+            "userId": f"u{users[i]:02d}",
+            "features": [
+                {"name": f"f{j}", "term": "", "value": float(x[j])}
+                for j in range(d)
+            ],
+        })
+    tmp = tempfile.mkdtemp(prefix="bench_ranks_")
+    avro_io.write_container(
+        os.path.join(tmp, "part-00000.avro"), schema, records,
+        block_records=32,
+    )
+    cfg = {"global": FeatureShardConfiguration(feature_bags=("features",))}
+    opt = OptimizerConfig(max_iterations=4)
+
+    def program(source, vocabs, *, partition=None, exchange=None):
+        return StreamingGameProgram(
+            TaskType.LINEAR_REGRESSION, source,
+            FixedEffectStepSpec("global", opt, l2_weight=0.1),
+            (RandomEffectStepSpec("userId", "global", opt, l2_weight=1.0),),
+            num_entities={"userId": len(vocabs["userId"])},
+            exchange=exchange, partition=partition,
+        )
+
+    # same-run single-rank streamed baseline (the pre-ISSUE-17 path)
+    files = avro_io.list_avro_files(tmp)
+    maps, vocabs, keys, indexes, _scalars = scan_game_stream(
+        files, cfg, ("userId",), cluster_by="userId"
+    )
+
+    def single_source():
+        return GameAvroChunkSource(
+            files, cfg, maps, chunk_records=chunk_records,
+            random_effect_id_columns=("userId",), entity_vocabs=vocabs,
+            cluster_by="userId", cluster_keys=keys, indexes=indexes,
+        )
+
+    program(single_source(), vocabs).train(num_sweeps=1)  # warm signatures
+    t0 = time.perf_counter()
+    program(single_source(), vocabs).train(num_sweeps=sweeps)
+    one_rank_ms = (time.perf_counter() - t0) * 1e3 / sweeps
+
+    partitions = [None] * num_ranks
+
+    def rank_run(group, r):
+        source, _maps, vocs, part = plan_partitioned_game_stream(
+            tmp, cfg, ("userId",), exchange=group[r],
+            chunk_records=chunk_records, cluster_by="userId",
+        )
+        partitions[r] = part
+        program(source, vocs, partition=part,
+                exchange=group[r]).train(num_sweeps=sweeps)
+
+    def once():
+        group = InProcessExchange.create_group(num_ranks, timeout=120.0)
+        errs = [None] * num_ranks
+
+        def work(r):
+            try:
+                rank_run(group, r)
+            except Exception as e:
+                errs[r] = e
+                raise
+
+        threads = [threading.Thread(target=work, args=(r,), daemon=True)
+                   for r in range(num_ranks)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300.0)
+        if any(t.is_alive() for t in threads) or any(errs):
+            raise RuntimeError(f"partitioned rank failure: {errs}")
+        return (time.perf_counter() - t0) * 1e3 / sweeps
+
+    once()  # warm the partitioned signatures outside the timings
+    ms, sp = median_spread(once)
+    part = partitions[0]
+    return _row(
+        "stream_game_ranks", round(ms, 1), [round(s, 1) for s in sp],
+        _unit_stream_game_ranks(
+            max(part.payload_bytes) / 1e6, part.input_bytes / 1e6,
+            one_rank_ms,
+        ),
+    )
+
+
 def bench_serve_microbatch() -> dict:
     """Resident-scorer serving throughput (ISSUE 10): scores/sec through
     the micro-batching loop at the replay's p95 request latency, with the
@@ -1384,6 +1532,7 @@ def main():
     extra.append(bench_sparse_fe_1e8())
     extra.append(bench_stream_fe_chunked())
     extra.append(bench_stream_game_duhl())
+    extra.append(bench_stream_game_ranks())
     extra.append(bench_serve_microbatch())
     extra.append(bench_refresh_incremental())
     cpu_rate = bench_cpu_scipy(x[:CPU_SUBSAMPLE], y[:CPU_SUBSAMPLE])

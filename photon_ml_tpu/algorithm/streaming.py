@@ -91,6 +91,16 @@ def _unpack_f64(s: str) -> np.ndarray:
     return np.frombuffer(base64.b64decode(s), dtype="<f8")
 
 
+def _pack_i64(a: np.ndarray) -> str:
+    return base64.b64encode(
+        np.ascontiguousarray(a, dtype="<i8").tobytes()
+    ).decode("ascii")
+
+
+def _unpack_i64(s: str) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(s), dtype="<i8")
+
+
 class StreamingGLMObjective:
     """A GLM objective whose every evaluation is one chunked epoch.
 

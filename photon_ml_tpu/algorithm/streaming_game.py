@@ -70,7 +70,13 @@ from photon_ml_tpu.algorithm.coordinates import (
     _mask_padding_lanes,
     _solve_bucket_entities,
 )
-from photon_ml_tpu.algorithm.streaming import StreamingGLMObjective
+from photon_ml_tpu.algorithm.streaming import (
+    StreamingGLMObjective,
+    _pack_f64,
+    _pack_i64,
+    _unpack_f64,
+    _unpack_i64,
+)
 from photon_ml_tpu.data.batch import LabeledPointBatch, solve_dtype_of
 from photon_ml_tpu.data.game_data import (
     group_entities_into_buckets,
@@ -78,6 +84,8 @@ from photon_ml_tpu.data.game_data import (
 )
 from photon_ml_tpu.io.checkpoint import commit_checkpoint, fingerprint_mismatch
 from photon_ml_tpu.io.stream_reader import (
+    DEFAULT_CHUNK_TIMEOUT,
+    ChunkPrefetcher,
     ChunkSpec,
     GameChunk,
     entities_spanning_chunks,
@@ -466,6 +474,7 @@ class StreamingGameProgram:
         prefetch: bool = True,
         mesh=None,
         exchange=None,
+        partition=None,
         retry_policy=None,
         scalars: Mapping[str, object] | None = None,
     ):
@@ -502,6 +511,38 @@ class StreamingGameProgram:
         self.prefetch = bool(prefetch)
         self.mesh = mesh
         self.exchange = exchange
+        # ISSUE 17: the exchange-agreed multi-rank plan. None (or a
+        # 1-rank partition) keeps every single-rank path bitwise — the
+        # chunk-id mapping below degenerates to the identity and no
+        # cross-rank exchange op runs. An exchange WITHOUT a partition is
+        # the ISSUE 15 wiring (checkpoint barriers only) and must stay
+        # exactly that: cross-rank sums are keyed off the partition, never
+        # off exchange presence.
+        self.partition = partition
+        self._multi_rank = partition is not None and partition.num_ranks > 1
+        if self._multi_rank and exchange is None:
+            raise ValueError(
+                "a multi-rank GameStreamPartition needs the exchange it "
+                "was agreed over (pass exchange=)"
+            )
+        if partition is not None:
+            self._chunk_lo, self._chunk_hi = partition.chunk_range()
+            self._num_chunks_global = int(partition.num_chunks)
+        else:
+            self._chunk_lo, self._chunk_hi = 0, source.num_chunks
+            self._num_chunks_global = int(source.num_chunks)
+        if self._multi_rank:
+            missing = [
+                s.re_type for s in self.re_specs
+                if s.re_type not in self.num_entities
+            ]
+            if missing:
+                raise ValueError(
+                    f"partitioned streamed GAME needs explicit num_entities "
+                    f"for {missing} — each rank sees only its local "
+                    "entities, so table sizes must come from the agreed "
+                    "global vocabs (num_entities={t: len(vocabs[t])})"
+                )
         self.retry_policy = retry_policy
         self._cache = _ChunkCache(source)
         if mesh is not None:
@@ -543,6 +584,8 @@ class StreamingGameProgram:
         self._scalars_arg = scalars
         self._scan_scalars()
         self._verify_clustering()
+        if self._multi_rank:
+            self._verify_rank_entity_partition()
 
     # -- one-time host scans --------------------------------------------------
 
@@ -695,6 +738,47 @@ class StreamingGameProgram:
                     "input by it, or train this coordinate in-core."
                 )
 
+    def _verify_rank_entity_partition(self) -> None:
+        """The multi-rank twin of :meth:`_verify_clustering`: every RE
+        entity's rows must co-reside on ONE rank (whole-chunk assignment
+        guarantees it for the cluster column; other RE types could still
+        straddle the rank boundary). An overlap would let two ranks solve
+        the same entity on partial data and the rank-order table sync
+        silently keep the last writer — fail fast instead. One allgather
+        of each rank's present entity rows (model-sized, like the vocab
+        agreement)."""
+        if not self.re_specs:
+            return
+        payload = {}
+        for s in self.re_specs:
+            idx = self.entity_idx[s.re_type]
+            payload[s.re_type] = _pack_i64(
+                np.unique(idx[idx >= 0]).astype(np.int64)
+            )
+        gathered = self.exchange.allgather(
+            "stream_game/entity_partition", payload
+        )
+        for s in self.re_specs:
+            per_rank = [_unpack_i64(g[s.re_type]) for g in gathered]
+            ids, counts = np.unique(
+                np.concatenate(per_rank), return_counts=True
+            )
+            overlap = ids[counts > 1]
+            if len(overlap):
+                owners = [
+                    r for r, present in enumerate(per_rank)
+                    if np.isin(overlap[:5], present).any()
+                ]
+                raise ValueError(
+                    f"random-effect coordinate '{s.re_type}': "
+                    f"{len(overlap)} entities have rows on more than one "
+                    f"rank (e.g. vocab rows {overlap[:5].tolist()} on ranks "
+                    f"{owners}) — a per-rank solve would train them on "
+                    "partial data. Sort the input by the cluster column, "
+                    "nest this type inside it, or train this coordinate "
+                    "in-core."
+                )
+
     # -- state / scores -------------------------------------------------------
 
     def init_state(self) -> GameTrainState:
@@ -794,6 +878,14 @@ class StreamingGameProgram:
             view, self._loss,
             l2_weight=self.fe.l2_weight,
             mesh=self.mesh,
+            # multi-rank: per-rank partial value/grad/Hv summed IN RANK
+            # ORDER through the exchange every epoch (the PR 7 accumulator
+            # rule) — every rank evaluates the identical global objective,
+            # so the host-loop solver takes identical steps on every rank.
+            # Keyed off the PARTITION, never off exchange presence: a
+            # coordinated-recovery exchange on a full program must not
+            # double-count (each such rank already streams ALL chunks).
+            exchange=self.exchange if self._multi_rank else None,
             prefetch=self.prefetch,
             retry_policy=self.retry_policy,
         )
@@ -936,8 +1028,23 @@ class StreamingGameProgram:
         margins = self._residual(scores)
         losses = self._loss.loss(jnp.asarray(margins),
                                  jnp.asarray(self.labels))
-        wsum = max(float(self.weights.sum()), 1.0)
-        return float(jnp.sum(jnp.asarray(self.weights) * losses)) / wsum
+        wloss = float(jnp.sum(jnp.asarray(self.weights) * losses))
+        wsum = float(self.weights.sum())
+        if self._multi_rank:
+            # rank-order f64 sum of (Σw·loss, Σw) — the loss every rank
+            # reports (and plateau-stops on) is the GLOBAL training loss,
+            # identical on every rank
+            gathered = self.exchange.allgather(
+                "stream_game/loss", {"acc": _pack_f64(
+                    np.array([wloss, wsum], np.float64)
+                )}
+            )
+            wloss, wsum = 0.0, 0.0
+            for g in gathered:  # rank order — the exchange contract
+                part = _unpack_f64(g["acc"])
+                wloss += float(part[0])
+                wsum += float(part[1])
+        return wloss / max(wsum, 1.0)
 
     def _chunk_residual_local(self, scores, rows, m, skip) -> np.ndarray:
         """The CD residual for ONE chunk's rows, in chunk-local
@@ -976,6 +1083,7 @@ class StreamingGameProgram:
         # (per-coordinate record() calls would let the last coordinate
         # overwrite the others' signal)
         chunk_importance: dict[int, float] = {}
+        updated_rows: dict[str, set] = {name: set() for name in re_names}
         for chunk_index in visit:
             spec = self.source.specs[chunk_index]
             chunk = self._cache.get(chunk_index)
@@ -993,12 +1101,81 @@ class StreamingGameProgram:
                     self._refresh_re_scores_chunk(
                         scores, name, tables[name], chunk, spec
                     )
+                if self._multi_rank:
+                    idx = chunk.entity_idx[name][:spec.num_records]
+                    updated_rows[name].update(
+                        np.unique(idx[idx >= 0]).tolist()
+                    )
                 chunk_importance[chunk_index] = (
                     chunk_importance.get(chunk_index, 0.0) + importance
                 )
-        for chunk_index, importance in chunk_importance.items():
-            self.schedule.record(chunk_index, importance)
+        # the schedule speaks GLOBAL chunk ids (identical state on every
+        # rank); local chunk k is global k + chunk_lo (identity when
+        # unpartitioned)
+        importance_global = {
+            ci + self._chunk_lo: imp for ci, imp in chunk_importance.items()
+        }
+        if self._multi_rank:
+            tables = self._sync_re_tables(tables, updated_rows)
+            importance_global = self._merge_importance(importance_global)
+        for chunk_index in sorted(importance_global):
+            self.schedule.record(chunk_index, importance_global[chunk_index])
         return GameTrainState(fe_coefficients=fe_w, re_tables=tables)
+
+    def _sync_re_tables(self, tables, updated_rows):
+        """Rank-order merge of this sweep's RE table updates: each rank
+        ships only the (row, value) pairs its chunks touched; every rank
+        applies every rank's rows in rank order. Rows partition across
+        ranks (whole-entity chunk assignment, verified at build time), so
+        the merge is EXACT — after it, every rank holds the identical
+        global tables, which is what lets the rank-0-gated checkpoint
+        commit and the final model stay complete on every rank. The
+        f32→f64→f32 round trip through the exchange is value-exact."""
+        payload = {}
+        for name, rows in updated_rows.items():
+            rows_arr = np.asarray(sorted(rows), np.int64)
+            vals = np.asarray(tables[name])[rows_arr]
+            payload[name] = {
+                "rows": _pack_i64(rows_arr),
+                "vals": _pack_f64(vals.ravel()),
+            }
+        with tracing.span("stream_game/re_sync", cat="stream"):
+            gathered = self.exchange.allgather("stream_game/re_sync", payload)
+        out = {}
+        for name, table in tables.items():
+            if name not in payload:
+                out[name] = table
+                continue
+            merged = np.asarray(table).copy()
+            width = merged.shape[1]
+            for g in gathered:  # rank order — the exchange contract
+                rows_arr = _unpack_i64(g[name]["rows"])
+                if len(rows_arr) == 0:
+                    continue
+                vals = _unpack_f64(g[name]["vals"]).reshape(-1, width)
+                merged[rows_arr] = vals.astype(merged.dtype)
+            out[name] = jnp.asarray(merged)
+        return out
+
+    def _merge_importance(self, importance_global):
+        """ONE allgathered DuHL importance signal (arXiv:2004.02414's
+        nonrandom-partition fix): every rank sees every chunk's importance
+        before any schedule records it, so pin/evict decisions are a pure
+        function of the same global signal on every rank — rank-local
+        ranking is the measured 12-vs-8-sweeps footgun. Chunk-id keys are
+        disjoint across ranks (each rank visits only its own range)."""
+        payload = {
+            "imp": {str(ci): float(v) for ci, v in importance_global.items()}
+        }
+        with tracing.span("stream_game/duhl_importance", cat="stream"):
+            gathered = self.exchange.allgather(
+                "stream_game/duhl_importance", payload
+            )
+        merged: dict[int, float] = {}
+        for g in gathered:  # rank order (keys disjoint; order is for form)
+            for ci, v in g["imp"].items():
+                merged[int(ci)] = float(v)
+        return merged
 
     # -- checkpoint plumbing --------------------------------------------------
 
@@ -1038,9 +1215,31 @@ class StreamingGameProgram:
                 for s in self.re_specs
             ],
             "bucket_sizes": list(self.bucket_sizes),
-            "num_chunks": int(self.source.num_chunks),
-            "chunk_rows": int(self.source.chunk_rows),
-            "total_records": int(self.source.total_records),
+            # GLOBAL geometry when partitioned — every rank's fingerprint
+            # must be identical (rank 0 saves, every rank compares on
+            # restore), and a restore under different rank geometry must
+            # fail fast naming "partition"
+            "num_chunks": int(
+                self.partition.num_chunks if self.partition is not None
+                else self.source.num_chunks
+            ),
+            "chunk_rows": int(
+                self.partition.chunk_rows if self.partition is not None
+                else self.source.chunk_rows
+            ),
+            "total_records": int(
+                self.partition.total_records if self.partition is not None
+                else self.source.total_records
+            ),
+            "partition": (
+                None if self.partition is None else {
+                    "num_ranks": int(self.partition.num_ranks),
+                    "chunk_ranges": [
+                        list(r) for r in self.partition.chunk_ranges
+                    ],
+                    "plan": self.partition.fingerprint,
+                }
+            ),
             # input IDENTITY, not just geometry: a daily re-run against
             # regenerated data of the same shape must fail fast, never
             # resume the old run's state (file-backed sources only)
@@ -1116,7 +1315,7 @@ class StreamingGameProgram:
         rollback; 0 = restart from scratch, None = newest intact).
         """
         if self.schedule is None:
-            self.schedule = UniformChunkSchedule(self.source.num_chunks)
+            self.schedule = UniformChunkSchedule(self._num_chunks_global)
         fingerprint = self._fingerprint()
         start_sweep = 0
         losses: list[float] = []
@@ -1149,8 +1348,18 @@ class StreamingGameProgram:
         )
         chunk_visits = 0
         for sweep in range(start_sweep, num_sweeps):
-            self._cache.set_pinned(self.schedule.pinned())
-            visit = self.schedule.plan_sweep()
+            # the schedule plans in GLOBAL chunk ids (identical state on
+            # every rank — the DuHL working set is a pure function of the
+            # allgathered signal); each rank executes only its own range,
+            # converted to local ids (identity when unpartitioned)
+            self._cache.set_pinned({
+                g - self._chunk_lo for g in self.schedule.pinned()
+                if self._chunk_lo <= g < self._chunk_hi
+            })
+            visit = [
+                g - self._chunk_lo for g in self.schedule.plan_sweep()
+                if self._chunk_lo <= g < self._chunk_hi
+            ]
             chunk_visits += len(visit) * len(self.re_specs)
             with tracing.span("stream_game/sweep", cat="stream",
                               sweep=sweep, chunks=len(visit)):
@@ -1223,3 +1432,96 @@ class StreamingGameProgram:
             chunk_loads=self._cache.loads,
             chunk_visits=chunk_visits,
         )
+
+
+# ---------------------------------------------------------------------------
+# Streamed validation scoring (ISSUE 17 rider)
+# ---------------------------------------------------------------------------
+
+
+def score_game_stream(
+    state: GameTrainState,
+    source,
+    task: TaskType,
+    fe_feature_shard_id: str,
+    re_feature_shards: "Mapping[str, str]",
+    *,
+    prefetch: bool = True,
+    retry_policy=None,
+    chunk_timeout: float = DEFAULT_CHUNK_TIMEOUT,
+    return_scalars: bool = False,
+) -> np.ndarray:
+    """Score a held-out dataset chunk-wise against a streamed GAME model —
+    the out-of-core twin of ``GameModel.score_dataset(ds) + ds.offsets``
+    (the driver's validation semantics, estimators.GameEstimator.fit):
+    per chunk, the FE margin plus every RE coordinate's score plus the
+    chunk's offsets, scattered into an [n] host vector through the SAME
+    module-level jitted steps the training sweeps use (so the streamed
+    scores match the in-core path to float round-off; O(n·d) features only
+    ever exist one chunk at a time). The validation source must be built
+    with the TRAINING index maps and entity vocabs — entities unseen in
+    training carry index -1 and score 0, exactly like the in-core build.
+
+    ``re_feature_shards`` maps each RE type in ``state.re_tables`` to the
+    feature shard its coordinate scores (RandomEffectStepSpec
+    .feature_shard_id). Single-rank: each rank scores only the chunks its
+    source holds. ``return_scalars=True`` additionally returns the [n]
+    evaluation scalars ({labels, offsets, weights}) collected from the
+    same decode pass — what a validation evaluator needs, without a
+    second pass over the input.
+    """
+    missing = [t for t in state.re_tables if t not in re_feature_shards]
+    if missing:
+        raise ValueError(
+            f"re_feature_shards is missing shard assignments for {missing}"
+        )
+    objective = GLMObjective(loss_for_task(task), 0.0, use_pallas=False)
+    n = source.total_records
+    dtype = solve_dtype_of(np.dtype(source.dtype))
+    scores = np.zeros(n, dtype)
+    scalars = (
+        {k: np.zeros(n, dtype) for k in ("labels", "offsets", "weights")}
+        if return_scalars else None
+    )
+    starts = getattr(source, "record_starts", None)
+    with tracing.span("stream_game/score", cat="stream",
+                      chunks=source.num_chunks):
+        with ChunkPrefetcher(
+            source, prefetch=prefetch, retry_policy=retry_policy,
+            chunk_timeout=chunk_timeout,
+        ) as chunks:
+            for spec, chunk in zip(source.specs, chunks):
+                m = chunk.num_records
+                total = np.asarray(_fe_margin_chunk(
+                    state.fe_coefficients,
+                    {"features": chunk.features[fe_feature_shard_id]},
+                    objective=objective,
+                ), dtype)
+                for re_type, table in state.re_tables.items():
+                    batch = {
+                        "features":
+                            chunk.features[re_feature_shards[re_type]],
+                        "entity_idx": chunk.entity_idx[re_type],
+                    }
+                    total = total + np.asarray(
+                        _re_score_chunk(table, batch), dtype
+                    )
+                total = total + np.asarray(chunk.offsets, dtype)
+                if getattr(chunk, "rows", None) is not None:
+                    rows = np.asarray(chunk.rows[:m])
+                elif starts is not None:
+                    rows = np.arange(starts[spec.index],
+                                     starts[spec.index] + m)
+                else:
+                    raise ValueError(
+                        "the validation chunk source carries neither row "
+                        "ids nor record starts — scores cannot be placed"
+                    )
+                scores[rows] = total[:m]
+                if scalars is not None:
+                    scalars["labels"][rows] = chunk.labels[:m]
+                    scalars["offsets"][rows] = chunk.offsets[:m]
+                    scalars["weights"][rows] = chunk.weights[:m]
+    if return_scalars:
+        return scores, scalars
+    return scores

@@ -337,9 +337,10 @@ class GameTrainingParams:
             problems.append(str(e))
 
     def _validate_streaming(self, problems: list) -> None:
-        """The streamed-GAME surface (ISSUE 11): one dense primary FE +
-        IDENTITY random effects over an entity-sorted Avro input,
-        single-process. Everything outside it fails fast here with the
+        """The streamed-GAME surface (ISSUE 11 + 17): one dense primary FE
+        + IDENTITY random effects over an entity-sorted Avro input —
+        single-process, or multi-rank via --partitioned-io (the ISSUE 17
+        composition). Everything outside it fails fast here with the
         composing alternative named (lint check 8)."""
         if self.input_format != "avro":
             problems.append(
@@ -352,23 +353,32 @@ class GameTrainingParams:
                 "--streaming-chunks streams one input directory; drop "
                 "--input-date-range (pass the resolved daily dir directly)"
             )
-        if self.distributed or self.mesh_shape or self.partitioned_io:
+        if self.validation_data_date_range:
             problems.append(
-                "--streaming-chunks is the single-process out-of-core GAME "
-                "path; drop --distributed/--mesh/--partitioned-io (the "
-                "multi-process streamed GAME is a later issue)"
+                "--streaming-chunks streams one validation directory; drop "
+                "--validation-data-date-range (pass the resolved dir "
+                "directly)"
+            )
+        if self.distributed or self.mesh_shape:
+            problems.append(
+                "--streaming-chunks is the host-loop out-of-core GAME "
+                "path; drop --distributed/--mesh (for multi-process "
+                "streamed GAME use --partitioned-io, which partitions "
+                "chunks across ranks instead of meshing devices)"
             )
         if self.normalization != NormalizationType.NONE:
             problems.append(
                 "--streaming-chunks trains un-normalized; use "
                 "--normalization NONE or run in-core"
             )
-        if self.validation_data_path or self.evaluators:
-            problems.append(
-                "--streaming-chunks has no validation pass yet; drop "
-                "--validation-data-path/--evaluators and score with the "
-                "scoring driver"
-            )
+        for spec in self.evaluators:
+            if ":" in str(spec):
+                problems.append(
+                    f"evaluator '{spec}': per-query evaluators need "
+                    "evaluation id columns the chunk stream does not "
+                    "decode; use a global evaluator or score with the "
+                    "scoring driver"
+                )
         if self.hyperparameter_tuning != HyperparameterTuningMode.NONE:
             problems.append(
                 "--streaming-chunks trains one configuration; drop "
@@ -493,13 +503,17 @@ def run(params: GameTrainingParams) -> dict:
         # outputs belong to process 0 — workers write into a scratch
         # subdirectory. The checkpoint directory stays SHARED: all processes
         # restore from it, train_distributed writes it from process 0 only.
-        if not (params.distributed or params.mesh_shape):
+        if not (
+            params.distributed or params.mesh_shape
+            or (params.streaming_chunks > 0 and params.partitioned_io)
+        ):
             # the host-loop CD path has no cross-process coordination (every
             # rank would train redundantly and race on the shared
             # checkpoint directory)
             raise ValueError(
                 "multi-process runs require --distributed or --mesh "
-                "(the fused SPMD training path)"
+                "(the fused SPMD training path) or --streaming-chunks with "
+                "--partitioned-io (the partitioned streamed GAME path)"
             )
         if jax.process_index() > 0:
             params = dataclasses.replace(
@@ -1106,19 +1120,25 @@ def _run_streaming(
     (index maps + entity vocabs + cluster keys, records discarded), an
     entity-clustered chunk source, and StreamingGameProgram sweeps — the
     input never materializes in core, so n is bounded by disk, not HBM.
-    validate() already restricted the surface (dense single FE + IDENTITY
-    REs, one λ, single process)."""
+    With --partitioned-io on a multi-process run (ISSUE 17) the chunk plan
+    is agreed over the metadata exchange, each rank streams only its own
+    entity-clustered chunk slice, and sweeps recover through the
+    coordinated all-rank rollback — n is then bounded by the fleet's
+    disks. validate() already restricted the surface (dense single FE +
+    IDENTITY REs, one λ)."""
     import jax  # noqa: F401  (platform selection must already be done)
 
     from photon_ml_tpu.algorithm.streaming_game import (
         DuHLChunkSchedule,
         DuHLScheduleConfig,
         StreamingGameProgram,
+        score_game_stream,
     )
     from photon_ml_tpu.io import avro as avro_io
     from photon_ml_tpu.io.checkpoint import TrainingCheckpointer
     from photon_ml_tpu.io.stream_reader import (
         GameAvroChunkSource,
+        plan_partitioned_game_stream,
         scan_game_stream,
     )
     from photon_ml_tpu.models.coefficients import Coefficients
@@ -1155,33 +1175,87 @@ def _run_streaming(
         params.coordinates[n].random_effect_type for n in re_names
     ))
 
-    files = avro_io.list_avro_files(params.input_data_path)
-    with Timed("streaming scan"):
-        index_maps, vocabs, cluster_keys, indexes, scalars = (
-            scan_game_stream(
-                files, shard_configs, re_columns,
-                cluster_by=cluster_by, on_corrupt=params.on_corrupt,
+    exchange = None
+    coordinator = None
+    partition = None
+    scalars = None
+    if params.partitioned_io and jax.process_count() > 1:
+        from photon_ml_tpu.parallel.multihost import default_exchange
+        from photon_ml_tpu.resilience import CoordinatedRecovery
+
+        if cluster_by is None:
+            raise ValueError(
+                "--partitioned-io streamed GAME needs at least one random-"
+                "effect coordinate (its entities define the chunk "
+                "partition); drop --partitioned-io or add one"
             )
+        if params.validation_data_path:
+            raise ValueError(
+                "--partitioned-io streamed GAME has no multi-rank "
+                "validation pass; drop --validation-data-path and score "
+                "with the scoring driver"
+            )
+        exchange = default_exchange()
+        schedule_budget = (
+            {"working_set": params.duhl_working_set,
+             "tail_chunks": params.duhl_tail_chunks}
+            if params.duhl_working_set > 0 else None
+        )
+        with Timed("streaming scan"):
+            source, index_maps, vocabs, partition = (
+                plan_partitioned_game_stream(
+                    params.input_data_path, shard_configs, re_columns,
+                    exchange=exchange,
+                    chunk_records=params.streaming_chunks,
+                    cluster_by=cluster_by,
+                    schedule_budget=schedule_budget,
+                    on_corrupt=params.on_corrupt,
+                )
+            )
+        job_log.info(
+            "partitioned streamed plan %s: rank %d/%d holds chunks "
+            "[%d, %d) of %d (payload %d/%d input bytes)",
+            partition.fingerprint, partition.rank, partition.num_ranks,
+            *partition.chunk_range(), partition.num_chunks,
+            partition.payload_bytes[partition.rank], partition.input_bytes,
+        )
+        # coordinated multi-rank recovery (ISSUE 15, applied to the
+        # streamed path): fence the run's ONE exchange into restart
+        # generations so a preempted rank becomes an attributed all-rank
+        # rollback to the last barrier-committed sweep. Host-side KV only.
+        coordinator = CoordinatedRecovery(
+            exchange,
+            max_restarts=params.max_restarts,
+            journal=telemetry.journal if telemetry is not None else None,
+            description="partitioned streamed game train",
+        )
+    else:
+        files = avro_io.list_avro_files(params.input_data_path)
+        with Timed("streaming scan"):
+            index_maps, vocabs, cluster_keys, indexes, scalars = (
+                scan_game_stream(
+                    files, shard_configs, re_columns,
+                    cluster_by=cluster_by, on_corrupt=params.on_corrupt,
+                )
+            )
+        source = GameAvroChunkSource(
+            files, shard_configs, index_maps,
+            chunk_records=params.streaming_chunks,
+            random_effect_id_columns=re_columns,
+            entity_vocabs=vocabs,
+            cluster_by=cluster_by,
+            cluster_keys=cluster_keys,
+            indexes=indexes,
+            on_corrupt=params.on_corrupt,
         )
     job_log.info(
         "streaming scan: %d files, shards %s, entities %s",
-        len(files), {k: v.size for k, v in index_maps.items()},
+        len(source.files), {k: v.size for k, v in index_maps.items()},
         {k: len(v) for k, v in vocabs.items()},
     )
     for shard_id, imap in index_maps.items():
         if isinstance(imap, IndexMap):
             imap.save(os.path.join(out, "index-maps"), shard_id)
-
-    source = GameAvroChunkSource(
-        files, shard_configs, index_maps,
-        chunk_records=params.streaming_chunks,
-        random_effect_id_columns=re_columns,
-        entity_vocabs=vocabs,
-        cluster_by=cluster_by,
-        cluster_keys=cluster_keys,
-        indexes=indexes,
-        on_corrupt=params.on_corrupt,
-    )
     job_log.info(
         "planned %d entity-clustered chunks (<=%d records requested, "
         "chunk_rows=%d)",
@@ -1210,12 +1284,15 @@ def _run_streaming(
 
     schedule = None
     if params.duhl_working_set > 0:
+        # the schedule spans GLOBAL chunks when partitioned — every rank
+        # drives the same schedule from the same allgathered signal
         schedule = DuHLChunkSchedule(
             DuHLScheduleConfig(
                 working_set_chunks=params.duhl_working_set,
                 tail_chunks_per_sweep=params.duhl_tail_chunks,
             ),
-            source.num_chunks,
+            partition.num_chunks if partition is not None
+            else source.num_chunks,
         )
     checkpointer = (
         TrainingCheckpointer(
@@ -1231,14 +1308,24 @@ def _run_streaming(
                 num_entities={t: len(vocabs[t]) for t in re_columns},
                 schedule=schedule,
                 prefetch=params.streaming_prefetch,
+                exchange=exchange,
+                partition=partition,
                 # the scan pass already collected the [n] scalars — the
-                # program skips its decode fallback entirely
+                # program skips its decode fallback entirely (partitioned
+                # plans collect per-rank scalars in the program's own
+                # chunk pass instead)
                 scalars=scalars,
             )
             return program.train(
                 num_sweeps=params.coordinate_descent_iterations,
                 checkpointer=checkpointer,
                 resume=params.resume or restart > 0,
+                # a coordinated restart restores the PUBLISHED step on
+                # every rank, never each rank's own local newest
+                resume_step=(
+                    coordinator.resume_step
+                    if coordinator is not None else None
+                ),
                 on_sweep=(
                     None if telemetry is None else
                     lambda sweep, total, loss: telemetry.heartbeat(
@@ -1248,12 +1335,15 @@ def _run_streaming(
                 ),
             )
 
+        if coordinator is not None:
+            coordinator.rebind(checkpointer)
         result = run_with_recovery(
             attempt,
             max_restarts=params.max_restarts,
             checkpointer=checkpointer,
             journal=telemetry.journal if telemetry is not None else None,
             description="streamed game train",
+            coordinator=coordinator,
         )
 
     state = result.state
@@ -1284,15 +1374,78 @@ def _run_streaming(
                 }
             },
         )
+
+    # streamed validation scoring (ISSUE 17 rider): chunk-wise scores
+    # against the streamed model through the SAME jitted steps the sweeps
+    # use — pinned == in-core score_dataset + offsets to float round-off
+    best_metric = float("nan")
+    validation_metrics: dict = {}
+    if params.validation_data_path:
+        from photon_ml_tpu.evaluation.evaluators import (
+            EvaluationData,
+            parse_evaluator,
+        )
+
+        with Timed("streamed validation scoring"):
+            val_source = GameAvroChunkSource(
+                avro_io.list_avro_files(params.validation_data_path),
+                shard_configs, index_maps,
+                chunk_records=params.streaming_chunks,
+                random_effect_id_columns=re_columns,
+                entity_vocabs=vocabs,
+                on_corrupt=params.on_corrupt,
+            )
+            val_scores, val_scalars = score_game_stream(
+                state, val_source, params.task_type, fe_cfg.feature_shard,
+                {spec.re_type: spec.feature_shard_id for spec in re_specs},
+                prefetch=params.streaming_prefetch,
+                return_scalars=True,
+            )
+        val_data = EvaluationData(
+            labels=val_scalars["labels"],
+            offsets=val_scalars["offsets"],
+            weights=val_scalars["weights"],
+            ids={},
+        )
+        for spec_str in params.evaluators:
+            validation_metrics[spec_str] = float(
+                parse_evaluator(spec_str).evaluate(val_scores, val_data)
+            )
+        if params.evaluators:
+            best_metric = validation_metrics[params.evaluators[0]]
+        job_log.info(
+            "streamed validation: %d records, metrics %s",
+            val_source.total_records, validation_metrics,
+        )
+
     evidence = stream_counters.game_stream_evidence()
     summary: dict = {
         "distributed": False,
         "streaming": {
-            "chunks": source.num_chunks,
+            "chunks": (
+                partition.num_chunks if partition is not None
+                else source.num_chunks
+            ),
             "chunk_rows": source.chunk_rows,
-            "records": source.total_records,
+            "records": (
+                partition.total_records if partition is not None
+                else source.total_records
+            ),
             "schedule": "duhl" if schedule is not None else "uniform",
             **evidence,
+            **(
+                {} if partition is None else {
+                    "partitioned": {
+                        "plan": partition.fingerprint,
+                        "rank": partition.rank,
+                        "num_ranks": partition.num_ranks,
+                        "chunk_range": list(partition.chunk_range()),
+                        "rank_records": source.total_records,
+                        "bytes_decoded": source.bytes_decoded,
+                        "input_bytes": partition.input_bytes,
+                    }
+                }
+            ),
         },
         "num_configurations": 1,
         "effective_coordinate_configurations": {
@@ -1303,7 +1456,8 @@ def _run_streaming(
         "best_reg_weights": {
             n: params.coordinates[n].reg_weights[0] for n in sequence
         },
-        "best_metric": float("nan"),
+        "best_metric": best_metric,
+        "validation_metrics": validation_metrics,
         "losses": [float(x) for x in result.losses],
         "metric_history": [],
     }
@@ -1314,6 +1468,9 @@ def _run_streaming(
             distributed=False,
             streaming_chunks=params.streaming_chunks,
             duhl_working_set=params.duhl_working_set,
+            partitioned_ranks=(
+                partition.num_ranks if partition is not None else 1
+            ),
             num_configurations=1,
         )
     summary["timings"] = timing_summary()

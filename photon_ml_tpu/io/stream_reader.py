@@ -39,7 +39,9 @@ Design rules (all enforced somewhere):
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import itertools
+import json
 import os
 import queue
 import threading
@@ -546,23 +548,12 @@ def plan_entity_chunks_avro(
             avro_io.scan_block_index(f, on_corrupt=on_corrupt) for f in files
         ]
     cluster_keys = np.asarray(cluster_keys).astype(str)
-    blocks = [
-        (fi, bi, file_index[bi][0])
-        for fi, file_index in enumerate(indexes)
-        for bi in range(len(file_index))
-    ]
-    if not blocks:
-        raise ValueError("no Avro blocks to stream")
-    total = sum(b[2] for b in blocks)
+    total = sum(n for file_index in indexes for (n, _, _) in file_index)
     if len(cluster_keys) != total:
         raise ValueError(
             f"cluster_keys covers {len(cluster_keys)} records but the "
             f"block index holds {total}"
         )
-    # global record offset at each block start
-    block_starts = np.concatenate(
-        [[0], np.cumsum([b[2] for b in blocks])]
-    ).astype(np.int64)
     # "" (a record missing the id column) is a REAL vocab entity on the
     # decode path (np.unique of keys, the in-core build_game_dataset
     # rule), so "" runs cluster like any other entity — splitting them
@@ -572,6 +563,40 @@ def plan_entity_chunks_avro(
     if total > 1:
         same = cluster_keys[1:] == cluster_keys[:-1]
         splittable[1:total] = ~same
+    specs, starts, skips = _entity_chunks_over_blocks(
+        files, indexes, chunk_records, splittable
+    )
+    return specs, indexes, starts, skips
+
+
+def _entity_chunks_over_blocks(
+    files: Sequence[str],
+    indexes: "list[list[tuple[int, int, int]]]",
+    chunk_records: int,
+    splittable: np.ndarray,
+):
+    """The record-granular chunk loop shared by
+    :func:`plan_entity_chunks_avro` (splittable mask from per-record
+    cluster keys) and :func:`plan_partitioned_game_stream` (splittable
+    mask reconstructed from the allgathered run-length encoding — never
+    materializing [n] key strings). Returns (specs, starts, skips)."""
+    blocks = [
+        (fi, bi, file_index[bi][0])
+        for fi, file_index in enumerate(indexes)
+        for bi in range(len(file_index))
+    ]
+    if not blocks:
+        raise ValueError("no Avro blocks to stream")
+    total = sum(b[2] for b in blocks)
+    if len(splittable) != total + 1:
+        raise ValueError(
+            f"boundary mask covers {len(splittable) - 1} records but the "
+            f"block index holds {total}"
+        )
+    # global record offset at each block start
+    block_starts = np.concatenate(
+        [[0], np.cumsum([b[2] for b in blocks])]
+    ).astype(np.int64)
     specs: list[ChunkSpec] = []
     starts: list[int] = []
     skips: list[int] = []
@@ -600,7 +625,7 @@ def plan_entity_chunks_avro(
         starts.append(int(pos))
         skips.append(int(pos - block_starts[first]))
         pos = end
-    return specs, indexes, starts, skips
+    return specs, starts, skips
 
 
 class GameAvroChunkSource:
@@ -631,6 +656,7 @@ class GameAvroChunkSource:
         indexes=None,
         on_corrupt: str = "raise",
         dtype=np.float32,
+        chunk_plan=None,
     ):
         self.files = [str(f) for f in files]
         self.shard_configs = dict(shard_configs)
@@ -639,7 +665,26 @@ class GameAvroChunkSource:
         self.entity_vocabs = dict(entity_vocabs or {})
         self.on_corrupt = on_corrupt
         self.dtype = dtype
-        if cluster_by is not None:
+        #: dynamic per-source decode evidence (the partitioned bench's
+        #: per-rank decoded-bytes metric; io_counters stays process-global)
+        self.bytes_decoded = 0
+        if chunk_plan is not None:
+            # a precomputed plan (plan_partitioned_game_stream's rank-local
+            # slice of the exchange-agreed global plan): specs already
+            # re-indexed 0..k-1, record starts in the rank's LOCAL row
+            # universe, skips into each chunk's first covering block
+            plan_specs, plan_starts, plan_skips = chunk_plan
+            self.specs = list(plan_specs)
+            self.record_starts = [int(s) for s in plan_starts]
+            self._skips = [int(s) for s in plan_skips]
+            self.indexes = (
+                indexes if indexes is not None
+                else [
+                    avro_io.scan_block_index(f, on_corrupt=on_corrupt)
+                    for f in self.files
+                ]
+            )
+        elif cluster_by is not None:
             if cluster_keys is None:
                 raise ValueError(
                     "cluster_by needs cluster_keys (the per-record entity "
@@ -693,6 +738,7 @@ class GameAvroChunkSource:
                 )
             )
         io_counters.record_bytes_decoded(payload_bytes)
+        self.bytes_decoded += payload_bytes
         # entity-clustered plans slice the covering blocks' records to the
         # chunk's exact record range (boundary blocks decode for both
         # neighbors)
@@ -1303,3 +1349,285 @@ def plan_partitioned_stream(
         block_subset=my_blocks,
     )
     return source, index_maps, intercepts
+
+
+@dataclasses.dataclass(frozen=True)
+class GameStreamPartition:
+    """The exchange-agreed multi-rank streamed-GAME plan: every field is
+    IDENTICAL on every rank (a deterministic function of the allgathered
+    payloads), so per-rank programs can fingerprint checkpoints, drive one
+    global DuHL schedule, and map global chunk ids to their local slice
+    without further coordination.
+
+    ``chunk_ranges[rank]`` is the rank's [lo, hi) slice of GLOBAL chunk
+    ids (whole chunks — hence whole entities — per rank);
+    ``payload_bytes[rank]`` is the deduped covering-block payload a full
+    pass over that slice decodes (the per-rank I/O evidence: strictly
+    less than ``input_bytes`` whenever the plan actually partitions).
+    """
+
+    rank: int
+    num_ranks: int
+    num_chunks: int
+    chunk_ranges: "tuple[tuple[int, int], ...]"
+    chunk_rows: int
+    total_records: int
+    payload_bytes: "tuple[int, ...]"
+    input_bytes: int
+    fingerprint: str
+
+    def chunk_range(self) -> "tuple[int, int]":
+        return self.chunk_ranges[self.rank]
+
+
+def plan_partitioned_game_stream(
+    path,
+    shard_configs: Mapping[str, object],
+    random_effect_id_columns: Sequence[str],
+    *,
+    exchange,
+    chunk_records: int,
+    cluster_by: str,
+    schedule_budget: "Mapping[str, object] | None" = None,
+    on_corrupt: str = "raise",
+    dtype=np.float32,
+    tag: str = "stream_game",
+):
+    """The --partitioned-io × --streaming-chunks composition for GAME
+    (ISSUE 17): entity-granular per-rank chunk assignments agreed over the
+    metadata exchange, so one streamed-GAME job spans the fleet's disks.
+
+    Each rank decodes ONLY a provisional contiguous block slice
+    (``assign_contiguous`` over payload sizes, the PR 6 rule) collecting
+    its feature keys, RE entity keys, and a run-length encoding of the
+    ``cluster_by`` column — O(vocabulary + entities) metadata, never the
+    [n] sample axis. ONE allgather unions the key sets and concatenates
+    the cluster runs in rank order (boundary runs of the same entity
+    merge), after which every rank deterministically rebuilds the SAME
+    global entity-clustered chunk plan (:func:`plan_entity_chunks_avro`
+    semantics, reconstructed from run boundaries) and assigns WHOLE
+    chunks — hence whole entities — contiguously to ranks. The agreed
+    plan fields (input fingerprint, chunk budget, cluster column, rank
+    geometry, schedule budget) are compared FIELD-WISE across ranks; any
+    disagreement fails fast naming the differing fields and their
+    per-rank values — a run never trains on a silently-disagreed plan.
+
+    Returns ``(source, index_maps, entity_vocabs, partition)``: a
+    rank-local :class:`GameAvroChunkSource` over this rank's chunks (rows
+    renumbered into the rank's LOCAL universe — the streamed program's
+    scalars stay O(n_rank)), the globally-agreed feature index maps and
+    entity vocabs, and the :class:`GameStreamPartition` every rank agrees
+    on. Feed all four to ``StreamingGameProgram(..., exchange=exchange,
+    partition=partition, num_entities={t: len(vocabs[t])})``.
+    """
+    from photon_ml_tpu.io.data_reader import META_DATA_MAP, build_index_maps
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.partitioned_reader import (
+        _local_keys,
+        _plan_fingerprint,
+        assign_contiguous,
+    )
+
+    if cluster_by is None:
+        raise ValueError(
+            "plan_partitioned_game_stream needs cluster_by (the RE type "
+            "whose entities define chunk grouping) — a multi-rank streamed "
+            "GAME run without entity clustering would split entities "
+            "across ranks"
+        )
+    re_cols = tuple(random_effect_id_columns)
+    files = avro_io.list_avro_files(path)
+    sizes = [int(os.path.getsize(f)) for f in files]
+    io_counters.set_input_bytes_total(int(sum(sizes)))
+    indexes = [
+        avro_io.scan_block_index(f, on_corrupt=on_corrupt) for f in files
+    ]
+    blocks = [
+        (fi, bi, payload)
+        for fi, file_index in enumerate(indexes)
+        for bi, (_, payload, _) in enumerate(file_index)
+    ]
+    if not blocks:
+        raise ValueError(f"no Avro blocks under {path!r}")
+    ranges = assign_contiguous([b[2] for b in blocks], exchange.num_ranks)
+    lo, hi = ranges[exchange.rank]
+    my_blocks = [(fi, bi) for fi, bi, _ in blocks[lo:hi]]
+
+    re_keys: "dict[str, set]" = {c: set() for c in re_cols}
+    cluster_runs: "list[list]" = []  # [key, count] run-length pairs
+    scan_bytes = 0
+
+    def my_records():
+        for spec_fi, group in itertools.groupby(my_blocks, key=lambda b: b[0]):
+            bis = [bi for _, bi in group]
+            for record in avro_io.read_container_block_range(
+                files[spec_fi], bis[0], len(bis), index=indexes[spec_fi],
+                on_corrupt=on_corrupt,
+            ):
+                meta = record.get(META_DATA_MAP) or {}
+                for c in re_cols:
+                    value = meta.get(c, record.get(c))
+                    re_keys[c].add("" if value is None else str(value))
+                value = meta.get(cluster_by, record.get(cluster_by))
+                key = "" if value is None else str(value)
+                if cluster_runs and cluster_runs[-1][0] == key:
+                    cluster_runs[-1][1] += 1
+                else:
+                    cluster_runs.append([key, 1])
+                yield record
+
+    local_maps = build_index_maps(my_records(), shard_configs)
+    scan_bytes = sum(
+        indexes[fi][bi][1] for fi, bi in my_blocks
+    )
+    budget = (
+        None if schedule_budget is None
+        else {k: schedule_budget[k] for k in sorted(schedule_budget)}
+    )
+    plan_fields = {
+        "input": _plan_fingerprint(files, sizes, "stream-game-blocks",
+                                   ranges),
+        "chunk_records": int(chunk_records),
+        "cluster_by": str(cluster_by),
+        "re_columns": list(re_cols),
+        "num_ranks": int(exchange.num_ranks),
+        "schedule": budget,
+    }
+    payload = {
+        "plan": plan_fields,
+        "keys": {
+            shard: _local_keys(local_maps[shard], cfg)
+            for shard, cfg in shard_configs.items()
+        },
+        "entities": {c: sorted(re_keys[c]) for c in re_cols},
+        "cluster_runs": cluster_runs,
+    }
+    with tracing.span("partitioned/game_stream_plan_exchange",
+                      cat="partitioned", tag=tag, rank=exchange.rank):
+        gathered = exchange.allgather(f"stream_game_plan/{tag}", payload)
+    diffs = []
+    fields = sorted(set().union(*[set(g["plan"]) for g in gathered]))
+    for field in fields:
+        values = [g["plan"].get(field) for g in gathered]
+        if any(v != values[0] for v in values[1:]):
+            diffs.append(
+                f"{field}: " + ", ".join(
+                    f"rank{r}={v!r}" for r, v in enumerate(values)
+                )
+            )
+    if diffs:
+        raise RuntimeError(
+            "ranks disagree on the partitioned GAME stream plan — refusing "
+            "to train on a silently-disagreed plan; differing fields: "
+            + "; ".join(diffs)
+        )
+
+    index_maps: "dict[str, IndexMap]" = {}
+    for shard, cfg in shard_configs.items():
+        union: "set[str]" = set()
+        for g in gathered:
+            union.update(g["keys"][shard])
+        index_maps[shard] = IndexMap.from_keys(
+            union, add_intercept=cfg.has_intercept
+        )
+    vocabs = {
+        c: np.unique(
+            np.asarray(
+                sorted(set().union(*[set(g["entities"][c]) for g in gathered]))
+            ).astype(str)
+        )
+        for c in re_cols
+    }
+
+    # global cluster runs: rank-order concatenation, merging boundary runs
+    # of the same entity (an entity spanning a provisional block boundary
+    # must still land in ONE chunk)
+    run_keys: "list[str]" = []
+    run_counts: "list[int]" = []
+    for g in gathered:
+        for key, count in g["cluster_runs"]:
+            if run_keys and run_keys[-1] == key:
+                run_counts[-1] += int(count)
+            else:
+                run_keys.append(key)
+                run_counts.append(int(count))
+    total = int(sum(run_counts))
+    index_total = sum(n for file_index in indexes for (n, _, _) in file_index)
+    if total != index_total:
+        raise RuntimeError(
+            f"rank-local scans cover {total} records but the block index "
+            f"holds {index_total} — the input changed between the block "
+            "scan and the key scan; re-run against a quiesced input"
+        )
+    splittable = np.zeros(total + 1, dtype=bool)
+    splittable[0] = True
+    splittable[total] = True
+    if run_counts:
+        ends = np.cumsum(np.asarray(run_counts, dtype=np.int64))
+        splittable[ends[:-1]] = True
+    if chunk_records <= 0:
+        raise ValueError(f"chunk_records must be positive, got {chunk_records}")
+    specs, starts, skips = _entity_chunks_over_blocks(
+        files, indexes, chunk_records, splittable
+    )
+    chunk_ranges = assign_contiguous(
+        [s.num_records for s in specs], exchange.num_ranks
+    )
+    empty = [r for r, (clo, chi) in enumerate(chunk_ranges) if chi <= clo]
+    if empty:
+        raise ValueError(
+            f"the entity-clustered plan has {len(specs)} chunks for "
+            f"{exchange.num_ranks} ranks — ranks {empty} would stream "
+            "nothing; use a smaller --streaming-chunks budget (more "
+            "chunks) or fewer ranks"
+        )
+
+    file_pos = {f: i for i, f in enumerate(files)}
+
+    def rank_payload(clo: int, chi: int) -> int:
+        cover: "set[tuple[int, int]]" = set()
+        for s in specs[clo:chi]:
+            for run_path, start, count in s.runs:
+                fi = file_pos[run_path]
+                cover.update((fi, bi) for bi in range(start, start + count))
+        return int(sum(indexes[fi][bi][1] for fi, bi in cover))
+
+    payload_bytes = tuple(rank_payload(clo, chi) for clo, chi in chunk_ranges)
+    fingerprint = hashlib.sha256(
+        json.dumps(
+            [plan_fields, starts, [list(r) for r in chunk_ranges]],
+            sort_keys=True,
+        ).encode()
+    ).hexdigest()[:16]
+    partition = GameStreamPartition(
+        rank=int(exchange.rank),
+        num_ranks=int(exchange.num_ranks),
+        num_chunks=len(specs),
+        chunk_ranges=tuple((int(a), int(b)) for a, b in chunk_ranges),
+        chunk_rows=max(s.num_records for s in specs),
+        total_records=total,
+        payload_bytes=payload_bytes,
+        input_bytes=int(sum(sizes)),
+        fingerprint=fingerprint,
+    )
+    clo, chi = chunk_ranges[exchange.rank]
+    local_specs = [
+        dataclasses.replace(s, index=i)
+        for i, s in enumerate(specs[clo:chi])
+    ]
+    base = starts[clo]
+    local_starts = [starts[c] - base for c in range(clo, chi)]
+    local_skips = [skips[c] for c in range(clo, chi)]
+    source = GameAvroChunkSource(
+        files, shard_configs, index_maps,
+        chunk_records=chunk_records,
+        random_effect_id_columns=re_cols,
+        entity_vocabs=vocabs,
+        cluster_by=cluster_by,
+        indexes=indexes,
+        on_corrupt=on_corrupt,
+        dtype=dtype,
+        chunk_plan=(local_specs, local_starts, local_skips),
+    )
+    source.scan_bytes = scan_bytes
+    return source, index_maps, vocabs, partition

@@ -58,6 +58,7 @@ _UNIT_PATTERNS: tuple[tuple[str, str, type], ...] = (
     ("overlap", rf"ovl{_NUM}", float),
     ("unbatched_rate", rf"1/dsp sr {_NUM}", float),
     ("full_ms", rf"fullsr {_NUM}", float),
+    ("one_rank_ms", rf"1rk{_NUM}", float),
     ("p95_ms", rf"p95 {_NUM}ms", float),
     ("cal_fraction", rf"{_NUM}xcal", float),
     # descriptive fields
@@ -100,6 +101,11 @@ def parse_unit(metric: str, unit: str) -> dict:
     if m:
         out["lanes_solved"] = int(m.group(1))
         out["lanes_total"] = int(m.group(2))
+    # partitioned-read evidence pair: rb<max-per-rank>/<input>MB decoded
+    m = re.search(r"\brb(\d+(?:\.\d+)?)/(\d+(?:\.\d+)?)MB", unit)
+    if m:
+        out["rank_payload_mb"] = float(m.group(1))
+        out["input_mb"] = float(m.group(2))
     return out
 
 

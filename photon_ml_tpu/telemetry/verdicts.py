@@ -350,6 +350,36 @@ def _judge_duhl(row: BenchRow, art: BenchArtifact) -> Verdict:
     )
 
 
+@rule("stream_game_ranks", name="rank-reads-strict-subset",
+      higher_better=False,
+      doc="multi-rank partitioned streamed GAME (ISSUE 17): max per-rank "
+          "decoded payload bytes must be STRICTLY smaller than the global "
+          "input bytes (rb<rank>/<input>MB pair) — the I/O the partition "
+          "exists to save. Wall ms/sweep on virtual ranks is "
+          "thread-serialized on one host and is informational only; the "
+          "same-run single-rank sweep ms (1rk) gives its scale")
+def _judge_stream_ranks(row: BenchRow, art: BenchArtifact) -> Verdict:
+    u = row.parsed_unit
+    rank_mb, input_mb = u.get("rank_payload_mb"), u.get("input_mb")
+    if rank_mb is None or input_mb is None:
+        return _verdict(row, "rank-reads-strict-subset", NO_EVIDENCE,
+                        "unit embeds no rb<rank>/<input>MB pair", art)
+    detail = f"max per-rank payload {rank_mb:g} MB of {input_mb:g} MB input"
+    one_rank = u.get("one_rank_ms")
+    if one_rank is not None and row.value is not None:
+        detail += (f"; {row.value:g} ms/sweep vs same-run single-rank "
+                   f"{one_rank:g} (informational — virtual ranks "
+                   f"serialize)")
+    if 0 < rank_mb < input_mb:
+        return _verdict(row, "rank-reads-strict-subset", WIN, detail, art)
+    return _verdict(
+        row, "rank-reads-strict-subset", REGRESSION,
+        detail + " — a rank decoded the whole input: the partitioned "
+        "plan assigned it every covering block (ISSUE 17's point is that "
+        "it must not)", art,
+    )
+
+
 @rule("serve_microbatch", name="batched-beats-unbatched", higher_better=True,
       doc="micro-batched scores/sec must beat the same-run one-request-"
           "per-dispatch rate embedded in the unit (~14x on the CPU mesh)")

@@ -31,6 +31,7 @@ EXPECTED_METRICS = [
     "sparse_1e8_fe_tron_ms_per_iter",
     "stream_fe_chunked",
     "stream_game_duhl",
+    "stream_game_ranks",
     "serve_microbatch",
     "refresh_incremental",
 ]

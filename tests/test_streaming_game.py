@@ -634,10 +634,11 @@ class TestStreamingGameDriver:
         )
 
     @pytest.mark.parametrize("extra,match", [
-        (["--distributed"], "single-process"),
+        (["--distributed"], "partitioned-io"),
         (["--normalization", "STANDARDIZATION"], "NONE"),
         (["--hyperparameter-tuning", "BAYESIAN"], "tuning"),
         (["--input-format", "libsvm"], "Avro"),
+        (["--evaluators", "AUC:queryId"], "per-query"),
     ])
     def test_driver_rejects_unsupported_combinations(
             self, tmp_path, extra, match):
