@@ -449,6 +449,8 @@ VMAPPED_SOLVE_PREFIXES = (
     f"{PACKAGE}/optim/",
     f"{PACKAGE}/algorithm/",
     f"{PACKAGE}/estimators.py",
+    # search tournaments (ISSUE 20) dispatch the same vmapped lane solves
+    f"{PACKAGE}/hyperparameter/",
 )
 
 _PALLAS_MODULE_RE = re.compile(r"(^|\.)pallas(\b|_glm)")
@@ -594,6 +596,11 @@ STREAMING_MODULES = (
     # the streamed-GAME path (ISSUE 11): its chunk-consuming jits carry
     # the same 413 exposure as the GLM streaming modules
     f"{PACKAGE}/algorithm/streaming_game.py",
+    # model-search tournaments (ISSUE 20): the vmapped lane solve and the
+    # on-device metric jits take the full train/validation batch — it must
+    # ride the argument list, never a closure
+    f"{PACKAGE}/algorithm/lane_search.py",
+    f"{PACKAGE}/hyperparameter/search_driver.py",
 )
 
 #: serving modules join the ban (whole package): the operand at risk is
@@ -827,6 +834,7 @@ RAW_JIT_PREFIXES = (
     f"{PACKAGE}/algorithm/",
     f"{PACKAGE}/serving/",
     f"{PACKAGE}/parallel/",
+    f"{PACKAGE}/hyperparameter/",
 )
 
 #: (file, dotted class-qualified scope) pairs whose RAW jax.jit use is

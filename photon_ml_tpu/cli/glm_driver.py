@@ -142,6 +142,21 @@ class GLMDriverParams:
     #: restarts the run — resuming from the latest intact checkpoint when
     #: --checkpoint-dir is set — up to this many times. 0 disables.
     max_restarts: int = 2
+    #: GP-driven model search (hyperparameter/search_driver.py): > 0 opts
+    #: in — each round trains --search-lane-budget configs as ONE vmapped
+    #: tournament, evaluated on-mesh by the task's selection metric, with
+    #: the GP fit overlapping the next round's device solve. Replaces the
+    #: --regularization-weights grid; requires --validation-data-path and
+    #: --search-space.
+    search_rounds: int = 0
+    #: configs per tournament round (vmapped solver lanes)
+    search_lane_budget: int = 8
+    #: search-space grammar, e.g. "lambda=1e-4:1e2:log,alpha=0:1,
+    #: tolerance=1e-9:1e-5:log" (see search_driver.parse_search_space)
+    search_space: str | None = None
+    #: one SeedSequence threads Sobol + the GP slice sampler — a search
+    #: trajectory replays deterministically under a fixed seed
+    search_seed: int = 0
 
 
 @dataclasses.dataclass
@@ -213,6 +228,47 @@ def _check_streaming_supported(params: "GLMDriverParams") -> None:
         )
 
 
+def _check_search_supported(params: "GLMDriverParams") -> None:
+    """Fail fast, naming the alternative, before any data is read."""
+    if not params.search_space:
+        raise ValueError(
+            "--search-rounds needs --search-space (grammar: "
+            "name=low:high[:log][:int], comma-separated; e.g. "
+            "'lambda=1e-4:1e2:log,alpha=0:1')"
+        )
+    if not params.validation_data_path:
+        raise ValueError(
+            "--search-rounds selects by the validation metric; pass "
+            "--validation-data-path"
+        )
+    if params.streaming_chunks > 0:
+        raise ValueError(
+            "--search-rounds trains vmapped tournament lanes on the "
+            "in-core batch; drop --streaming-chunks (stream-compose the "
+            "winning config afterwards instead)"
+        )
+    if params.grid_parallel:
+        raise ValueError(
+            "--search-rounds replaces the λ grid (tournament lanes ARE "
+            "the grid generalization); drop --grid-parallel"
+        )
+    if params.elastic_net_alpha:
+        raise ValueError(
+            "the elastic-net mix is a search dimension — add 'alpha=0:1' "
+            "to --search-space instead of --elastic-net-alpha"
+        )
+    if params.enable_diagnostics or params.num_bootstraps:
+        raise ValueError(
+            "diagnostics re-fit the λ grid; run them on the winning "
+            "config without --search-rounds"
+        )
+    if params.compute_variance:
+        raise ValueError(
+            "coefficient variances are not computed per tournament lane; "
+            "re-fit the winning config with --compute-variance"
+        )
+
+
 def _check_checkpoint_supported(params: "GLMDriverParams") -> None:
     if params.checkpoint_dir and params.streaming_chunks <= 0:
         raise ValueError(
@@ -230,6 +286,8 @@ def _check_checkpoint_supported(params: "GLMDriverParams") -> None:
 def run(params: GLMDriverParams) -> GLMDriverResult:
     if params.streaming_chunks > 0:
         _check_streaming_supported(params)
+    if params.search_rounds > 0:
+        _check_search_supported(params)
     _check_checkpoint_supported(params)
     if (
         params.coefficient_box_constraints
@@ -285,6 +343,9 @@ def run(params: GLMDriverParams) -> GLMDriverResult:
         "normalization": params.normalization.name,
         "streaming_chunks": params.streaming_chunks,
         "streaming_prefetch": params.streaming_prefetch,
+        "search_rounds": params.search_rounds,
+        "search_lane_budget": params.search_lane_budget,
+        "search_space": params.search_space,
         "checkpoint_dir": params.checkpoint_dir,
         "max_restarts": params.max_restarts,
         "trace_dir": params.trace_dir,
@@ -527,8 +588,49 @@ def _run_stages(params: GLMDriverParams, telemetry: SolverTelemetry,
                 telemetry=tel,
             )
 
+        val_batch = None
+        search_outcome = None
         with Timed("glm train"):
-            if streaming:
+            if params.search_rounds > 0:
+                from photon_ml_tpu.hyperparameter.search_driver import (
+                    parse_search_space,
+                    run_model_search,
+                )
+
+                # the validation batch doubles as the tournament metric
+                # input; read it here (VALIDATE below reuses it)
+                val_batch, _, _ = _read_batch(
+                    params.validation_data_path, params.input_format,
+                    shard_cfg, index_maps, on_corrupt=params.on_corrupt,
+                )
+                space = parse_search_space(params.search_space)
+                search_outcome = run_model_search(
+                    batch, val_batch, params.task_type, space,
+                    rounds=params.search_rounds,
+                    lane_budget=params.search_lane_budget,
+                    optimizer=opt,
+                    seed=params.search_seed,
+                    evaluator=_SELECTION_METRIC[params.task_type],
+                    normalization=norm,
+                    intercept_index=intercept_index,
+                    box_lower=lower_bounds,
+                    box_upper=upper_bounds,
+                    journal=telemetry.journal,
+                    telemetry=telemetry,
+                )
+                models = {
+                    search_outcome.best_config["lambda"]:
+                        search_outcome.best_model
+                }
+                job_log.info(
+                    "search best %s=%s config=%s (%d configs over %d rounds)",
+                    search_outcome.evaluator_name,
+                    search_outcome.best_metric,
+                    search_outcome.best_config,
+                    params.search_rounds * params.search_lane_budget,
+                    params.search_rounds,
+                )
+            elif streaming:
                 from photon_ml_tpu.estimators import train_glm_streaming
 
                 models = train_glm_streaming(
@@ -559,13 +661,13 @@ def _run_stages(params: GLMDriverParams, telemetry: SolverTelemetry,
         # VALIDATE
         best_lambda = None
         validation_metrics: dict = {}
-        val_batch = None
         if params.validation_data_path:
             with Timed("glm validate"):
-                val_batch, _, _ = _read_batch(
-                    params.validation_data_path, params.input_format, shard_cfg,
-                    index_maps, on_corrupt=params.on_corrupt,
-                )
+                if val_batch is None:
+                    val_batch, _, _ = _read_batch(
+                        params.validation_data_path, params.input_format,
+                        shard_cfg, index_maps, on_corrupt=params.on_corrupt,
+                    )
                 metric = _SELECTION_METRIC[params.task_type]
                 larger = METRIC_DIRECTIONS[metric]
                 best_value = None
@@ -613,20 +715,24 @@ def _run_stages(params: GLMDriverParams, telemetry: SolverTelemetry,
             stage = DriverStage.DIAGNOSED
 
     summary_path = os.path.join(params.output_dir, "glm-summary.json")
+    summary = {
+        "stage": stage.name,
+        "lambdas": sorted(models),
+        "best_lambda": best_lambda,
+        "validation_metrics": {
+            str(k): v for k, v in validation_metrics.items()
+        },
+    }
+    if search_outcome is not None:
+        summary["search"] = {
+            "best_config": search_outcome.best_config,
+            "best_metric": search_outcome.best_metric,
+            "metric": search_outcome.evaluator_name,
+            "rounds": len(search_outcome.trajectory),
+            "configs": len(search_outcome.observations),
+        }
     with open(summary_path, "w") as f:
-        json.dump(
-            {
-                "stage": stage.name,
-                "lambdas": sorted(models),
-                "best_lambda": best_lambda,
-                "validation_metrics": {
-                    str(k): v for k, v in validation_metrics.items()
-                },
-            },
-            f,
-            indent=2,
-            default=float,
-        )
+        json.dump(summary, f, indent=2, default=float)
     return GLMDriverResult(
         stage=stage,
         models=models,
@@ -703,6 +809,23 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
                         "shapes) up to N times, resuming from the latest "
                         "intact checkpoint when --checkpoint-dir is set "
                         "(0 disables)")
+    p.add_argument("--search-rounds", type=int, default=0,
+                   help="GP-driven model search: rounds of vmapped config "
+                        "tournaments (> 0 opts in; replaces "
+                        "--regularization-weights; requires "
+                        "--validation-data-path and --search-space)")
+    p.add_argument("--search-lane-budget", type=int, default=8,
+                   help="configs per tournament round (vmapped solver "
+                        "lanes sharing one feature-block read)")
+    p.add_argument("--search-space",
+                   help="search-space grammar: name=low:high[:log][:int], "
+                        "comma-separated; dims: lambda (required), alpha, "
+                        "tolerance, box — e.g. "
+                        "'lambda=1e-4:1e2:log,alpha=0:1'")
+    p.add_argument("--search-seed", type=int, default=0,
+                   help="one SeedSequence threads Sobol + the GP slice "
+                        "sampler; a trajectory replays deterministically "
+                        "under a fixed seed")
     args = p.parse_args(argv)
     return run(
         GLMDriverParams(
@@ -733,6 +856,10 @@ def main(argv: Sequence[str] | None = None) -> GLMDriverResult:
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
             max_restarts=args.max_restarts,
+            search_rounds=args.search_rounds,
+            search_lane_budget=args.search_lane_budget,
+            search_space=args.search_space,
+            search_seed=args.search_seed,
         )
     )
 

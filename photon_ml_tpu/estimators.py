@@ -1047,6 +1047,11 @@ def train_glm_grid(
     serves any grid of the same size. Supports LBFGS and OWLQN lanes
     (elastic net included); TRON's trust-region loop is per-lane scalar
     control flow and stays on the sequential path.
+
+    The lane-varying-L2-only special case of the config tournaments in
+    algorithm/lane_search.py (per-lane l1/l2/tolerance/box vectors, warm
+    starts — the GP model-search substrate); a uniform-config tournament is
+    pinned bitwise-identical to this path (tests/test_lane_search.py).
     """
     optimizer = resolve_auto_optimizer(optimizer or OptimizerConfig())
     if optimizer.optimizer_type not in (
@@ -1167,6 +1172,33 @@ def _jitted_grid_solve(objective, use_owlqn, history, max_iter, tolerance,
         )
 
     return jax.vmap(solve_one)(l2v, l1v)
+
+
+def train_glm_tournament(
+    batch: LabeledPointBatch,
+    task: TaskType,
+    configs,
+    *,
+    optimizer: OptimizerConfig | None = None,
+    warm_start=None,
+    normalization: NormalizationContext | None = None,
+    intercept_index: int | None = None,
+    telemetry=None,
+):
+    """Train one vmapped config tournament (per-lane l1/l2/tolerance/box
+    vectors — the generalization of :func:`train_glm_grid`'s λ-only lanes).
+
+    ``configs``: algorithm.lane_search.LaneConfigs. Returns the
+    TournamentResult (per-lane SolverResult stack + model-space GLMs); the
+    GP ask/tell loop above it lives in hyperparameter/search_driver.py.
+    """
+    from photon_ml_tpu.algorithm.lane_search import run_lane_tournament
+
+    return run_lane_tournament(
+        batch, task, configs, optimizer=optimizer, warm_start=warm_start,
+        normalization=normalization, intercept_index=intercept_index,
+        telemetry=telemetry,
+    )
 
 
 def _objective_for_batch(batch, loss, l2_weight, normalization,

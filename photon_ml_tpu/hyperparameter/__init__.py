@@ -20,9 +20,19 @@ from photon_ml_tpu.hyperparameter.search import (
     GaussianProcessSearch,
     RandomSearch,
 )
+from photon_ml_tpu.hyperparameter.search_driver import (
+    SearchOutcome,
+    SearchSpace,
+    parse_search_space,
+    run_model_search,
+)
 from photon_ml_tpu.hyperparameter.slice_sampler import slice_sample
 
 __all__ = [
+    "SearchOutcome",
+    "SearchSpace",
+    "parse_search_space",
+    "run_model_search",
     "confidence_bound",
     "expected_improvement",
     "GaussianProcessEstimator",

@@ -63,6 +63,10 @@ class GaussianProcessEstimator:
     num_kernel_samples: int = 5
     burn_in: int = 10
     seed: int = 0
+    #: explicit generator for the slice sampler — searchers thread ONE
+    #: generator through every fit so trajectories replay deterministically
+    #: (None = a fresh default_rng(seed) per fit, the standalone behavior)
+    rng: np.random.Generator | None = None
     #: log-normal prior scale on (log amplitude, log noise, log lengthscale)
     prior_scale: float = 2.0
 
@@ -98,7 +102,7 @@ class GaussianProcessEstimator:
 
         theta0 = np.zeros(2 + d)
         theta0[1] = np.log(0.1)  # start with moderate noise
-        rng = np.random.default_rng(self.seed)
+        rng = self.rng if self.rng is not None else np.random.default_rng(self.seed)
         thetas = slice_sample(
             log_marginal,
             theta0,

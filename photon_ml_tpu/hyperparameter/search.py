@@ -48,17 +48,45 @@ class SearchResult:
 
 
 class RandomSearch:
-    """Sobol-sequence random search (reference RandomSearch.scala:33-50)."""
+    """Sobol-sequence random search (reference RandomSearch.scala:33-50).
 
-    def __init__(self, dim: int, seed: int = 0):
+    Seeded with a ``np.random.SeedSequence`` (the search_driver tournament
+    path), ALL randomness threads from that one sequence: the Sobol scramble
+    and the GP subclass's slice sampler draw from deterministic children of
+    it (EI is pure), so a whole search trajectory replays bit-for-bit under
+    a fixed seed — no ad-hoc seed arithmetic, no numpy global state
+    (tests/test_lane_search.py pins the replay). An int seed keeps the
+    historical derivation (Sobol seeded with the int, per-fit estimator
+    seeds) so existing tuner trajectories are unchanged.
+    """
+
+    #: where the last propose_batch came from ("sobol" | "gp")
+    last_proposal_source = "sobol"
+
+    def __init__(self, dim: int, seed: "int | np.random.SeedSequence" = 0):
         self.dim = dim
         self.seed = seed
-        self._sobol = qmc.Sobol(d=dim, scramble=True, seed=seed)
+        if isinstance(seed, np.random.SeedSequence):
+            sobol_child, model_child = seed.spawn(2)
+            self._sobol = qmc.Sobol(
+                d=dim, scramble=True, seed=np.random.default_rng(sobol_child)
+            )
+            #: one generator threaded through every surrogate-model fit
+            self._model_rng = np.random.default_rng(model_child)
+        else:
+            self._sobol = qmc.Sobol(d=dim, scramble=True, seed=seed)
+            self._model_rng = None
         self.observations: list[Observation] = []
         self.prior_observations: list[Observation] = []
 
     def draw_candidates(self, n: int) -> np.ndarray:
         return self._sobol.random(n)
+
+    def propose_batch(self, n: int) -> np.ndarray:
+        """The batch-ask API (search_driver tournaments): n fresh
+        candidates; subclasses may rank a pool instead."""
+        self.last_proposal_source = "sobol"
+        return self.draw_candidates(n)
 
     def next_candidate(self) -> np.ndarray:
         return self.draw_candidates(1)[0]
@@ -113,20 +141,44 @@ class GaussianProcessSearch(RandomSearch):
         self.num_kernel_samples = num_kernel_samples
         self.burn_in = burn_in
 
-    def next_candidate(self) -> np.ndarray:
-        all_obs = self.observations + self.prior_observations
-        if len(all_obs) < self.min_observations:
-            return super().next_candidate()
+    def _fit_surrogate(self, all_obs: list[Observation]):
         x = np.stack([o.candidate for o in all_obs])
         y = np.array([o.value for o in all_obs])
         estimator = GaussianProcessEstimator(
             kernel=self.kernel,
             num_kernel_samples=self.num_kernel_samples,
             burn_in=self.burn_in,
-            seed=self.seed + len(all_obs),
+            # SeedSequence-seeded searches thread ONE generator; int seeds
+            # keep the historical per-fit derivation (tuner trajectories
+            # must not move under existing seeds)
+            seed=(self.seed + len(all_obs)
+                  if self._model_rng is None else 0),
+            rng=self._model_rng,
         )
-        model = estimator.fit(x, y)
+        return estimator.fit(x, y), y
+
+    def next_candidate(self) -> np.ndarray:
+        all_obs = self.observations + self.prior_observations
+        if len(all_obs) < self.min_observations:
+            return super().next_candidate()
+        model, y = self._fit_surrogate(all_obs)
         pool = self.draw_candidates(self.candidate_pool)
         mean, var = model.predict(pool)
         ei = expected_improvement(mean, var, best_value=float(y.min()))
         return pool[int(np.argmax(ei))]
+
+    def propose_batch(self, n: int) -> np.ndarray:
+        """One GP fit, one EI ranking of a fresh Sobol pool, top-n distinct
+        candidates — the tournament-round ask (search_driver.py). Falls
+        back to Sobol until min_observations are told back."""
+        all_obs = self.observations + self.prior_observations
+        if len(all_obs) < self.min_observations:
+            self.last_proposal_source = "sobol"
+            return self.draw_candidates(n)
+        model, y = self._fit_surrogate(all_obs)
+        pool = self.draw_candidates(max(self.candidate_pool, n))
+        mean, var = model.predict(pool)
+        ei = expected_improvement(mean, var, best_value=float(y.min()))
+        self.last_proposal_source = "gp"
+        order = np.argsort(-ei)
+        return pool[order[:n]]

@@ -57,6 +57,7 @@ _UNIT_PATTERNS: tuple[tuple[str, str, type], ...] = (
     ("off_ms", rf"OFF{_NUM}", float),
     ("overlap", rf"ovl{_NUM}", float),
     ("unbatched_rate", rf"1/dsp sr {_NUM}", float),
+    ("seq_rate", rf"seq{_NUM}", float),
     ("full_ms", rf"fullsr {_NUM}", float),
     ("one_rank_ms", rf"1rk{_NUM}", float),
     ("p95_ms", rf"p95 {_NUM}ms", float),
@@ -66,6 +67,7 @@ _UNIT_PATTERNS: tuple[tuple[str, str, type], ...] = (
     ("hot_cols", r"hot(\d+)", int),
     ("roofline_gbps", rf"roof{_NUM}", float),
     ("chunks", r"ON (\d+)ch", int),
+    ("chunks", r"\b(\d+)ch\b", int),  # r20 trims the "ON " (line budget)
     # legacy verbose grammar (r01-r05): the same facts in prose
     ("cal_fraction", rf"stream rate: {_NUM}", float),
     ("ms_per_iter", rf"{_NUM} ?ms/it(?:er)?\b", float),

@@ -428,6 +428,31 @@ def _judge_refresh(row: BenchRow, art: BenchArtifact) -> Verdict:
     return v
 
 
+@rule("search_throughput", name="tournament-beats-sequential",
+      higher_better=True,
+      doc="GP tournament configs/sec must beat the same-run one-config-"
+          "per-solve sequential rate embedded in the unit (seq token) — "
+          "vmapped lanes are the ONLY reason the search driver exists "
+          "(ISSUE 20); wall rates never compare across rounds")
+def _judge_search(row: BenchRow, art: BenchArtifact) -> Verdict:
+    base = row.parsed_unit.get("seq_rate")
+    if base is None:
+        return _verdict(row, "tournament-beats-sequential", NO_EVIDENCE,
+                        "unit embeds no same-run sequential rate", art)
+    if row.value is None:
+        return _verdict(row, "tournament-beats-sequential", NO_EVIDENCE,
+                        "row has no value", art)
+    ratio = row.value / base if base else float("inf")
+    detail = f"{row.value:g} cfg/s vs sequential {base:g} ({ratio:.1f}x)"
+    if ratio > 1.0:
+        return _verdict(row, "tournament-beats-sequential", WIN, detail, art)
+    return _verdict(
+        row, "tournament-beats-sequential", REGRESSION,
+        detail + " — the vmapped tournament must beat one-config-per-"
+        "solve or the search driver has no reason to exist", art,
+    )
+
+
 # -- judging entry points ----------------------------------------------------
 
 

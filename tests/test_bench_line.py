@@ -34,6 +34,7 @@ EXPECTED_METRICS = [
     "stream_game_ranks",
     "serve_microbatch",
     "refresh_incremental",
+    "search_throughput",
 ]
 
 

@@ -31,6 +31,7 @@ import json
 import os
 import threading
 
+import jax
 import numpy as np
 import pytest
 
@@ -330,7 +331,21 @@ def _composed_fixture(tmp_path):
     return parts, re_parts
 
 
-def _run_composed_per_rank(parts, re_parts, mesh, exchanges, checkpointers,
+def _rank_meshes():
+    """Disjoint 2x2 hybrid meshes, one per virtual rank (devices[4r:4r+4]).
+
+    Two rank THREADS dispatching collective-bearing programs over the SAME
+    XLA CPU devices can interleave at the AllReduce rendezvous and deadlock
+    (the documented virtual-rank landmine; test_streaming_game_ranks takes
+    the same split) — ranks never share devices, the production topology."""
+    devices = jax.devices()
+    return [
+        make_hybrid_mesh(data=2, model=2, devices=devices[4 * r:4 * r + 4])
+        for r in range(NUM_RANKS)
+    ]
+
+
+def _run_composed_per_rank(parts, re_parts, meshes, exchanges, checkpointers,
                            coordinators, journals, num_iterations=3):
     """Each virtual rank runs the SAME composed train_partitioned under
     run_with_recovery(coordinator=...) — the per-process shape a real pod
@@ -345,6 +360,7 @@ def _run_composed_per_rank(parts, re_parts, mesh, exchanges, checkpointers,
     def work(r):
         def attempt(restart):
             prog = _program()
+            mesh = meshes[r]
             scheds = make_schedulers(prog.re_specs, mesh=mesh)
             return train_partitioned(
                 prog,
@@ -385,14 +401,14 @@ class TestCoordinatedComposedRollback:
 
     def test_rank_kill_mid_sweep_resumes_bitwise_attributed(self, tmp_path):
         parts, re_parts = _composed_fixture(tmp_path / "data")
-        mesh = make_hybrid_mesh(data=4, model=2)
+        meshes = _rank_meshes()
 
         # uninterrupted reference: same composed path, no chaos attached
         ref_group = InProcessExchange.create_group(NUM_RANKS, timeout=5.0)
         ref_cks = [TrainingCheckpointer(tmp_path / "refck")
                    for _ in range(NUM_RANKS)]
         ref_res, ref_err = _run_composed_per_rank(
-            parts, re_parts, mesh, ref_group, ref_cks,
+            parts, re_parts, meshes, ref_group, ref_cks,
             [None] * NUM_RANKS, None,
         )
         assert ref_err == [None, None], ref_err
@@ -418,7 +434,7 @@ class TestCoordinatedComposedRollback:
         ]
         before = (rc.peer_aborts(), rc.coordinated_restarts())
         results, errors = _run_composed_per_rank(
-            parts, re_parts, mesh, exchanges, cks, coords, journals,
+            parts, re_parts, meshes, exchanges, cks, coords, journals,
         )
         for j in journals:
             j.close()
@@ -466,7 +482,7 @@ class TestCoordinatedComposedRollback:
         == the detached run, with ZERO additional exchange ops on the
         sweep hot path and no abort key ever written."""
         parts, re_parts = _composed_fixture(tmp_path / "data")
-        mesh = make_hybrid_mesh(data=4, model=2)
+        meshes = _rank_meshes()
 
         class CountingExchange:
             def __init__(self, inner):
@@ -503,7 +519,7 @@ class TestCoordinatedComposedRollback:
                 for r in range(NUM_RANKS)
             ]
             results, errors = _run_composed_per_rank(
-                parts, re_parts, mesh, counted, cks, coords, None,
+                parts, re_parts, meshes, counted, cks, coords, None,
             )
             assert errors == [None, None], errors
             return results, [c.ops for c in counted], group

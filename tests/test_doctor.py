@@ -83,13 +83,30 @@ class TestUnitParsing:
             "stream_fe_chunked": "off_ms",
             "stream_game_duhl": "visits_ordered",
             "serve_microbatch": "unbatched_rate",
-            "fe_hot_loop_hbm_gbps_pallas_kernel": "cal_fraction",
+            "search_throughput": "seq_rate",
         }
         for metric, field in need.items():
             parsed = bench_history.parse_unit(
                 metric, by_metric[metric]["unit"]
             )
             assert field in parsed, (metric, by_metric[metric]["unit"])
+        # the r20 line-budget trim moved the hot-loop cal fraction out of
+        # the unit: its rule now rides calibration_fraction's documented
+        # fallback — value / same-run stream-probe row
+        art = bench_history.BenchArtifact(
+            path="sample", round=None, rc=0, parsed_ok=True,
+            rows=[
+                bench_history.BenchRow.from_report_row(r)
+                for r in report["extra_metrics"]
+            ],
+        )
+        frac = bench_history.calibration_fraction(
+            art, art.row("fe_hot_loop_hbm_gbps_pallas_kernel")
+        )
+        assert frac == pytest.approx(
+            art.row("fe_hot_loop_hbm_gbps_pallas_kernel").value
+            / art.row("fe_hot_loop_stream_gbps").value
+        )
 
 
 # ---------------------------------------------------------------------------
